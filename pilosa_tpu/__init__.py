@@ -1,0 +1,9 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A ground-up re-design of Pilosa's capabilities (reference:
+chenjw1985/pilosa, Go) for JAX/XLA/Pallas: roaring-compatible storage,
+dense-in-HBM shard compute, PQL queries executed as per-shard device kernels
+reduced over ICI collectives.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,137 @@
+"""Dense bitmap kernels — the TPU replacement for roaring container set-ops.
+
+The reference's query-time math is per-container AND/OR/ANDNOT/XOR/popcount
+(roaring/roaring.go:2162-2800).  On TPU we keep each 2^20-bit shard row dense:
+``uint32[32768]`` (128 KiB), i.e. a fragment is ``uint32[n_rows, 32768]`` in
+HBM.  Set algebra is elementwise bitwise ops the VPU eats 8x128 at a time, and
+cardinality is ``lax.population_count`` + sum — XLA fuses op+popcount+reduce
+into a single pass over HBM, which replaces the per-container-type kernel
+matrix (intersectArrayRun, intersectBitmapBitmap, ...) wholesale.
+
+Bit layout matches little-endian packbits: bit ``i`` of a shard lives in word
+``i >> 5``, bit position ``i & 31``.  This makes a host ``uint64[16384]`` view
+and the device ``uint32[32768]`` view identical byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SHARD_WIDTH_EXP = 20
+SHARD_WIDTH = 1 << SHARD_WIDTH_EXP  # columns per shard (fragment.go:50-51)
+WORDS = SHARD_WIDTH // 32  # 32768 uint32 words per shard row
+WORDS64 = SHARD_WIDTH // 64  # host-side uint64 words per shard row
+
+
+# -- host conversions ------------------------------------------------------
+
+def positions_to_words(positions: np.ndarray, width: int = SHARD_WIDTH) -> np.ndarray:
+    """Within-shard bit positions -> dense uint32 word vector."""
+    bits = np.zeros(width, dtype=np.uint8)
+    if len(positions):
+        bits[np.asarray(positions, dtype=np.int64)] = 1
+    return np.packbits(bits, bitorder="little").view("<u4")
+
+
+def words_to_positions(words: np.ndarray) -> np.ndarray:
+    """Dense uint32 word vector -> sorted within-shard bit positions."""
+    bits = np.unpackbits(np.ascontiguousarray(words).view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint64)
+
+
+# -- device kernels --------------------------------------------------------
+
+@jax.jit
+def row_and(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+@jax.jit
+def row_or(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+@jax.jit
+def row_andnot(a, b):
+    return jnp.bitwise_and(a, jnp.bitwise_not(b))
+
+
+@jax.jit
+def row_xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+@jax.jit
+def row_not(a):
+    return jnp.bitwise_not(a)
+
+
+@jax.jit
+def popcount(words):
+    """Total set bits of a word vector (int32; max 2^20 per shard row)."""
+    return jnp.sum(jax.lax.population_count(words).astype(jnp.int32))
+
+
+@jax.jit
+def popcount_and(a, b):
+    """Fused intersection count — the north-star Count(Intersect(...)) kernel."""
+    return jnp.sum(
+        jax.lax.population_count(jnp.bitwise_and(a, b)).astype(jnp.int32)
+    )
+
+
+@jax.jit
+def popcount_rows(matrix):
+    """Per-row popcounts of uint32[n_rows, WORDS] -> int32[n_rows]."""
+    return jnp.sum(jax.lax.population_count(matrix).astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def popcount_and_rows(matrix, row):
+    """Per-row intersection counts against one row (TopN candidate scoring)."""
+    return jnp.sum(
+        jax.lax.population_count(jnp.bitwise_and(matrix, row[None, :])).astype(
+            jnp.int32
+        ),
+        axis=-1,
+    )
+
+
+@jax.jit
+def union_rows(matrix):
+    """OR-reduce rows of uint32[n_rows, WORDS] -> uint32[WORDS]."""
+    return jax.lax.reduce(
+        matrix,
+        jnp.uint32(0),
+        jnp.bitwise_or,
+        dimensions=(0,),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def mask_first_n(row, n_bits: int):
+    """Zero all bits >= n_bits (used by Not/Range against partial shards)."""
+    if n_bits >= SHARD_WIDTH:
+        return row
+    word_idx = jnp.arange(row.shape[-1], dtype=jnp.int32)
+    full = n_bits // 32
+    rem = n_bits % 32
+    full_mask = jnp.where(word_idx < full, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    partial = jnp.where(
+        word_idx == full,
+        jnp.uint32((1 << rem) - 1 if rem else 0),
+        jnp.uint32(0),
+    )
+    return jnp.bitwise_and(row, full_mask | partial)
+
+
+def empty_row():
+    return jnp.zeros(WORDS, dtype=jnp.uint32)
+
+
+def full_row():
+    return jnp.full(WORDS, 0xFFFFFFFF, dtype=jnp.uint32)

@@ -1,0 +1,330 @@
+"""Pilosa-roaring file-format codec (byte-compatible with the reference).
+
+Format spec derived from /root/reference/roaring/roaring.go:30-65 (header
+constants), :812-883 (WriteTo), :886-974 (unmarshalPilosaRoaring) and
+:3353-3420 (op-log records):
+
+    [u32 cookie = 12348 | version<<16]
+    [u32 keyN]
+    keyN * [u64 key][u16 containerType][u16 n-1]      # descriptive headers
+    keyN * [u32 absolute file offset]                 # offset table
+    container payloads:
+        array : n * u16 (sorted low-16 values)
+        bitmap: 1024 * u64 (2^16 bits)
+        run   : u16 runCount, runCount * (u16 start, u16 last)   # inclusive
+    op-log (appended after the snapshot section, replayed on load):
+        repeated [u8 opType][u64 value][u32 fnv1a32 of first 9 bytes]
+
+All integers little-endian.  In-memory representation here is intentionally
+NOT a container tree: a bitmap is a sorted, unique ``np.uint64`` vector, which
+vectorizes cleanly and converts to/from the dense device layout.  Container
+types exist only at the serialization boundary, chosen with the reference's
+``Optimize`` thresholds (roaring.go:768,1594-1612, ArrayMaxSize=4096,
+runMaxSize=2048).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+MAGIC = 12348
+VERSION = 0
+COOKIE = MAGIC | (VERSION << 16)
+HEADER_BASE_SIZE = 8
+
+CONTAINER_ARRAY = 1
+CONTAINER_BITMAP = 2
+CONTAINER_RUN = 3
+
+ARRAY_MAX_SIZE = 4096
+RUN_MAX_SIZE = 2048
+
+OP_TYPE_ADD = 0
+OP_TYPE_REMOVE = 1
+OP_SIZE = 13  # 1 type + 8 value + 4 checksum
+
+_FNV_OFFSET = np.uint32(2166136261)
+_FNV_PRIME = np.uint32(16777619)
+
+
+def fnv1a32(data: bytes) -> int:
+    """FNV-1a 32-bit hash (op-log record checksum)."""
+    h = int(_FNV_OFFSET)
+    for b in data:
+        h = ((h ^ b) * int(_FNV_PRIME)) & 0xFFFFFFFF
+    return h
+
+
+def _runs_of(lows: np.ndarray) -> np.ndarray:
+    """Collapse a sorted u16 vector into inclusive [start, last] run pairs."""
+    if lows.size == 0:
+        return np.empty((0, 2), dtype=np.uint16)
+    breaks = np.flatnonzero(np.diff(lows.astype(np.int64)) != 1)
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [lows.size - 1]))
+    return np.stack([lows[starts], lows[ends]], axis=1)
+
+
+def _num_runs(lows: np.ndarray) -> int:
+    if lows.size == 0:
+        return 0
+    return 1 + int(np.count_nonzero(np.diff(lows.astype(np.int64)) != 1))
+
+
+def container_type_for(lows: np.ndarray) -> int:
+    """Pick the serialized container type with the reference's Optimize rule."""
+    n = lows.size
+    runs = _num_runs(lows)
+    if runs <= RUN_MAX_SIZE and runs <= n // 2:
+        return CONTAINER_RUN
+    if n < ARRAY_MAX_SIZE:
+        return CONTAINER_ARRAY
+    return CONTAINER_BITMAP
+
+
+def _lows_to_words(lows: np.ndarray) -> np.ndarray:
+    """Sorted u16 values -> 1024 x u64 bitmap words (little-endian bit order)."""
+    bits = np.zeros(1 << 16, dtype=np.uint8)
+    bits[lows] = 1
+    return np.packbits(bits, bitorder="little").view("<u8")
+
+
+def _words_to_lows(words: np.ndarray) -> np.ndarray:
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint16)
+
+
+def serialize(values: np.ndarray) -> bytes:
+    """Serialize a sorted unique u64 vector to pilosa-roaring bytes."""
+    values = np.asarray(values, dtype=np.uint64)
+    highs = (values >> np.uint64(16)).astype(np.uint64)
+    lows_all = (values & np.uint64(0xFFFF)).astype(np.uint16)
+    keys, starts = np.unique(highs, return_index=True)
+    bounds = np.append(starts, values.size)
+
+    headers = []
+    payloads = []
+    for i, key in enumerate(keys):
+        lows = lows_all[bounds[i] : bounds[i + 1]]
+        ctype = container_type_for(lows)
+        if ctype == CONTAINER_RUN:
+            runs = _runs_of(lows)
+            payload = struct.pack("<H", runs.shape[0]) + runs.astype("<u2").tobytes()
+        elif ctype == CONTAINER_ARRAY:
+            payload = lows.astype("<u2").tobytes()
+        else:
+            payload = _lows_to_words(lows).astype("<u8").tobytes()
+        headers.append((int(key), ctype, lows.size))
+        payloads.append(payload)
+
+    key_n = len(headers)
+    out = bytearray()
+    out += struct.pack("<II", COOKIE, key_n)
+    for key, ctype, n in headers:
+        out += struct.pack("<QHH", key, ctype, n - 1)
+    offset = HEADER_BASE_SIZE + key_n * (8 + 2 + 2 + 4)
+    for payload in payloads:
+        out += struct.pack("<I", offset)
+        offset += len(payload)
+    for payload in payloads:
+        out += payload
+    return bytes(out)
+
+
+class _Decoded:
+    __slots__ = ("values", "op_n", "ops")
+
+    def __init__(self, values: np.ndarray, op_n: int, ops: list):
+        self.values = values
+        self.op_n = op_n
+        self.ops = ops
+
+
+# Official-roaring cookies (32-bit interchange format, also accepted by the
+# reference's UnmarshalBinary, roaring.go:3819-3925).
+OFFICIAL_COOKIE_NO_RUN = 12346
+OFFICIAL_COOKIE = 12347
+
+
+def deserialize(data: bytes) -> _Decoded:
+    """Decode roaring bytes -> sorted unique u64 vector.
+
+    Accepts both Pilosa's 64-bit format (cookie 12348, with op-log replay,
+    mirroring unmarshalPilosaRoaring roaring.go:886-974) and the official
+    32-bit roaring interchange format (cookies 12346/12347,
+    roaring.go:3885-3925).
+    """
+    if len(data) < HEADER_BASE_SIZE:
+        raise ValueError("roaring: data too small")
+    magic = struct.unpack_from("<H", data, 0)[0]
+    version = struct.unpack_from("<H", data, 2)[0]
+    if magic != MAGIC:
+        return _deserialize_official(data)
+    if version != VERSION:
+        raise ValueError(f"roaring: wrong version {version}")
+    key_n = struct.unpack_from("<I", data, 4)[0]
+
+    headers = []
+    pos = HEADER_BASE_SIZE
+    for _ in range(key_n):
+        key, ctype, n_minus_1 = struct.unpack_from("<QHH", data, pos)
+        headers.append((key, ctype, n_minus_1 + 1))
+        pos += 12
+
+    chunks = []
+    ops_offset = pos + 4 * key_n
+    for i, (key, ctype, n) in enumerate(headers):
+        offset = struct.unpack_from("<I", data, pos + 4 * i)[0]
+        if offset >= len(data):
+            raise ValueError(f"roaring: offset out of bounds: {offset}")
+        if ctype == CONTAINER_RUN:
+            run_count = struct.unpack_from("<H", data, offset)[0]
+            runs = np.frombuffer(
+                data, dtype="<u2", count=run_count * 2, offset=offset + 2
+            ).reshape(run_count, 2)
+            pieces = [
+                np.arange(int(s), int(e) + 1, dtype=np.uint32)
+                for s, e in runs.astype(np.int64)
+            ]
+            lows = (
+                np.concatenate(pieces).astype(np.uint64)
+                if pieces
+                else np.empty(0, dtype=np.uint64)
+            )
+            ops_offset = offset + 2 + run_count * 4
+        elif ctype == CONTAINER_ARRAY:
+            lows = np.frombuffer(data, dtype="<u2", count=n, offset=offset).astype(
+                np.uint64
+            )
+            ops_offset = offset + n * 2
+        elif ctype == CONTAINER_BITMAP:
+            words = np.frombuffer(data, dtype="<u8", count=1024, offset=offset)
+            lows = _words_to_lows(words).astype(np.uint64)
+            ops_offset = offset + 1024 * 8
+        else:
+            raise ValueError(f"roaring: unknown container type {ctype}")
+        chunks.append((np.uint64(key) << np.uint64(16)) | lows)
+
+    values = (
+        np.concatenate(chunks) if chunks else np.empty(0, dtype=np.uint64)
+    )
+
+    # Replay the op-log (indexed, not re-sliced: op logs can be large).
+    ops = []
+    view = memoryview(data)
+    pos = ops_offset
+    while pos < len(data):
+        typ, value = parse_op(view[pos : pos + OP_SIZE])
+        ops.append((typ, value))
+        pos += OP_SIZE
+    if ops:
+        values = apply_ops(values, ops)
+    return _Decoded(values, len(ops), ops)
+
+
+def _deserialize_official(data: bytes) -> _Decoded:
+    """Decode the official 32-bit roaring format (u16 keys; runs stored as
+    (start, length); offset table only in the no-run layout)."""
+    cookie = struct.unpack_from("<I", data, 0)[0]
+    pos = 4
+    if cookie == OFFICIAL_COOKIE_NO_RUN:
+        key_n = struct.unpack_from("<I", data, pos)[0]
+        pos += 4
+        is_run = [False] * key_n
+        have_runs = False
+    elif cookie & 0xFFFF == OFFICIAL_COOKIE:
+        key_n = (cookie >> 16) + 1
+        nbytes = (key_n + 7) // 8
+        run_bits = data[pos : pos + nbytes]
+        is_run = [bool(run_bits[i // 8] & (1 << (i % 8))) for i in range(key_n)]
+        pos += nbytes
+        have_runs = True
+    else:
+        raise ValueError(f"roaring: invalid magic number {cookie & 0xFFFF}")
+
+    headers = []
+    for i in range(key_n):
+        key, n_minus_1 = struct.unpack_from("<HH", data, pos)
+        n = n_minus_1 + 1
+        if is_run[i]:
+            ctype = CONTAINER_RUN
+        elif n < ARRAY_MAX_SIZE:
+            ctype = CONTAINER_ARRAY
+        else:
+            ctype = CONTAINER_BITMAP
+        headers.append((key, ctype, n))
+        pos += 4
+
+    if not have_runs:
+        offsets = [
+            struct.unpack_from("<I", data, pos + 4 * i)[0] for i in range(key_n)
+        ]
+    else:
+        # No offset table; containers are packed back-to-back.
+        offsets = None
+
+    chunks = []
+    for i, (key, ctype, n) in enumerate(headers):
+        offset = offsets[i] if offsets is not None else pos
+        if ctype == CONTAINER_RUN:
+            run_count = struct.unpack_from("<H", data, offset)[0]
+            runs = np.frombuffer(
+                data, dtype="<u2", count=run_count * 2, offset=offset + 2
+            ).reshape(run_count, 2)
+            pieces = [
+                np.arange(int(s), int(s) + int(l) + 1, dtype=np.uint32)
+                for s, l in runs.astype(np.int64)
+            ]
+            lows = (
+                np.concatenate(pieces).astype(np.uint64)
+                if pieces
+                else np.empty(0, dtype=np.uint64)
+            )
+            size = 2 + run_count * 4
+        elif ctype == CONTAINER_ARRAY:
+            lows = np.frombuffer(data, dtype="<u2", count=n, offset=offset).astype(
+                np.uint64
+            )
+            size = n * 2
+        else:
+            words = np.frombuffer(data, dtype="<u8", count=1024, offset=offset)
+            lows = _words_to_lows(words).astype(np.uint64)
+            size = 1024 * 8
+        if offsets is None:
+            pos = offset + size
+        chunks.append((np.uint64(key) << np.uint64(16)) | lows)
+
+    values = np.concatenate(chunks) if chunks else np.empty(0, dtype=np.uint64)
+    return _Decoded(values, 0, [])
+
+
+def parse_op(buf) -> tuple:
+    if len(buf) < OP_SIZE:
+        raise ValueError(f"roaring: op data out of bounds: len={len(buf)}")
+    typ = buf[0]
+    value = struct.unpack_from("<Q", buf, 1)[0]
+    chk = struct.unpack_from("<I", buf, 9)[0]
+    want = fnv1a32(bytes(buf[:9]))
+    if chk != want:
+        raise ValueError(f"roaring: op checksum mismatch: exp={want:08x} got={chk:08x}")
+    if typ not in (OP_TYPE_ADD, OP_TYPE_REMOVE):
+        raise ValueError(f"roaring: invalid op type {typ}")
+    return typ, value
+
+
+def encode_op(typ: int, value: int) -> bytes:
+    head = struct.pack("<BQ", typ, value)
+    return head + struct.pack("<I", fnv1a32(head))
+
+
+def apply_ops(values: np.ndarray, ops) -> np.ndarray:
+    """Replay (type, value) ops over a sorted u64 vector."""
+    vals = set(values.tolist())
+    for typ, value in ops:
+        if typ == OP_TYPE_ADD:
+            vals.add(value)
+        else:
+            vals.discard(value)
+    return np.array(sorted(vals), dtype=np.uint64)

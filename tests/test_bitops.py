@@ -1,0 +1,91 @@
+"""Dense device kernels vs numpy oracle (reference test model:
+roaring/roaring_internal_test.go's exhaustive pairwise op checks)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import ops
+
+
+def rand_positions(rng, n):
+    return np.unique(rng.integers(0, ops.SHARD_WIDTH, n))
+
+
+def test_positions_words_roundtrip(rng):
+    pos = rand_positions(rng, 10000)
+    words = ops.positions_to_words(pos)
+    assert words.shape == (ops.WORDS,)
+    back = ops.words_to_positions(words)
+    assert np.array_equal(back, pos.astype(np.uint64))
+
+
+def test_positions_to_words_bit_layout():
+    words = ops.positions_to_words(np.array([0, 31, 32, 95]))
+    assert words[0] == (1 | (1 << 31))
+    assert words[1] == 1
+    assert words[2] == (1 << 31)
+
+
+@pytest.mark.parametrize(
+    "op,pyop",
+    [
+        (ops.row_and, lambda a, b: a & b),
+        (ops.row_or, lambda a, b: a | b),
+        (ops.row_xor, lambda a, b: a ^ b),
+        (ops.row_andnot, lambda a, b: a - b),
+    ],
+)
+def test_setops_oracle(rng, op, pyop):
+    a = set(rand_positions(rng, 50000).tolist())
+    b = set(rand_positions(rng, 50000).tolist())
+    wa = ops.positions_to_words(np.array(sorted(a)))
+    wb = ops.positions_to_words(np.array(sorted(b)))
+    got = ops.words_to_positions(np.asarray(op(wa, wb)))
+    assert got.tolist() == sorted(pyop(a, b))
+
+
+def test_popcount(rng):
+    pos = rand_positions(rng, 77777)
+    words = ops.positions_to_words(pos)
+    assert int(ops.popcount(words)) == pos.size
+
+
+def test_popcount_and(rng):
+    a = rand_positions(rng, 50000)
+    b = rand_positions(rng, 50000)
+    wa, wb = ops.positions_to_words(a), ops.positions_to_words(b)
+    expect = np.intersect1d(a, b).size
+    assert int(ops.popcount_and(wa, wb)) == expect
+
+
+def test_popcount_rows(rng):
+    rows = [rand_positions(rng, n) for n in (10, 1000, 100000)]
+    mat = np.stack([ops.positions_to_words(r) for r in rows])
+    got = np.asarray(ops.popcount_rows(mat))
+    assert got.tolist() == [r.size for r in rows]
+
+
+def test_popcount_and_rows(rng):
+    rows = [rand_positions(rng, 5000) for _ in range(4)]
+    src = rand_positions(rng, 5000)
+    mat = np.stack([ops.positions_to_words(r) for r in rows])
+    w_src = ops.positions_to_words(src)
+    got = np.asarray(ops.popcount_and_rows(mat, w_src))
+    expect = [np.intersect1d(r, src).size for r in rows]
+    assert got.tolist() == expect
+
+
+def test_union_rows(rng):
+    rows = [rand_positions(rng, 5000) for _ in range(5)]
+    mat = np.stack([ops.positions_to_words(r) for r in rows])
+    got = ops.words_to_positions(np.asarray(ops.union_rows(mat)))
+    expect = np.unique(np.concatenate(rows))
+    assert np.array_equal(got, expect.astype(np.uint64))
+
+
+@pytest.mark.parametrize("n_bits", [0, 1, 31, 32, 33, 1000, ops.SHARD_WIDTH])
+def test_mask_first_n(rng, n_bits):
+    pos = rand_positions(rng, 100000)
+    words = ops.positions_to_words(pos)
+    got = ops.words_to_positions(np.asarray(ops.mask_first_n(words, n_bits)))
+    assert got.tolist() == [p for p in pos.tolist() if p < n_bits]
