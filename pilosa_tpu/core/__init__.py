@@ -1,0 +1,27 @@
+from .cache import LRUCache, NopCache, RankCache, new_cache
+from .field import BSIGroup, Field, FieldOptions
+from .fragment import Fragment, HASH_BLOCK_SIZE, SHARD_WIDTH
+from .holder import Holder
+from .index import EXISTENCE_FIELD_NAME, Index
+from .row import Row
+from .view import VIEW_STANDARD, View, view_bsi_name
+
+__all__ = [
+    "BSIGroup",
+    "EXISTENCE_FIELD_NAME",
+    "Field",
+    "FieldOptions",
+    "Fragment",
+    "HASH_BLOCK_SIZE",
+    "Holder",
+    "Index",
+    "LRUCache",
+    "NopCache",
+    "RankCache",
+    "Row",
+    "SHARD_WIDTH",
+    "VIEW_STANDARD",
+    "View",
+    "new_cache",
+    "view_bsi_name",
+]

@@ -1,0 +1,178 @@
+"""TopN row-count caches: ranked, LRU, none.
+
+Behavioral mirror of the reference's cache.go: a per-fragment cache of
+row-id -> column count used by TopN's approximate phase 1.  The ranked cache
+keeps up to maxEntries sorted pairs, admits new entries above the current
+threshold value, and trims at thresholdFactor (1.1) * maxEntries
+(cache.go:30-31,145-290).  The LRU variant evicts by recency
+(cache.go:57-131).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Tuple
+
+THRESHOLD_FACTOR = 1.1
+
+DEFAULT_CACHE_SIZE = 50000
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+
+VALID_CACHE_TYPES = {CACHE_TYPE_RANKED, CACHE_TYPE_LRU, CACHE_TYPE_NONE}
+
+
+def pair_sort_key(pair: Tuple[int, int]):
+    """Sort pairs by count desc, then id desc (matches the reference's
+    bitmapPairs ordering used for ranked caches and TopN merges)."""
+    return (-pair[1], -pair[0])
+
+
+class RankCache:
+    """Sorted row-count cache with admission threshold."""
+
+    def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE, debounce_seconds: float = 10.0):
+        self.max_entries = max_entries
+        self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
+        self.threshold_value = 0
+        self.entries: Dict[int, int] = {}
+        self.rankings: List[Tuple[int, int]] = []
+        self._update_time = 0.0
+        # The reference hard-codes a 10s invalidation debounce
+        # (cache.go:236-240); configurable here so tests are deterministic.
+        self.debounce_seconds = debounce_seconds
+
+    def add(self, row_id: int, n: int):
+        # Below-threshold counts are ignored unless zero (zero clears).
+        if n < self.threshold_value and n > 0:
+            return
+        self.entries[row_id] = n
+        self.invalidate()
+
+    def bulk_add(self, row_id: int, n: int):
+        if n < self.threshold_value:
+            return
+        self.entries[row_id] = n
+
+    def get(self, row_id: int) -> int:
+        return self.entries.get(row_id, 0)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def ids(self) -> List[int]:
+        return sorted(self.entries)
+
+    def invalidate(self):
+        if time.monotonic() - self._update_time < self.debounce_seconds:
+            return
+        self.recalculate()
+
+    def recalculate(self):
+        rankings = sorted(self.entries.items(), key=pair_sort_key)
+        remove_items: List[Tuple[int, int]] = []
+        if len(rankings) > self.max_entries:
+            self.threshold_value = rankings[self.max_entries][1]
+            remove_items = rankings[self.max_entries :]
+            rankings = rankings[: self.max_entries]
+        else:
+            self.threshold_value = 1
+        self.rankings = rankings
+        self._update_time = time.monotonic()
+        if len(self.entries) > self.threshold_buffer:
+            for row_id, _ in remove_items:
+                self.entries.pop(row_id, None)
+
+    def top(self) -> List[Tuple[int, int]]:
+        return self.rankings
+
+
+class LRUCache:
+    """Recency-evicting row-count cache."""
+
+    def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE, **_):
+        self.max_entries = max_entries
+        self._od: OrderedDict[int, int] = OrderedDict()
+
+    def add(self, row_id: int, n: int):
+        if row_id in self._od:
+            self._od.move_to_end(row_id)
+        self._od[row_id] = n
+        if self.max_entries and len(self._od) > self.max_entries:
+            self._od.popitem(last=False)
+
+    bulk_add = add
+
+    def get(self, row_id: int) -> int:
+        n = self._od.get(row_id, 0)
+        if row_id in self._od:
+            self._od.move_to_end(row_id)
+        return n
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+    def ids(self) -> List[int]:
+        return sorted(self._od)
+
+    def invalidate(self):
+        pass
+
+    def recalculate(self):
+        pass
+
+    def top(self) -> List[Tuple[int, int]]:
+        return sorted(self._od.items(), key=pair_sort_key)
+
+
+class NopCache:
+    """No cache (cacheType: none)."""
+
+    def __init__(self, *_, **__):
+        pass
+
+    def add(self, row_id: int, n: int):
+        pass
+
+    bulk_add = add
+
+    def get(self, row_id: int) -> int:
+        return 0
+
+    def __len__(self) -> int:
+        return 0
+
+    def ids(self) -> List[int]:
+        return []
+
+    def invalidate(self):
+        pass
+
+    def recalculate(self):
+        pass
+
+    def top(self) -> List[Tuple[int, int]]:
+        return []
+
+
+def new_cache(cache_type: str, size: int, debounce_seconds: float = 10.0):
+    if cache_type == CACHE_TYPE_RANKED:
+        return RankCache(size, debounce_seconds=debounce_seconds)
+    if cache_type == CACHE_TYPE_LRU:
+        return LRUCache(size)
+    if cache_type == CACHE_TYPE_NONE:
+        return NopCache()
+    raise ValueError(f"invalid cache type: {cache_type}")
+
+
+def merge_pairs(lists: List[List[Tuple[int, int]]]) -> List[Tuple[int, int]]:
+    """K-way merge of (id, count) pair lists, summing counts per id
+    (reference: Pairs.Add heap merge, cache.go:356-397)."""
+    acc: Dict[int, int] = {}
+    for pairs in lists:
+        for row_id, n in pairs:
+            acc[row_id] = acc.get(row_id, 0) + n
+    return sorted(acc.items(), key=pair_sort_key)

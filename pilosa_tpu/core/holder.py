@@ -1,0 +1,139 @@
+"""Holder: root container of all indexes on a node.
+
+Mirror of the reference's Holder (holder.go:50-911): owns the data
+directory, opens/closes every index/field/view/fragment, hands fragments to
+the executor, and hosts the anti-entropy syncer (cluster stage).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .fragment import Fragment
+from .index import Index
+from .view import View
+
+
+class Holder:
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        cache_debounce: float = 0.0,
+        on_create_shard=None,
+        attr_store_factory=None,
+    ):
+        self.path = path
+        self.indexes: Dict[str, Index] = {}
+        self.cache_debounce = cache_debounce
+        self.on_create_shard = on_create_shard
+        self.attr_store_factory = attr_store_factory
+        self.opened = False
+
+    def open(self):
+        if self.path is not None:
+            os.makedirs(self.path, exist_ok=True)
+            for name in sorted(os.listdir(self.path)):
+                p = os.path.join(self.path, name)
+                if os.path.isdir(p) and not name.startswith("."):
+                    idx = self._new_index(name)
+                    idx.open()
+                    self.indexes[name] = idx
+        self.opened = True
+
+    def close(self):
+        for idx in self.indexes.values():
+            idx.close()
+        self.opened = False
+
+    def _index_path(self, name: str) -> Optional[str]:
+        if self.path is None:
+            return None
+        return os.path.join(self.path, name)
+
+    def _new_index(self, name: str, keys: bool = False, track_existence: bool = True) -> Index:
+        return Index(
+            name,
+            path=self._index_path(name),
+            keys=keys,
+            track_existence=track_existence,
+            cache_debounce=self.cache_debounce,
+            on_create_shard=self.on_create_shard,
+            attr_store_factory=self.attr_store_factory,
+        )
+
+    def index(self, name: str) -> Optional[Index]:
+        return self.indexes.get(name)
+
+    def create_index(
+        self, name: str, keys: bool = False, track_existence: bool = True
+    ) -> Index:
+        if name in self.indexes:
+            raise ValueError(f"index already exists: {name}")
+        return self._create(name, keys, track_existence)
+
+    def create_index_if_not_exists(
+        self, name: str, keys: bool = False, track_existence: bool = True
+    ) -> Index:
+        idx = self.indexes.get(name)
+        if idx is not None:
+            return idx
+        return self._create(name, keys, track_existence)
+
+    def _create(self, name: str, keys: bool, track_existence: bool) -> Index:
+        from .index import validate_name
+
+        validate_name(name)
+        idx = self._new_index(name, keys, track_existence)
+        idx.open()
+        idx.save_meta()
+        self.indexes[name] = idx
+        return idx
+
+    def delete_index(self, name: str):
+        idx = self.indexes.pop(name, None)
+        if idx is None:
+            raise ValueError(f"index not found: {name}")
+        idx.close()
+        if idx.path and os.path.isdir(idx.path):
+            import shutil
+
+            shutil.rmtree(idx.path)
+
+    # -- executor accessors (holder.go fragment/view helpers) --------------
+
+    def fragment(
+        self, index: str, field: str, view: str, shard: int
+    ) -> Optional[Fragment]:
+        idx = self.indexes.get(index)
+        if idx is None:
+            return None
+        f = idx.field(field)
+        if f is None:
+            return None
+        v = f.view(view)
+        if v is None:
+            return None
+        return v.fragment(shard)
+
+    def view(self, index: str, field: str, view: str) -> Optional[View]:
+        idx = self.indexes.get(index)
+        if idx is None:
+            return None
+        f = idx.field(field)
+        if f is None:
+            return None
+        return f.view(view)
+
+    def schema(self) -> List[dict]:
+        """Schema description for the /schema endpoint."""
+        out = []
+        for name, idx in sorted(self.indexes.items()):
+            fields = []
+            for f in idx.public_fields():
+                fields.append({"name": f.name, "options": f.options.to_dict()})
+            out.append({"name": name, "options": {"keys": idx.keys}, "fields": fields})
+        return out
+
+    def __repr__(self) -> str:
+        return f"Holder(indexes={sorted(self.indexes)})"

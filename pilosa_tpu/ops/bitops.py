@@ -37,6 +37,15 @@ def positions_to_words(positions: np.ndarray, width: int = SHARD_WIDTH) -> np.nd
     return np.packbits(bits, bitorder="little").view("<u4")
 
 
+def popcount_np(words: np.ndarray) -> int:
+    """Host-side popcount of a word vector (any unsigned dtype)."""
+    return int(
+        np.sum(np.bitwise_count(words))
+        if hasattr(np, "bitwise_count")
+        else np.sum(np.unpackbits(np.ascontiguousarray(words).view(np.uint8)))
+    )
+
+
 def words_to_positions(words: np.ndarray) -> np.ndarray:
     """Dense uint32 word vector -> sorted within-shard bit positions."""
     bits = np.unpackbits(np.ascontiguousarray(words).view(np.uint8), bitorder="little")

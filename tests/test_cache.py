@@ -1,0 +1,48 @@
+"""Ranked/LRU cache semantics (cache_test.go model)."""
+
+from pilosa_tpu.core.cache import LRUCache, RankCache, merge_pairs, new_cache
+
+
+def test_rank_cache_ordering():
+    c = RankCache(10, debounce_seconds=0)
+    for i, n in [(1, 5), (2, 10), (3, 3)]:
+        c.add(i, n)
+    assert c.top() == [(2, 10), (1, 5), (3, 3)]
+    assert c.get(2) == 10
+    assert c.ids() == [1, 2, 3]
+
+
+def test_rank_cache_threshold_trim():
+    c = RankCache(3, debounce_seconds=0)
+    for i in range(10):
+        c.bulk_add(i, i + 1)
+    c.recalculate()
+    # top 3 kept in rankings; threshold set at 4th item's count
+    assert c.top() == [(9, 10), (8, 9), (7, 8)]
+    assert c.threshold_value == 7
+    # below-threshold adds are ignored (unless 0)
+    c.add(100, 2)
+    assert c.get(100) == 0
+    c.add(9, 0)  # zero clears
+    assert c.get(9) == 0
+
+
+def test_lru_cache_eviction():
+    c = LRUCache(2)
+    c.add(1, 10)
+    c.add(2, 20)
+    c.add(3, 30)
+    assert c.get(1) == 0  # evicted
+    assert sorted(c.ids()) == [2, 3]
+    assert c.top() == [(3, 30), (2, 20)]
+
+
+def test_new_cache_types():
+    assert isinstance(new_cache("ranked", 10), RankCache)
+    assert isinstance(new_cache("lru", 10), LRUCache)
+    assert len(new_cache("none", 10)) == 0
+
+
+def test_merge_pairs():
+    merged = merge_pairs([[(1, 5), (2, 3)], [(1, 2), (3, 9)]])
+    assert merged == [(3, 9), (1, 7), (2, 3)]

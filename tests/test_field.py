@@ -1,0 +1,192 @@
+"""Field/Index/Holder behavior, modeled on field_test.go / index_test.go /
+holder_test.go: field types, time views, BSI ranges, existence field,
+available shards, persistence."""
+
+import datetime as dt
+
+import pytest
+
+from pilosa_tpu.core import (
+    EXISTENCE_FIELD_NAME,
+    Field,
+    FieldOptions,
+    Holder,
+    Row,
+)
+from pilosa_tpu.core.cache import CACHE_TYPE_NONE
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_TIME,
+)
+
+
+def test_set_field_basic():
+    f = Field("i", "f")
+    assert f.set_bit(10, 100)
+    assert not f.set_bit(10, 100)
+    assert f.row(10).columns().tolist() == [100]
+    assert f.clear_bit(10, 100)
+    assert f.row(10).count() == 0
+
+
+def test_time_field_views():
+    f = Field("i", "t", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD"))
+    ts = dt.datetime(2018, 8, 21, 13, 0)
+    f.set_bit(1, 5, timestamp=ts)
+    assert sorted(f.views) == [
+        "standard",
+        "standard_2018",
+        "standard_201808",
+        "standard_20180821",
+    ]
+    for v in f.views.values():
+        assert v.fragment(0).bit(1, 5)
+
+
+def test_time_field_rejects_timestamp_on_set_type():
+    f = Field("i", "f")
+    with pytest.raises(ValueError):
+        f.set_bit(1, 5, timestamp=dt.datetime(2018, 1, 1))
+
+
+def test_int_field_value_roundtrip():
+    f = Field("i", "n", FieldOptions(type=FIELD_TYPE_INT, min=-10, max=1000))
+    assert f.bit_depth() == 10  # range 1010 < 2^10
+    assert f.set_value(42, 99)
+    assert f.value(42) == (99, True)
+    assert f.set_value(43, -10)
+    assert f.value(43) == (-10, True)
+    assert f.value(44) == (0, False)
+    with pytest.raises(ValueError):
+        f.set_value(45, 1001)
+    f.clear_value(42)
+    assert f.value(42) == (0, False)
+
+
+def test_bool_field_mutex_semantics():
+    f = Field("i", "b", FieldOptions(type=FIELD_TYPE_BOOL, cache_type=CACHE_TYPE_NONE, cache_size=0))
+    f.set_bit(1, 7)  # true
+    f.set_bit(0, 7)  # flip to false clears true row
+    frag = f.view("standard").fragment(0)
+    assert frag.bit(0, 7) and not frag.bit(1, 7)
+
+
+def test_mutex_field():
+    f = Field("i", "m", FieldOptions(type=FIELD_TYPE_MUTEX))
+    f.set_bit(3, 9)
+    f.set_bit(5, 9)
+    frag = f.view("standard").fragment(0)
+    assert frag.bit(5, 9) and not frag.bit(3, 9)
+
+
+def test_bsi_base_value():
+    from pilosa_tpu.core.field import BSIGroup
+
+    g = BSIGroup("n", 0, 1023)
+    assert g.bit_depth() == 10
+    assert g.base_value(">", 2000) == (0, True)
+    assert g.base_value("<", 2000) == (1023, False)
+    assert g.base_value("==", 500) == (500, False)
+    assert g.base_value("==", -1) == (0, True)
+    g2 = BSIGroup("n", 100, 200)
+    assert g2.base_value("==", 150) == (50, False)
+    assert g2.base_value_between(50, 150) == (0, 50, False)
+    assert g2.base_value_between(250, 300) == (0, 0, True)
+
+
+def test_field_import_bulk_with_time():
+    f = Field("i", "t", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YM"))
+    ts = [dt.datetime(2018, 1, 1), dt.datetime(2018, 2, 1), None]
+    f.import_bulk([1, 1, 2], [10, 20, 30], ts)
+    assert f.row(1).columns().tolist() == [10, 20]
+    assert "standard_201801" in f.views
+    assert "standard_201802" in f.views
+
+
+def test_available_shards_merge():
+    from pilosa_tpu.roaring import Bitmap
+
+    f = Field("i", "f")
+    f.set_bit(0, 5)  # shard 0
+    f.set_bit(0, 3 * 2**20 + 1)  # shard 3
+    assert list(f.local_available_shards()) == [0, 3]
+    f.add_remote_available_shards(Bitmap([7]))
+    assert list(f.available_shards()) == [0, 3, 7]
+
+
+def test_holder_persistence(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("myindex")
+    f = idx.create_field("myfield")
+    f.set_bit(1, 100)
+    n = idx.create_field("num", FieldOptions(type=FIELD_TYPE_INT, min=0, max=100))
+    n.set_value(7, 42)
+    h.close()
+
+    h2 = Holder(str(tmp_path / "data"))
+    h2.open()
+    idx2 = h2.index("myindex")
+    assert idx2 is not None
+    assert idx2.field("myfield").row(1).columns().tolist() == [100]
+    assert idx2.field("num").value(7) == (42, True)
+    assert idx2.field("num").options.min == 0
+    # existence field recreated
+    assert idx2.existence_field() is not None
+    h2.close()
+
+
+def test_existence_field():
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    assert idx.existence_field() is not None
+    idx.add_column_existence([5, 10])
+    ef = idx.existence_field()
+    assert ef.row(0).columns().tolist() == [5, 10]
+    # hidden from public schema
+    assert EXISTENCE_FIELD_NAME not in [f.name for f in idx.public_fields()]
+
+
+def test_index_no_track_existence():
+    h = Holder()
+    h.open()
+    idx = h.create_index("i", track_existence=False)
+    assert idx.existence_field() is None
+
+
+def test_name_validation():
+    h = Holder()
+    h.open()
+    with pytest.raises(ValueError):
+        h.create_index("Bad Name")
+    with pytest.raises(ValueError):
+        h.create_index("1starts-with-digit")
+    idx = h.create_index("good-name_1")
+    with pytest.raises(ValueError):
+        idx.create_field("UPPER")
+
+
+def test_delete_field_and_index(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("f").set_bit(0, 1)
+    idx.delete_field("f")
+    assert idx.field("f") is None
+    h.delete_index("i")
+    assert h.index("i") is None
+
+
+def test_schema():
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    idx.create_field("n", FieldOptions(type=FIELD_TYPE_INT, min=0, max=10))
+    schema = h.schema()
+    assert schema[0]["name"] == "i"
+    names = [f["name"] for f in schema[0]["fields"]]
+    assert names == ["f", "n"]

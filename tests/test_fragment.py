@@ -1,0 +1,237 @@
+"""Fragment behavior, modeled on fragment_internal_test.go: set/clear bits,
+row materialization, BSI values, bulk import, snapshot+oplog persistence,
+mutex handling, TopN cache, block checksums and merge."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import ops
+from pilosa_tpu.core import Fragment, Row, SHARD_WIDTH
+from pilosa_tpu.ops import bsi
+
+
+def make_frag(tmp_path=None, shard=0, **kw):
+    path = str(tmp_path / f"frag{shard}") if tmp_path is not None else None
+    return Fragment("i", "f", "standard", shard, path=path, **kw)
+
+
+def test_set_clear_bit():
+    f = make_frag()
+    assert f.set_bit(120, 1)
+    assert not f.set_bit(120, 1)
+    assert f.set_bit(120, 6)
+    assert f.bit(120, 1) and f.bit(120, 6)
+    assert f.row_count(120) == 2
+    assert f.clear_bit(120, 1)
+    assert not f.clear_bit(120, 1)
+    assert f.row_count(120) == 1
+
+
+def test_pos_bounds():
+    f = make_frag(shard=2)
+    assert f.pos(3, 2 * SHARD_WIDTH + 5) == 3 * SHARD_WIDTH + 5
+    with pytest.raises(ValueError):
+        f.pos(0, 5)  # column in shard 0, fragment is shard 2
+
+
+def test_row_materialization():
+    f = make_frag(shard=1)
+    base = SHARD_WIDTH
+    f.set_bit(7, base + 10)
+    f.set_bit(7, base + 999)
+    row = f.row(7)
+    assert row.count() == 2
+    assert row.columns().tolist() == [base + 10, base + 999]
+
+
+def test_bsi_set_get_value():
+    f = make_frag()
+    assert f.set_value(100, 8, 177)
+    v, ok = f.value(100, 8)
+    assert ok and v == 177
+    # overwrite
+    f.set_value(100, 8, 12)
+    v, ok = f.value(100, 8)
+    assert ok and v == 12
+    v, ok = f.value(101, 8)
+    assert not ok
+    f.clear_value(100, 8, 12)
+    v, ok = f.value(100, 8)
+    assert not ok
+
+
+def test_bulk_import_and_counts():
+    f = make_frag()
+    rows = [0, 0, 0, 1, 1, 2]
+    cols = [1, 2, 3, 1, 2, 100]
+    assert f.bulk_import(rows, cols) == 6
+    assert f.row_count(0) == 3
+    assert f.row_count(1) == 2
+    assert f.row_count(2) == 1
+    # re-import same bits: no change
+    assert f.bulk_import(rows, cols) == 0
+
+
+def test_persistence_roundtrip(tmp_path):
+    f = make_frag(tmp_path)
+    f.set_bit(1, 100)
+    f.set_bit(1, 200)
+    f.set_bit(9, 5)
+    f.clear_bit(1, 200)
+    f.close()
+    # Reopen: op-log replay must restore state.
+    f2 = make_frag(tmp_path)
+    assert f2.bit(1, 100)
+    assert not f2.bit(1, 200)
+    assert f2.bit(9, 5)
+    assert f2.row_count(1) == 1
+
+
+def test_snapshot_compaction(tmp_path):
+    f = make_frag(tmp_path, max_op_n=10)
+    for i in range(25):
+        f.set_bit(0, i)
+    assert f.op_n <= 10  # snapshots happened
+    f.close()
+    f2 = make_frag(tmp_path)
+    assert f2.row_count(0) == 25
+
+
+def test_import_roaring(tmp_path):
+    from pilosa_tpu.roaring import codec
+
+    f = make_frag(tmp_path)
+    # bits for rows 0 and 3 in storage-position encoding
+    positions = np.array(
+        [0, 1, 5, 3 * SHARD_WIDTH + 7, 3 * SHARD_WIDTH + 8], dtype=np.uint64
+    )
+    f.import_roaring(codec.serialize(positions))
+    assert f.row_count(0) == 3
+    assert f.row_count(3) == 2
+    f.close()
+    f2 = make_frag(tmp_path)
+    assert f2.row_count(3) == 2
+
+
+def test_mutex():
+    f = make_frag(mutex=True)
+    f.set_bit(1, 50)
+    f.set_bit(2, 50)  # must clear row 1's bit at column 50
+    assert not f.bit(1, 50)
+    assert f.bit(2, 50)
+    assert f.row_containing(50) == 2
+
+
+def test_top_ranked(rng):
+    f = make_frag(cache_type="ranked")
+    # row r gets r+1 bits
+    for r in range(5):
+        for c in range(r + 1):
+            f.set_bit(r, c)
+    f.cache.recalculate()
+    top = f.top(n=3)
+    assert top == [(4, 5), (3, 4), (2, 3)]
+    # with src filter: intersect against columns {0}
+    src = Row({0: ops.positions_to_words(np.array([0]))})
+    top = f.top(n=5, src=src)
+    assert top == [(4, 1), (3, 1), (2, 1), (1, 1), (0, 1)]
+
+
+def test_rows_filtered():
+    f = make_frag()
+    f.set_bit(1, 10)
+    f.set_bit(5, 10)
+    f.set_bit(9, 20)
+    assert f.rows_filtered() == [1, 5, 9]
+    assert f.rows_filtered(start=2) == [5, 9]
+    assert f.rows_filtered(column=10) == [1, 5]
+    assert f.rows_filtered(limit=1) == [1]
+
+
+def test_checksum_blocks_and_merge():
+    a = make_frag()
+    b = make_frag()
+    a.set_bit(0, 1)
+    a.set_bit(150, 3)
+    b.set_bit(0, 1)
+    b.set_bit(150, 4)
+    blocks_a = dict(a.checksum_blocks())
+    blocks_b = dict(b.checksum_blocks())
+    assert blocks_a[0] == blocks_b[0]  # block 0 identical
+    assert blocks_a[1] != blocks_b[1]  # block 1 differs
+    # Merge block 1 of b into a (2 copies, majority of 2 -> ties set).
+    br, bc = b.block_data(1)
+    sets, clears = a.merge_block(1, [(br, bc)])
+    assert a.bit(150, 3) and a.bit(150, 4)
+    # Peer diff harvested for push-back: peer is missing (150, 3).
+    assert sets[0] == [(150, 3)]
+
+
+def test_device_planes_and_bsi_kernels(rng):
+    """BSI range kernels vs numpy oracle over a fragment's planes."""
+    f = make_frag()
+    depth = 8
+    cols = rng.choice(10000, 300, replace=False)
+    vals = rng.integers(0, 200, 300)
+    for c, v in zip(cols.tolist(), vals.tolist()):
+        f.set_value(c, depth, v)
+    planes = f.device_planes(depth)
+    by_col = dict(zip(cols.tolist(), vals.tolist()))
+
+    def oracle(pred):
+        return sorted(c for c, v in by_col.items() if pred(v))
+
+    def cols_of(words):
+        return ops.words_to_positions(np.asarray(words)).tolist()
+
+    pb = bsi.to_bits(57, depth)
+    assert cols_of(bsi.range_eq(planes, pb)) == oracle(lambda v: v == 57)
+    assert cols_of(bsi.range_neq(planes, pb)) == oracle(lambda v: v != 57)
+    assert cols_of(bsi.range_lt(planes, pb, False)) == oracle(lambda v: v < 57)
+    assert cols_of(bsi.range_lt(planes, pb, True)) == oracle(lambda v: v <= 57)
+    assert cols_of(bsi.range_gt(planes, pb, False)) == oracle(lambda v: v > 57)
+    assert cols_of(bsi.range_gt(planes, pb, True)) == oracle(lambda v: v >= 57)
+    lo, hi = bsi.to_bits(50, depth), bsi.to_bits(100, depth)
+    assert cols_of(bsi.range_between(planes, lo, hi)) == oracle(
+        lambda v: 50 <= v <= 100
+    )
+
+    # sum / min / max
+    full = np.full(ops.WORDS, 0xFFFFFFFF, dtype=np.uint32)
+    counts, n = bsi.sum_counts(planes, full)
+    total = sum((1 << i) * int(c) for i, c in enumerate(np.asarray(counts)))
+    assert total == sum(by_col.values())
+    assert int(n) == len(by_col)
+    flags, cnt = bsi.min_flags(planes, full)
+    mn = sum(1 << i for i, s in enumerate(np.asarray(flags)) if s)
+    assert mn == min(by_col.values())
+    assert int(cnt) == sum(1 for v in by_col.values() if v == mn)
+    flags, cnt = bsi.max_flags(planes, full)
+    mx = sum(1 << i for i, s in enumerate(np.asarray(flags)) if s)
+    assert mx == max(by_col.values())
+    assert int(cnt) == sum(1 for v in by_col.values() if v == mx)
+
+
+@pytest.mark.parametrize("edge", [0, 1, 127, 128, 255])
+def test_bsi_kernel_edges(edge):
+    """Predicates at container/bit boundaries."""
+    f = make_frag()
+    depth = 8
+    values = {10: 0, 11: 1, 12: 127, 13: 128, 14: 255, 15: 200}
+    for c, v in values.items():
+        f.set_value(c, depth, v)
+    planes = f.device_planes(depth)
+    pb = bsi.to_bits(edge, depth)
+
+    def cols_of(words):
+        return ops.words_to_positions(np.asarray(words)).tolist()
+
+    assert cols_of(bsi.range_eq(planes, pb)) == sorted(
+        c for c, v in values.items() if v == edge
+    )
+    assert cols_of(bsi.range_lt(planes, pb, True)) == sorted(
+        c for c, v in values.items() if v <= edge
+    )
+    assert cols_of(bsi.range_gt(planes, pb, False)) == sorted(
+        c for c, v in values.items() if v > edge
+    )

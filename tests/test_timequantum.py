@@ -1,0 +1,83 @@
+"""Time quantum behavior — scenarios match the reference's
+time_internal_test.go expectations exactly."""
+
+import datetime as dt
+
+import pytest
+
+from pilosa_tpu.core import timequantum as tq
+
+
+TS = dt.datetime(2000, 1, 2, 3, 4, 5)
+
+
+def t(s):
+    return dt.datetime.strptime(s, "%Y-%m-%d %H:%M")
+
+
+def test_valid_quantum():
+    for q in ("Y", "YM", "YMD", "YMDH", "M", "MD", "MDH", "D", "DH", "H", ""):
+        assert tq.valid_quantum(q)
+    assert not tq.valid_quantum("BADQUANTUM")
+
+
+@pytest.mark.parametrize(
+    "unit,expect",
+    [("Y", "F_2000"), ("M", "F_200001"), ("D", "F_20000102"), ("H", "F_2000010203")],
+)
+def test_view_by_time_unit(unit, expect):
+    assert tq.view_by_time_unit("F", TS, unit) == expect
+
+
+def test_views_by_time():
+    assert tq.views_by_time("F", TS, "YMDH") == [
+        "F_2000",
+        "F_200001",
+        "F_20000102",
+        "F_2000010203",
+    ]
+    assert tq.views_by_time("F", TS, "D") == ["F_20000102"]
+
+
+@pytest.mark.parametrize(
+    "start,end,quantum,expect",
+    [
+        ("2000-01-01 00:00", "2002-01-01 00:00", "Y", ["F_2000", "F_2001"]),
+        (
+            "2000-11-01 00:00",
+            "2003-03-01 00:00",
+            "YM",
+            ["F_200011", "F_200012", "F_2001", "F_2002", "F_200301", "F_200302"],
+        ),
+        (
+            "2001-10-31 00:00",
+            "2003-04-01 00:00",
+            "YM",
+            ["F_200110", "F_200111", "F_200112", "F_2002", "F_200301", "F_200302", "F_200303"],
+        ),
+        (
+            "1999-12-31 00:00",
+            "2000-04-01 00:00",
+            "YM",
+            ["F_199912", "F_200001", "F_200002", "F_200003"],
+        ),
+        (
+            "2000-01-31 00:00",
+            "2001-04-01 00:00",
+            "YM",
+            ["F_2000", "F_200101", "F_200102", "F_200103"],
+        ),
+        (
+            "2000-11-28 00:00",
+            "2003-03-02 00:00",
+            "YMD",
+            ["F_20001128", "F_20001129", "F_20001130", "F_200012", "F_2001", "F_2002", "F_200301", "F_200302", "F_20030301"],
+        ),
+    ],
+)
+def test_views_by_time_range(start, end, quantum, expect):
+    assert tq.views_by_time_range("F", t(start), t(end), quantum) == expect
+
+
+def test_parse_timestamp():
+    assert tq.parse_timestamp("2018-08-21T13:30") == dt.datetime(2018, 8, 21, 13, 30)
