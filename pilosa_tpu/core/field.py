@@ -170,6 +170,14 @@ class Field:
         self.views: Dict[str, View] = {}
         self.cache_debounce = cache_debounce
         self.on_create_shard = on_create_shard
+        if row_attr_store is None:
+            from .attrs import AttrStore
+
+            if path is not None:
+                os.makedirs(path, exist_ok=True)
+            row_attr_store = AttrStore(
+                os.path.join(path, ".data") if path else None
+            )
         self.row_attr_store = row_attr_store
         self.bsi_groups: List[BSIGroup] = []
         if self.options.type == FIELD_TYPE_INT:
@@ -218,6 +226,8 @@ class Field:
         self._save_available_shards()
         for view in self.views.values():
             view.close()
+        if self.row_attr_store is not None:
+            self.row_attr_store.close()
 
     # -- available shards (field.go:228-317) -------------------------------
 
@@ -276,6 +286,7 @@ class Field:
                 mutex=self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL),
                 cache_debounce=self.cache_debounce,
                 on_create_shard=self.on_create_shard,
+                row_attr_store=self.row_attr_store,
             )
             self.views[name] = v
         return v

@@ -64,6 +64,7 @@ class Fragment:
         max_op_n: int = DEFAULT_MAX_OP_N,
         mutex: bool = False,
         cache_debounce: float = 0.0,
+        row_attr_store=None,
     ):
         self.index = index
         self.field = field
@@ -72,6 +73,7 @@ class Fragment:
         self.path = path
         self.mutex = mutex
         self.max_op_n = max_op_n
+        self.row_attr_store = row_attr_store
 
         self.rows: Dict[int, np.ndarray] = {}
         self.row_counts: Dict[int, int] = {}
@@ -453,6 +455,36 @@ class Fragment:
             self.cache.bulk_add(r, self.row_counts[r])
         self.cache.invalidate()
 
+    def clear_row(self, row_id: int) -> bool:
+        """Remove every bit in a row, snapshot (fragment.go clearRow :551,
+        unprotectedClearRow)."""
+        words = self.rows.pop(row_id, None)
+        changed = words is not None and bool(np.any(words))
+        self.row_counts[row_id] = 0
+        self.cache.add(row_id, 0)
+        self._touch(row_id)
+        self.snapshot()
+        return changed
+
+    def set_row(self, row, row_id: int) -> bool:
+        """Overwrite a row with a Row's segment for this shard, snapshot
+        (fragment.go setRow :501 — Store()/SetRow support)."""
+        seg = row.segment(self.shard) if row is not None else None
+        new = (
+            np.zeros(WORDS64, dtype=np.uint64)
+            if seg is None
+            else np.asarray(seg).view("<u8").copy()
+        )
+        old = self.rows.get(row_id)
+        changed = old is None or not np.array_equal(old, new)
+        self.rows[row_id] = new
+        self.row_counts[row_id] = int(bitops.popcount_np(new))
+        self.cache.bulk_add(row_id, self.row_counts[row_id])
+        self.cache.invalidate()
+        self._touch(row_id)
+        self.snapshot()
+        return changed
+
     # -- row scans (Rows/GroupBy support, fragment.go rows() :2000-2100) ---
 
     def rows_filtered(
@@ -472,6 +504,14 @@ class Fragment:
                 break
         return out
 
+    def row_iterator(self, wrap: bool, row_ids_filter: Optional[List[int]] = None):
+        """Iterator over rows for GroupBy (fragment.go rowIterator :2101)."""
+        ids = self.row_ids()
+        if row_ids_filter is not None:
+            allowed = set(row_ids_filter)
+            ids = [r for r in ids if r in allowed]
+        return RowIterator(self, ids, wrap)
+
     # -- TopN (fragment.go top :1018-1150) ---------------------------------
 
     def top(
@@ -480,37 +520,94 @@ class Fragment:
         src: Optional[Row] = None,
         row_ids: Optional[List[int]] = None,
         min_threshold: int = 0,
+        filter_name: str = "",
+        filter_values: Optional[list] = None,
+        tanimoto_threshold: int = 0,
     ) -> List[Tuple[int, int]]:
-        """Approximate top rows from the ranked cache; with a src row the
-        candidates are re-scored by intersection count on device."""
-        if row_ids is not None:
+        """fragment.go top :1018-1150, exactly — the candidate walk with its
+        min-heap, threshold early-exits, attribute filter, and Tanimoto
+        window — except the per-candidate Src intersection counts (the
+        reference's hot loop :1089,:1133) are computed for ALL candidates in
+        one batched device popcount kernel up front."""
+        import heapq
+        import math
+
+        if row_ids:
             pairs = [(r, self.row_count(r)) for r in row_ids]
+            n = 0  # explicit ids: never truncate
         else:
             pairs = list(self.cache.top())
+
+        filters = set(filter_values) if (filter_name and filter_values) else None
+
+        src_count = 0
+        min_tan = max_tan = 0.0
+        if tanimoto_threshold > 0 and src is not None:
+            src_count = src.count()
+            min_tan = src_count * tanimoto_threshold / 100.0
+            max_tan = src_count * 100.0 / tanimoto_threshold
+
+        # Batched device scoring of every candidate against src.
+        src_counts: Dict[int, int] = {}
         if src is not None:
             seg = src.segment(self.shard)
-            if seg is None:
-                return []
-            candidates = [r for r, _ in pairs]
-            if not candidates:
-                return []
-            mat, idx = self.device_matrix()
-            rows_present = [r for r in candidates if r in idx]
-            if rows_present:
+            _, idx = self.device_matrix()
+            present = [r for r, _ in pairs if r in idx]
+            if seg is not None and present:
                 import jax.numpy as jnp
 
                 sel = self._dev_matrix[
-                    np.array([idx[r] for r in rows_present], dtype=np.int32)
+                    np.array([idx[r] for r in present], dtype=np.int32)
                 ]
-                counts = np.asarray(bitops.popcount_and_rows(sel, jnp.asarray(seg)))
-                pairs = list(zip(rows_present, counts.tolist()))
-            else:
-                pairs = []
-        pairs = [(r, c) for r, c in pairs if c > min_threshold and c > 0]
-        pairs.sort(key=cache_mod.pair_sort_key)
-        if n:
-            pairs = pairs[:n]
-        return pairs
+                counts = np.asarray(
+                    bitops.popcount_and_rows(sel, jnp.asarray(seg))
+                )
+                src_counts = dict(zip(present, counts.tolist()))
+
+        # heap of (count, id): smallest count on top (pairHeap is a min-heap).
+        heap: List[Tuple[int, int]] = []
+        for row_id, cnt in pairs:
+            if cnt <= 0:
+                continue
+            if tanimoto_threshold > 0:
+                if cnt <= min_tan or cnt >= max_tan:
+                    continue
+            elif cnt < min_threshold:
+                continue
+            if filters is not None:
+                if self.row_attr_store is None:
+                    continue
+                attr = self.row_attr_store.attrs(row_id)
+                val = attr.get(filter_name)
+                if val is None or val not in filters:
+                    continue
+
+            if n == 0 or len(heap) < n:
+                count = src_counts.get(row_id, 0) if src is not None else cnt
+                if count == 0:
+                    continue
+                if tanimoto_threshold > 0:
+                    tan = math.ceil(count * 100 / (cnt + src_count - count))
+                    if tan <= tanimoto_threshold:
+                        continue
+                elif count < min_threshold:
+                    continue
+                heapq.heappush(heap, (count, row_id))
+                if n > 0 and len(heap) == n and src is None:
+                    break
+                continue
+
+            threshold = heap[0][0]
+            if threshold < min_threshold or cnt < threshold:
+                break
+            count = src_counts.get(row_id, 0)
+            if count < threshold:
+                continue
+            heapq.heappush(heap, (count, row_id))
+
+        out = [(rid, c) for c, rid in heap]
+        out.sort(key=cache_mod.pair_sort_key)
+        return out
 
     # -- anti-entropy blocks (fragment.go Blocks :1226-1321) ---------------
 
@@ -578,3 +675,30 @@ class Fragment:
             f"Fragment({self.index}/{self.field}/{self.view}/{self.shard}, "
             f"rows={len(self.rows)})"
         )
+
+
+class RowIterator:
+    """Sorted row-ID cursor with optional wraparound (fragment.go:2101-2135)."""
+
+    def __init__(self, frag: Fragment, row_ids: List[int], wrap: bool):
+        self.frag = frag
+        self.row_ids = row_ids
+        self.cur = 0
+        self.wrap = wrap
+
+    def seek(self, row_id: int):
+        import bisect
+
+        self.cur = bisect.bisect_left(self.row_ids, row_id)
+
+    def next(self):
+        """Returns (row, row_id, wrapped); row is None when exhausted."""
+        wrapped = False
+        if self.cur >= len(self.row_ids):
+            if not self.wrap or not self.row_ids:
+                return None, 0, True
+            self.cur = 0
+            wrapped = True
+        row_id = self.row_ids[self.cur]
+        self.cur += 1
+        return self.frag.row(row_id), row_id, wrapped

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 from ..roaring import Bitmap
 from . import cache as cache_mod
+from .attrs import AttrStore
 from .field import Field, FieldOptions
 
 EXISTENCE_FIELD_NAME = "exists"
@@ -36,12 +37,13 @@ class Index:
         self.fields: Dict[str, Field] = {}
         self.cache_debounce = cache_debounce
         self.on_create_shard = on_create_shard
-        self._attr_store_factory = attr_store_factory
-        self.column_attr_store = (
-            attr_store_factory(os.path.join(path, ".data")) if attr_store_factory and path else None
-        )
+        self._attr_store_factory = attr_store_factory or AttrStore
         if path is not None:
             os.makedirs(path, exist_ok=True)
+        # Column attributes (index.go ColumnAttrStore; BoltDB ".data" file).
+        self.column_attr_store = self._attr_store_factory(
+            os.path.join(path, ".data") if path else None
+        )
 
     # -- metadata ----------------------------------------------------------
 
@@ -85,6 +87,8 @@ class Index:
     def close(self):
         for f in self.fields.values():
             f.close()
+        if self.column_attr_store is not None:
+            self.column_attr_store.close()
 
     # -- fields ------------------------------------------------------------
 
@@ -94,13 +98,17 @@ class Index:
         return os.path.join(self.path, name)
 
     def _new_field(self, name: str, options: Optional[FieldOptions] = None) -> Field:
+        field_path = self._field_path(name)
         return Field(
             self.name,
             name,
             options=options,
-            path=self._field_path(name),
+            path=field_path,
             cache_debounce=self.cache_debounce,
             on_create_shard=self.on_create_shard,
+            row_attr_store=self._attr_store_factory(
+                os.path.join(field_path, ".data") if field_path else None
+            ),
         )
 
     def field(self, name: str) -> Optional[Field]:

@@ -32,6 +32,7 @@ class View:
         mutex: bool = False,
         cache_debounce: float = 0.0,
         on_create_shard=None,
+        row_attr_store=None,
     ):
         self.index = index
         self.field = field
@@ -41,6 +42,7 @@ class View:
         self.cache_size = cache_size
         self.mutex = mutex
         self.cache_debounce = cache_debounce
+        self.row_attr_store = row_attr_store
         self.fragments: Dict[int, fragment_mod.Fragment] = {}
         # Callback fired when a shard's fragment first appears — the field
         # broadcasts CreateShardMessage here (view.go:226).
@@ -85,6 +87,7 @@ class View:
                 cache_size=self.cache_size,
                 mutex=self.mutex,
                 cache_debounce=self.cache_debounce,
+                row_attr_store=self.row_attr_store,
             )
             self.fragments[shard] = frag
             if self.on_create_shard is not None:

@@ -1,0 +1,19 @@
+from .executor import (
+    ExecOptions,
+    Executor,
+    FieldRow,
+    GroupCount,
+    QueryResponse,
+    RowIdentifiers,
+    ValCount,
+)
+
+__all__ = [
+    "ExecOptions",
+    "Executor",
+    "FieldRow",
+    "GroupCount",
+    "QueryResponse",
+    "RowIdentifiers",
+    "ValCount",
+]

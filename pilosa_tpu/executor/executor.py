@@ -1,0 +1,1197 @@
+"""The query engine: PQL call dispatch + per-shard kernels + shard reduce.
+
+Re-design of the reference's executor (executor.go:84-2890) for TPU:
+
+- Per-call dispatch mirrors executeCall (executor.go:256-295).
+- Per-shard work runs as device kernels over the fragment's dense HBM
+  matrix (ops.bitops / ops.bsi) instead of roaring container loops.
+- ``map_reduce`` is the seam the cluster layer plugs into: shards are
+  grouped by owning node (single-node: all local), local shards execute
+  as batched device work, remote nodes receive the serialized call
+  (executor.go mapReduce :2183-2321).
+
+Results use the same shapes as the reference: Row for bitmap calls,
+ValCount for Sum/Min/Max, (id, count) pair lists for TopN, RowIdentifiers
+for Rows, GroupCount list for GroupBy, bool for mutations.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import ops, pql
+from ..core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_SET, FIELD_TYPE_TIME
+from ..core.fragment import SHARD_WIDTH
+from ..core import cache as cache_mod
+from ..core import timequantum
+from ..core.row import Row
+from ..core.view import VIEW_STANDARD, view_bsi_name
+from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition, Query
+
+TIME_FORMAT = "%Y-%m-%dT%H:%M"  # pilosa.TimeFormat
+
+DEFAULT_MIN_THRESHOLD = 1
+DEFAULT_FIELD = "general"
+DEFAULT_MAX_WRITES_PER_REQUEST = 5000
+
+
+class Error(Exception):
+    pass
+
+
+class IndexNotFoundError(Error):
+    pass
+
+
+class FieldNotFoundError(Error):
+    pass
+
+
+class ExecOptions:
+    """executor.go execOptions."""
+
+    __slots__ = ("remote", "exclude_row_attrs", "exclude_columns", "column_attrs")
+
+    def __init__(
+        self,
+        remote: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+        column_attrs: bool = False,
+    ):
+        self.remote = remote
+        self.exclude_row_attrs = exclude_row_attrs
+        self.exclude_columns = exclude_columns
+        self.column_attrs = column_attrs
+
+    def copy(self) -> "ExecOptions":
+        return ExecOptions(
+            self.remote,
+            self.exclude_row_attrs,
+            self.exclude_columns,
+            self.column_attrs,
+        )
+
+
+class ValCount:
+    """Sum/Min/Max result (executor.go ValCount :2652-2696)."""
+
+    __slots__ = ("val", "count")
+
+    def __init__(self, val: int = 0, count: int = 0):
+        self.val = val
+        self.count = count
+
+    def add(self, other: "ValCount") -> "ValCount":
+        return ValCount(self.val + other.val, self.count + other.count)
+
+    def smaller(self, other: "ValCount") -> "ValCount":
+        if self.count == 0 or (other.val < self.val and other.count > 0):
+            return other
+        return ValCount(self.val, self.count)
+
+    def larger(self, other: "ValCount") -> "ValCount":
+        if self.count == 0 or (other.val > self.val and other.count > 0):
+            return other
+        return ValCount(self.val, self.count)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ValCount)
+            and self.val == other.val
+            and self.count == other.count
+        )
+
+    def __repr__(self):
+        return f"ValCount(val={self.val}, count={self.count})"
+
+    def to_dict(self):
+        return {"value": self.val, "count": self.count}
+
+
+class FieldRow:
+    """One (field, row) of a GroupBy group (executor.go:976-1001)."""
+
+    __slots__ = ("field", "row_id", "row_key")
+
+    def __init__(self, field: str, row_id: int = 0, row_key: str = ""):
+        self.field = field
+        self.row_id = row_id
+        self.row_key = row_key
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, FieldRow)
+            and self.field == other.field
+            and self.row_id == other.row_id
+            and self.row_key == other.row_key
+        )
+
+    def __repr__(self):
+        return f"FieldRow({self.field}.{self.row_key or self.row_id})"
+
+    def to_dict(self):
+        if self.row_key:
+            return {"field": self.field, "rowKey": self.row_key}
+        return {"field": self.field, "rowID": self.row_id}
+
+
+class GroupCount:
+    __slots__ = ("group", "count")
+
+    def __init__(self, group: List[FieldRow], count: int):
+        self.group = group
+        self.count = count
+
+    def compare(self, other: "GroupCount") -> int:
+        """Order by row ids, field-major (executor.go Compare :1043)."""
+        for a, b in zip(self.group, other.group):
+            if a.row_id < b.row_id:
+                return -1
+            if a.row_id > b.row_id:
+                return 1
+        return 0
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, GroupCount)
+            and self.group == other.group
+            and self.count == other.count
+        )
+
+    def __repr__(self):
+        return f"GroupCount({self.group}, count={self.count})"
+
+    def to_dict(self):
+        return {"group": [g.to_dict() for g in self.group], "count": self.count}
+
+
+class RowIdentifiers:
+    """Rows() result (executor.go:822-827)."""
+
+    __slots__ = ("rows", "keys")
+
+    def __init__(self, rows: List[int], keys: Optional[List[str]] = None):
+        self.rows = rows
+        self.keys = keys or []
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, RowIdentifiers)
+            and self.rows == other.rows
+            and self.keys == other.keys
+        )
+
+    def __repr__(self):
+        return f"RowIdentifiers(rows={self.rows}, keys={self.keys})"
+
+    def to_dict(self):
+        d = {"rows": self.rows}
+        if self.keys:
+            d["keys"] = self.keys
+        return d
+
+
+class ColumnAttrSet:
+    __slots__ = ("id", "key", "attrs")
+
+    def __init__(self, id: int, attrs: dict, key: str = ""):
+        self.id = id
+        self.attrs = attrs
+        self.key = key
+
+    def to_dict(self):
+        d = {"id": self.id, "attrs": self.attrs}
+        if self.key:
+            d = {"key": self.key, "attrs": self.attrs}
+        return d
+
+
+class QueryResponse:
+    __slots__ = ("results", "column_attr_sets")
+
+    def __init__(self, results=None, column_attr_sets=None):
+        self.results = results if results is not None else []
+        self.column_attr_sets = column_attr_sets
+
+
+def _merge_row_ids(a: List[int], b: List[int], limit: int) -> List[int]:
+    """Sorted-unique merge with limit (executor.go RowIDs.merge :833)."""
+    out: List[int] = []
+    i = j = 0
+    while i < len(a) and j < len(b) and len(out) < limit:
+        if a[i] < b[j]:
+            out.append(a[i])
+            i += 1
+        elif a[i] > b[j]:
+            out.append(b[j])
+            j += 1
+        else:
+            out.append(a[i])
+            i += 1
+            j += 1
+    while i < len(a) and len(out) < limit:
+        out.append(a[i])
+        i += 1
+    while j < len(b) and len(out) < limit:
+        out.append(b[j])
+        j += 1
+    return out
+
+
+def _merge_group_counts(
+    a: List[GroupCount], b: List[GroupCount], limit: int
+) -> List[GroupCount]:
+    """executor.go mergeGroupCounts :1013."""
+    limit = min(limit, len(a) + len(b))
+    out: List[GroupCount] = []
+    i = j = 0
+    while i < len(a) and j < len(b) and len(out) < limit:
+        c = a[i].compare(b[j])
+        if c < 0:
+            out.append(a[i])
+            i += 1
+        elif c == 0:
+            a[i].count += b[j].count
+            out.append(a[i])
+            i += 1
+            j += 1
+        else:
+            out.append(b[j])
+            j += 1
+    while i < len(a) and len(out) < limit:
+        out.append(a[i])
+        i += 1
+    while j < len(b) and len(out) < limit:
+        out.append(b[j])
+        j += 1
+    return out
+
+
+_MAXINT = (1 << 63) - 1
+
+
+class Executor:
+    """Single-node query executor; the cluster layer overrides ``_mapper``
+    routing (executor.go:34-60)."""
+
+    def __init__(
+        self,
+        holder,
+        cluster=None,
+        node=None,
+        client=None,
+        translator=None,
+        max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST,
+        stats=None,
+        tracer=None,
+    ):
+        self.holder = holder
+        self.cluster = cluster
+        self.node = node
+        self.client = client
+        self.translator = translator
+        self.max_writes_per_request = max_writes_per_request
+        from ..util.stats import NopStatsClient
+        from ..util.tracing import NopTracer
+
+        self.stats = stats if stats is not None else NopStatsClient()
+        self.tracer = tracer if tracer is not None else NopTracer()
+
+    # -- entry point (executor.go Execute :84) -----------------------------
+
+    def execute(
+        self,
+        index: str,
+        query,
+        shards: Optional[List[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> QueryResponse:
+        with self.tracer.start_span("executor.Execute", index=index):
+            return self._execute_outer(index, query, shards, opt)
+
+    def _execute_outer(self, index, query, shards, opt):
+        if not index:
+            raise Error("index required")
+        if isinstance(query, str):
+            query = pql.parse(query)
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        if (
+            self.max_writes_per_request > 0
+            and query.write_call_n() > self.max_writes_per_request
+        ):
+            raise Error("too many writes in a single request")
+        opt = opt or ExecOptions()
+
+        if not opt.remote and self.translator is not None:
+            self.translator.translate_calls(index, idx, query.calls)
+
+        results = self._execute(index, query, shards, opt)
+        resp = QueryResponse(results)
+
+        if opt.column_attrs:
+            ids: List[int] = []
+            for r in results:
+                if isinstance(r, Row):
+                    ids = _merge_row_ids(ids, r.columns().tolist(), _MAXINT)
+            sets = []
+            for cid in ids:
+                attrs = idx.column_attr_store.attrs(cid)
+                if attrs:
+                    sets.append(ColumnAttrSet(cid, attrs))
+            if self.translator is not None and idx.keys:
+                for col in sets:
+                    col.key = self.translator.translate_column_to_string(
+                        index, col.id
+                    )
+                    col.id = 0
+            resp.column_attr_sets = sets
+
+        if not opt.remote and self.translator is not None:
+            self.translator.translate_results(index, idx, query.calls, results)
+        return resp
+
+    def _execute(self, index, query: Query, shards, opt) -> list:
+        needs = any(
+            c.name not in ("Set", "Clear", "SetRowAttrs", "SetColumnAttrs")
+            for c in query.calls
+        )
+        if not shards and needs:
+            idx = self.holder.index(index)
+            shards = [int(s) for s in idx.available_shards()]
+            if not shards:
+                shards = [0]
+
+        # Bulk SetRowAttrs optimization (executor.go:146-149,1995).
+        if query.calls and all(c.name == "SetRowAttrs" for c in query.calls):
+            return self._execute_bulk_set_row_attrs(index, query.calls, opt)
+
+        return [self._execute_call(index, c, shards, opt) for c in query.calls]
+
+    # -- dispatch (executor.go executeCall :245-295) -----------------------
+
+    def _execute_call(self, index: str, c: Call, shards, opt):
+        with self.tracer.start_span(f"executor.{c.name}", index=index):
+            return self._dispatch_call(index, c, shards, opt)
+
+    def _dispatch_call(self, index: str, c: Call, shards, opt):
+        self._validate_call_args(c)
+        name = c.name
+        self.stats.count(name, 1, tags=[f"index:{index}"])
+        if name == "Sum":
+            return self._execute_sum(index, c, shards, opt)
+        if name == "Min":
+            return self._execute_min(index, c, shards, opt)
+        if name == "Max":
+            return self._execute_max(index, c, shards, opt)
+        if name == "Clear":
+            return self._execute_clear_bit(index, c, opt)
+        if name == "ClearRow":
+            return self._execute_clear_row(index, c, shards, opt)
+        if name == "Store":
+            return self._execute_set_row(index, c, shards, opt)
+        if name == "Count":
+            return self._execute_count(index, c, shards, opt)
+        if name == "Set":
+            return self._execute_set(index, c, opt)
+        if name == "SetRowAttrs":
+            self._execute_set_row_attrs(index, c, opt)
+            return None
+        if name == "SetColumnAttrs":
+            self._execute_set_column_attrs(index, c, opt)
+            return None
+        if name == "TopN":
+            return self._execute_topn(index, c, shards, opt)
+        if name == "Rows":
+            return self._execute_rows(index, c, shards, opt)
+        if name == "GroupBy":
+            return self._execute_group_by(index, c, shards, opt)
+        if name == "Options":
+            return self._execute_options_call(index, c, shards, opt)
+        return self._execute_bitmap_call(index, c, shards, opt)
+
+    def _validate_call_args(self, c: Call):
+        ids = c.args.get("ids")
+        if ids is not None and not isinstance(ids, list):
+            raise Error("ids must be a list")
+
+    # -- map/reduce over shards (executor.go mapReduce :2183) --------------
+
+    def map_reduce(self, index, shards, call, opt, map_fn, reduce_fn):
+        """Per-shard map + pairwise reduce.  Single-node: every shard is
+        local.  The cluster layer (stage 6) overrides node routing by
+        passing a sharded client; reduce order is shard-ascending so
+        non-commutative merges behave like the reference's channel drain."""
+        result = None
+        first = True
+        for shard in shards:
+            v = map_fn(shard)
+            if first:
+                result = reduce_fn(None, v)
+                first = False
+            else:
+                result = reduce_fn(result, v)
+        return result
+
+    # -- bitmap calls ------------------------------------------------------
+
+    def _execute_bitmap_call(self, index, c, shards, opt) -> Row:
+        def map_fn(shard):
+            return self._execute_bitmap_call_shard(index, c, shard)
+
+        def reduce_fn(prev, v):
+            if prev is None:
+                prev = Row()
+            prev.merge(v)
+            return prev
+
+        row = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn)
+        if row is None:
+            row = Row()
+
+        # Attach row attributes for Row() (executor.go:491-530).
+        if c.name == "Row":
+            if opt.exclude_row_attrs:
+                row.attrs = {}
+            else:
+                idx = self.holder.index(index)
+                if idx is not None:
+                    field_name = c.field_arg()
+                    fld = idx.field(field_name)
+                    if fld is not None and fld.row_attr_store is not None:
+                        row_id, ok = c.uint_arg(field_name)
+                        if ok:
+                            row.attrs = fld.row_attr_store.attrs(row_id)
+        if opt.exclude_columns:
+            row.segments = {}
+        return row
+
+    def _execute_bitmap_call_shard(self, index, c: Call, shard: int) -> Row:
+        name = c.name
+        if name == "Row":
+            return self._execute_row_shard(index, c, shard)
+        if name == "Difference":
+            return self._execute_nary_shard(index, c, shard, "difference")
+        if name == "Intersect":
+            return self._execute_nary_shard(index, c, shard, "intersect")
+        if name == "Range":
+            return self._execute_range_shard(index, c, shard)
+        if name == "Union":
+            return self._execute_nary_shard(index, c, shard, "union", empty_ok=True)
+        if name == "Xor":
+            return self._execute_nary_shard(index, c, shard, "xor", empty_ok=True)
+        if name == "Not":
+            return self._execute_not_shard(index, c, shard)
+        raise Error(f"unknown call: {name}")
+
+    def _execute_row_shard(self, index, c: Call, shard: int) -> Row:
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        field_name = c.field_arg()
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise Error("Row() must specify a row")
+        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            return Row()
+        return frag.row(row_id)
+
+    def _execute_nary_shard(
+        self, index, c: Call, shard: int, op: str, empty_ok: bool = False
+    ) -> Row:
+        if not c.children and not empty_ok:
+            raise Error(f"empty {c.name} query is currently not supported")
+        other = Row()
+        for i, child in enumerate(c.children):
+            row = self._execute_bitmap_call_shard(index, child, shard)
+            if i == 0:
+                other = row
+            else:
+                other = getattr(other, op)(row)
+        return other
+
+    def _execute_not_shard(self, index, c: Call, shard: int) -> Row:
+        if len(c.children) != 1:
+            raise Error("Not() requires a single input row")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        if idx.existence_field() is None:
+            raise Error(f"index does not support existence tracking: {index}")
+        from ..core.index import EXISTENCE_FIELD_NAME
+
+        frag = self.holder.fragment(index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, shard)
+        existence = frag.row(0) if frag is not None else Row()
+        row = self._execute_bitmap_call_shard(index, c.children[0], shard)
+        return existence.difference(row)
+
+    # -- Range (executor.go :1233-1440) ------------------------------------
+
+    def _execute_range_shard(self, index, c: Call, shard: int) -> Row:
+        if c.has_condition_arg():
+            return self._execute_bsi_range_shard(index, c, shard)
+
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise Error("Range() must specify a row")
+        start_str = c.args.get("_start")
+        end_str = c.args.get("_end")
+        if not isinstance(start_str, str):
+            raise Error("Range() start time required")
+        if not isinstance(end_str, str):
+            raise Error("Range() end time required")
+        try:
+            start = dt.datetime.strptime(start_str, TIME_FORMAT)
+            end = dt.datetime.strptime(end_str, TIME_FORMAT)
+        except ValueError:
+            raise Error("cannot parse Range() time")
+        q = f.time_quantum()
+        if not q:
+            return Row()
+        row = Row()
+        for view_name in timequantum.views_by_time_range(
+            VIEW_STANDARD, start, end, q
+        ):
+            frag = self.holder.fragment(index, field_name, view_name, shard)
+            if frag is None:
+                continue
+            row = row.union(frag.row(row_id))
+        return row
+
+    def _execute_bsi_range_shard(self, index, c: Call, shard: int) -> Row:
+        if len(c.args) == 0:
+            raise Error("Range(): condition required")
+        if len(c.args) > 1:
+            raise Error("Range(): too many arguments")
+        (field_name, cond), = c.args.items()
+        if not isinstance(cond, Condition):
+            raise Error(f"Range(): {field_name}: expected condition argument")
+        f = self.holder_field(index, field_name)
+        bsig = f.bsi_group(field_name)
+        if bsig is None:
+            raise Error(f"field not found: {field_name}")
+        frag = self.holder.fragment(
+            index, field_name, view_bsi_name(field_name), shard
+        )
+        if frag is None:
+            return Row()
+
+        import jax.numpy as jnp
+
+        from ..ops import bsi as bsi_ops
+
+        depth = bsig.bit_depth()
+        planes = frag.device_planes(depth)
+
+        def wrap(words):
+            return Row({shard: words})
+
+        if cond.op == NEQ and cond.value is None:
+            # `!= null` (executor.go:1355-1369)
+            return wrap(bsi_ops.not_null(planes))
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            if len(predicates) != 2:
+                raise Error(
+                    "Range(): BETWEEN condition requires exactly two integer values"
+                )
+            lo, hi, out_of_range = bsig.base_value_between(*predicates)
+            if out_of_range:
+                return Row()
+            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
+                return wrap(bsi_ops.not_null(planes))
+            return wrap(
+                bsi_ops.range_between(
+                    planes,
+                    jnp.asarray(bsi_ops.to_bits(lo, depth)),
+                    jnp.asarray(bsi_ops.to_bits(hi, depth)),
+                )
+            )
+
+        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
+            raise Error("Range(): conditions only support integer values")
+        value = cond.value
+        base, out_of_range = bsig.base_value(cond.op, value)
+        if out_of_range and cond.op != NEQ:
+            return Row()
+        # Whole-range LT/GT collapse to the not-null row (executor.go:1420).
+        if (
+            (cond.op == LT and value > bsig.max)
+            or (cond.op == LTE and value >= bsig.max)
+            or (cond.op == GT and value < bsig.min)
+            or (cond.op == GTE and value <= bsig.min)
+        ):
+            return wrap(bsi_ops.not_null(planes))
+        if out_of_range and cond.op == NEQ:
+            return wrap(bsi_ops.not_null(planes))
+
+        bits = jnp.asarray(bsi_ops.to_bits(base, depth))
+        if cond.op == EQ:
+            return wrap(bsi_ops.range_eq(planes, bits))
+        if cond.op == NEQ:
+            return wrap(bsi_ops.range_neq(planes, bits))
+        if cond.op in (LT, LTE):
+            return wrap(bsi_ops.range_lt(planes, bits, cond.op == LTE))
+        if cond.op in (GT, GTE):
+            return wrap(bsi_ops.range_gt(planes, bits, cond.op == GTE))
+        raise Error(f"Range(): unsupported operator {cond.op}")
+
+    def holder_field(self, index: str, field_name: str):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+        return f
+
+    # -- Count / Sum / Min / Max -------------------------------------------
+
+    def _execute_count(self, index, c: Call, shards, opt) -> int:
+        if len(c.children) != 1:
+            raise Error("Count() requires a single bitmap input")
+
+        def map_fn(shard):
+            row = self._execute_bitmap_call_shard(index, c.children[0], shard)
+            return row.count()
+
+        result = self.map_reduce(
+            index, shards, c, opt, map_fn, lambda p, v: (p or 0) + v
+        )
+        return result or 0
+
+    def _bsi_shard_ctx(self, index, c: Call, shard: int):
+        """(fragment, bsig, filter_words) for Sum/Min/Max shard kernels."""
+        field_name = c.args.get("field")
+        if not field_name:
+            raise Error(f"{c.name}(): field required")
+        if len(c.children) > 1:
+            raise Error(f"{c.name}() only accepts a single bitmap input")
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx is not None else None
+        if f is None:
+            return None
+        bsig = f.bsi_group(field_name)
+        if bsig is None:
+            return None
+        frag = self.holder.fragment(
+            index, field_name, view_bsi_name(field_name), shard
+        )
+        if frag is None:
+            return None
+        import jax.numpy as jnp
+
+        from ..ops import bitops
+
+        if c.children:
+            filt = self._execute_bitmap_call_shard(index, c.children[0], shard)
+            seg = filt.segment(shard)
+            words = (
+                jnp.zeros(bitops.WORDS, dtype=jnp.uint32)
+                if seg is None
+                else jnp.asarray(seg)
+            )
+        else:
+            words = jnp.full(bitops.WORDS, 0xFFFFFFFF, dtype=jnp.uint32)
+        return frag, bsig, words
+
+    def _execute_sum(self, index, c: Call, shards, opt) -> ValCount:
+        from ..ops import bsi as bsi_ops
+
+        def map_fn(shard):
+            ctx = self._bsi_shard_ctx(index, c, shard)
+            if ctx is None:
+                return ValCount()
+            frag, bsig, filt = ctx
+            depth = bsig.bit_depth()
+            counts, n = bsi_ops.sum_counts(frag.device_planes(depth), filt)
+            counts = np.asarray(counts)
+            total = sum(int(counts[i]) << i for i in range(depth))
+            n = int(n)
+            return ValCount(total + n * bsig.min, n)
+
+        result = self.map_reduce(
+            index, shards, c, opt, map_fn, lambda p, v: (p or ValCount()).add(v)
+        )
+        result = result or ValCount()
+        return ValCount() if result.count == 0 else result
+
+    def _execute_min_max(self, index, c: Call, shards, opt, is_min: bool) -> ValCount:
+        from ..ops import bsi as bsi_ops
+
+        def map_fn(shard):
+            ctx = self._bsi_shard_ctx(index, c, shard)
+            if ctx is None:
+                return ValCount()
+            frag, bsig, filt = ctx
+            depth = bsig.bit_depth()
+            planes = frag.device_planes(depth)
+            flags, n = (
+                bsi_ops.min_flags(planes, filt)
+                if is_min
+                else bsi_ops.max_flags(planes, filt)
+            )
+            n = int(n)
+            if n == 0:
+                return ValCount()
+            flags = np.asarray(flags)
+            val = sum(1 << i for i in range(depth) if flags[i])
+            return ValCount(val + bsig.min, n)
+
+        def reduce_fn(p, v):
+            p = p or ValCount()
+            return p.smaller(v) if is_min else p.larger(v)
+
+        result = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn)
+        result = result or ValCount()
+        return ValCount() if result.count == 0 else result
+
+    def _execute_min(self, index, c, shards, opt):
+        return self._execute_min_max(index, c, shards, opt, True)
+
+    def _execute_max(self, index, c, shards, opt):
+        return self._execute_min_max(index, c, shards, opt, False)
+
+    # -- TopN (executor.go :694-828) ---------------------------------------
+
+    def _execute_topn(self, index, c: Call, shards, opt) -> List[Tuple[int, int]]:
+        ids_arg, _ = c.uint_slice_arg("ids")
+        n, _ = c.uint_arg("n")
+
+        pairs = self._execute_topn_shards(index, c, shards, opt)
+        if not pairs or ids_arg or opt.remote:
+            return pairs
+
+        # Phase 2: refetch exact counts for the merged candidate ids
+        # (executor.go :715-733).
+        other = c.clone()
+        other.args["ids"] = sorted(r for r, _ in pairs)
+        trimmed = self._execute_topn_shards(index, other, shards, opt)
+        if n and n < len(trimmed):
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _execute_topn_shards(self, index, c, shards, opt):
+        def map_fn(shard):
+            return self._execute_topn_shard(index, c, shard)
+
+        def reduce_fn(prev, v):
+            return cache_mod.merge_pairs([prev or [], v])
+
+        pairs = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
+        pairs.sort(key=cache_mod.pair_sort_key)
+        return pairs
+
+    def _execute_topn_shard(self, index, c: Call, shard: int):
+        field_name = c.args.get("_field") or DEFAULT_FIELD
+        n, _ = c.uint_arg("n")
+        attr_name = c.args.get("attrName", "")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        attr_values = c.args.get("attrValues")
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise Error("Tanimoto Threshold is from 1 to 100 only")
+        src = None
+        if len(c.children) == 1:
+            src = self._execute_bitmap_call_shard(index, c.children[0], shard)
+        elif len(c.children) > 1:
+            raise Error("TopN() can only have one input bitmap")
+        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            return []
+        if min_threshold <= 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+        return frag.top(
+            n=int(n),
+            src=src,
+            row_ids=row_ids or None,
+            min_threshold=min_threshold,
+            filter_name=attr_name,
+            filter_values=attr_values,
+            tanimoto_threshold=tanimoto,
+        )
+
+    # -- Rows / GroupBy (executor.go :897-1170) ----------------------------
+
+    def _execute_rows(self, index, c: Call, shards, opt) -> List[int]:
+        col, ok = c.uint_arg("column")
+        if ok:
+            shards = [col // SHARD_WIDTH]
+        limit_arg, has_limit = c.uint_arg("limit")
+        limit = limit_arg if has_limit else _MAXINT
+
+        def map_fn(shard):
+            return self._execute_rows_shard(index, c, shard)
+
+        def reduce_fn(prev, v):
+            return _merge_row_ids(prev or [], v, limit)
+
+        return self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
+
+    def _execute_rows_shard(self, index, c: Call, shard: int) -> List[int]:
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        field_name = c.args.get("field")
+        if not isinstance(field_name, str):
+            raise Error("Rows() argument required: field")
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            return []
+        previous, has_prev = c.uint_arg("previous")
+        start = previous + 1 if has_prev else 0
+        column = None
+        col, ok = c.uint_arg("column")
+        if ok:
+            if col // SHARD_WIDTH != shard:
+                return []
+            column = col
+        limit_arg, has_limit = c.uint_arg("limit")
+        return frag.rows_filtered(
+            start=start, column=column, limit=limit_arg if has_limit else None
+        )
+
+    def _execute_group_by(self, index, c: Call, shards, opt) -> List[GroupCount]:
+        if not c.children:
+            raise Error("need at least one child call")
+        limit_arg, has_limit = c.uint_arg("limit")
+        limit = limit_arg if has_limit else _MAXINT
+        filter_call = c.call_arg("filter")
+
+        child_rows: List[Optional[List[int]]] = [None] * len(c.children)
+        for i, child in enumerate(c.children):
+            if child.name != "Rows":
+                raise Error(
+                    f"'{child.name}' is not a valid child query for GroupBy, "
+                    "must be 'Rows'"
+                )
+            _, has_lim = child.uint_arg("limit")
+            _, has_col = child.uint_arg("column")
+            if has_lim or has_col:
+                child_rows[i] = self._execute_rows(index, child, shards, opt)
+                if not child_rows[i]:
+                    return []
+
+        def map_fn(shard):
+            return self._execute_group_by_shard(
+                index, c, filter_call, shard, child_rows
+            )
+
+        def reduce_fn(prev, v):
+            return _merge_group_counts(prev or [], v, limit)
+
+        results = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
+
+        offset, has_offset = c.uint_arg("offset")
+        if has_offset and offset < len(results):
+            results = results[offset:]
+        if has_limit and limit < len(results):
+            results = results[:limit]
+        return results
+
+    def _execute_group_by_shard(
+        self, index, c: Call, filter_call, shard, child_rows
+    ) -> List[GroupCount]:
+        filter_row = None
+        if filter_call is not None:
+            filter_row = self._execute_bitmap_call_shard(index, filter_call, shard)
+        iterator = _GroupByIterator.create(
+            self, child_rows, c.children, filter_row, index, shard
+        )
+        if iterator is None:
+            return []
+        limit_arg, has_limit = c.uint_arg("limit")
+        limit = limit_arg if has_limit else _MAXINT
+        results: List[GroupCount] = []
+        while len(results) < limit:
+            gc, done = iterator.next()
+            if done:
+                break
+            if gc.count > 0:
+                results.append(gc)
+        return results
+
+    # -- Options (executor.go :317) ----------------------------------------
+
+    def _execute_options_call(self, index, c: Call, shards, opt):
+        opt_copy = opt.copy()
+        if "columnAttrs" in c.args:
+            v, _ = c.bool_arg("columnAttrs")
+            opt.column_attrs = v  # applies to the whole response
+        if "excludeRowAttrs" in c.args:
+            opt_copy.exclude_row_attrs, _ = c.bool_arg("excludeRowAttrs")
+        if "excludeColumns" in c.args:
+            opt_copy.exclude_columns, _ = c.bool_arg("excludeColumns")
+        if "shards" in c.args:
+            s = c.args["shards"]
+            if not isinstance(s, list) or any(
+                isinstance(x, bool) or not isinstance(x, int) for x in s
+            ):
+                raise Error("Query(): shards must be a list of unsigned integers")
+            shards = [int(x) for x in s]
+        if len(c.children) != 1:
+            raise Error("Options() requires exactly one child call")
+        return self._execute_call(index, c.children[0], shards, opt_copy)
+
+    # -- writes ------------------------------------------------------------
+
+    def _execute_set(self, index, c: Call, opt) -> bool:
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise Error("Set() column argument 'col' required")
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+
+        ef = idx.existence_field()
+        if ef is not None:
+            ef.set_bit(0, col_id)
+
+        if f.options.type == FIELD_TYPE_INT:
+            value, ok = c.int_arg(field_name)
+            if not ok:
+                raise Error("Set() row argument required")
+            return f.set_value(col_id, value)
+
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise Error("Set() row argument required")
+        timestamp = None
+        ts = c.args.get("_timestamp")
+        if isinstance(ts, str):
+            try:
+                timestamp = dt.datetime.strptime(ts, TIME_FORMAT)
+            except ValueError:
+                raise Error(f"invalid date: {ts}")
+        if f.options.type == FIELD_TYPE_BOOL and row_id not in (0, 1):
+            raise Error("bool field rows must be 0 or 1")
+        return f.set_bit(row_id, col_id, timestamp)
+
+    def _execute_clear_bit(self, index, c: Call, opt) -> bool:
+        field_name = c.field_arg()
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise Error("Clear() row argument required")
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise Error("Clear() col argument required")
+        return f.clear_bit(row_id, col_id)
+
+    def _execute_clear_row(self, index, c: Call, shards, opt) -> bool:
+        field_name = c.field_arg()
+        f = self.holder_field(index, field_name)
+        if f.options.type not in (
+            FIELD_TYPE_SET,
+            FIELD_TYPE_TIME,
+            FIELD_TYPE_MUTEX,
+            FIELD_TYPE_BOOL,
+        ):
+            raise Error(
+                f"ClearRow() is not supported on {f.options.type} field types"
+            )
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise Error("ClearRow() row argument required")
+
+        def map_fn(shard):
+            changed = False
+            for view in f.views.values():
+                frag = view.fragment(shard)
+                if frag is not None:
+                    changed |= frag.clear_row(row_id)
+            return changed
+
+        return bool(
+            self.map_reduce(
+                index, shards, c, opt, map_fn, lambda p, v: bool(p) or v
+            )
+        )
+
+    def _execute_set_row(self, index, c: Call, shards, opt) -> bool:
+        field_name = c.field_arg()
+        f = self.holder_field(index, field_name)
+        if f.options.type != FIELD_TYPE_SET:
+            raise Error(
+                f"Store() is not supported on {f.options.type} field types"
+            )
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise Error("Store() row argument required")
+        if len(c.children) != 1:
+            raise Error("Store() requires a source row")
+
+        def map_fn(shard):
+            src = self._execute_bitmap_call_shard(index, c.children[0], shard)
+            view = f.view_if_not_exists(VIEW_STANDARD)
+            frag = view.fragment_if_not_exists(shard)
+            return frag.set_row(src, row_id)
+
+        return bool(
+            self.map_reduce(
+                index, shards, c, opt, map_fn, lambda p, v: bool(p) or v
+            )
+        )
+
+    def _execute_set_row_attrs(self, index, c: Call, opt):
+        field_name = c.args.get("_field")
+        f = self.holder_field(index, field_name)
+        row_id, ok = c.uint_arg("_row")
+        if not ok:
+            raise Error("SetRowAttrs() row field required")
+        attrs = {
+            k: v for k, v in c.args.items() if k not in ("_field", "_row")
+        }
+        f.row_attr_store.set_attrs(row_id, attrs)
+
+    def _execute_bulk_set_row_attrs(self, index, calls: List[Call], opt):
+        by_field: Dict[str, Dict[int, dict]] = {}
+        for c in calls:
+            field_name = c.args.get("_field")
+            f = self.holder_field(index, field_name)
+            row_id, ok = c.uint_arg("_row")
+            if not ok:
+                raise Error("SetRowAttrs() row field required")
+            attrs = {
+                k: v for k, v in c.args.items() if k not in ("_field", "_row")
+            }
+            by_field.setdefault(field_name, {}).setdefault(row_id, {}).update(
+                attrs
+            )
+        for field_name, m in by_field.items():
+            f = self.holder_field(index, field_name)
+            f.row_attr_store.set_bulk_attrs(m)
+        return [None] * len(calls)
+
+    def _execute_set_column_attrs(self, index, c: Call, opt):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        col, ok = c.uint_arg("_col")
+        if not ok:
+            raise Error("SetColumnAttrs() column required")
+        attrs = {
+            k: v for k, v in c.args.items() if k not in ("_col", "field")
+        }
+        idx.column_attr_store.set_attrs(col, attrs)
+
+
+class _GroupByIterator:
+    """Multi-field row-combination walker (executor.go:2726-2890)."""
+
+    def __init__(self):
+        self.row_iters = []
+        self.rows: List[Tuple[Optional[Row], int]] = []
+        self.fields: List[FieldRow] = []
+        self.filter: Optional[Row] = None
+        self.done = False
+
+    @classmethod
+    def create(
+        cls, executor, child_rows, children: List[Call], filter_row, index, shard
+    ) -> Optional["_GroupByIterator"]:
+        gbi = cls()
+        gbi.filter = filter_row
+        gbi.rows = [(None, 0)] * len(children)
+        ignore_prev = False
+        for i, call in enumerate(children):
+            field_name = call.args["field"]
+            gbi.fields.append(FieldRow(field_name))
+            frag = executor.holder.fragment(
+                index, field_name, VIEW_STANDARD, shard
+            )
+            if frag is None:
+                return None
+            it = frag.row_iterator(
+                wrap=(i != 0), row_ids_filter=child_rows[i] or None
+            )
+            gbi.row_iters.append(it)
+            prev, has_prev = call.uint_arg("previous")
+            if has_prev and not ignore_prev:
+                if i == len(children) - 1:
+                    prev += 1
+                it.seek(prev)
+            next_row, row_id, wrapped = it.next()
+            if next_row is None:
+                gbi.done = True
+                return gbi
+            gbi.rows[i] = (next_row, row_id)
+            if has_prev and row_id != prev:
+                ignore_prev = True
+            if wrapped:
+                for j in range(i - 1, -1, -1):
+                    next_row, row_id, w2 = gbi.row_iters[j].next()
+                    if next_row is None:
+                        gbi.done = True
+                        return gbi
+                    gbi.rows[j] = (next_row, row_id)
+                    if not w2:
+                        break
+
+        if gbi.filter is not None and gbi.rows:
+            r, i0 = gbi.rows[0]
+            gbi.rows[0] = (r.intersect(gbi.filter), i0)
+        for i in range(1, len(gbi.rows) - 1):
+            r, rid = gbi.rows[i]
+            gbi.rows[i] = (r.intersect(gbi.rows[i - 1][0]), rid)
+        return gbi
+
+    def _next_at_idx(self, i: int):
+        nr, row_id, wrapped = self.row_iters[i].next()
+        if nr is None:
+            self.done = True
+            return
+        if wrapped and i != 0:
+            self._next_at_idx(i - 1)
+            if self.done:
+                return
+        if i == 0 and self.filter is not None:
+            self.rows[i] = (nr.intersect(self.filter), row_id)
+        elif i == 0 or i == len(self.rows) - 1:
+            self.rows[i] = (nr, row_id)
+        else:
+            self.rows[i] = (nr.intersect(self.rows[i - 1][0]), row_id)
+
+    def next(self) -> Tuple[Optional[GroupCount], bool]:
+        if self.done:
+            return None, True
+        if len(self.rows) == 1:
+            count = self.rows[-1][0].count()
+        else:
+            count = self.rows[-1][0].intersection_count(self.rows[-2][0])
+        group = [
+            FieldRow(f.field, rid)
+            for f, (_, rid) in zip(self.fields, self.rows)
+        ]
+        ret = GroupCount(group, count)
+        self._next_at_idx(len(self.rows) - 1)
+        return ret, False

@@ -1,0 +1,21 @@
+from . import stats, tracing
+from .logger import Logger, NopLogger, StandardLogger, VerboseLogger
+from .stats import ExpvarStatsClient, MultiStatsClient, NopStatsClient, StatsClient
+from .tracing import NopTracer, ProfilerTracer, Span, Tracer
+
+__all__ = [
+    "ExpvarStatsClient",
+    "Logger",
+    "MultiStatsClient",
+    "NopLogger",
+    "NopStatsClient",
+    "NopTracer",
+    "ProfilerTracer",
+    "Span",
+    "StandardLogger",
+    "StatsClient",
+    "Tracer",
+    "VerboseLogger",
+    "stats",
+    "tracing",
+]

@@ -1,0 +1,137 @@
+"""Stats clients: counters/gauges/timings with tag scoping.
+
+Mirror of the reference's StatsClient interface (stats/stats.go:31-66) with
+nop / expvar-style in-memory / multi backends (stats/stats.go:69-283).  A
+statsd backend can be registered by the server layer when a host agent is
+configured (statsd/statsd.go) — network emission is optional and off by
+default.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class StatsClient:
+    """Interface; also usable as a base class."""
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        return self
+
+    def tags(self) -> List[str]:
+        return []
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0, tags=None):
+        pass
+
+    def count_with_custom_tags(self, name, value, rate, tags):
+        self.count(name, value, rate, tags)
+
+    def gauge(self, name: str, value: float, rate: float = 1.0):
+        pass
+
+    def histogram(self, name: str, value: float, rate: float = 1.0):
+        pass
+
+    def set(self, name: str, value: str, rate: float = 1.0):
+        pass
+
+    def timing(self, name: str, value_seconds: float, rate: float = 1.0):
+        pass
+
+    def open(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class NopStatsClient(StatsClient):
+    pass
+
+
+class ExpvarStatsClient(StatsClient):
+    """In-memory, inspectable backend (the reference's expvar client,
+    stats/stats.go:117-214): exposed by the HTTP layer at /debug/vars."""
+
+    def __init__(self, _tags: Optional[List[str]] = None, _root=None):
+        self._tags = _tags or []
+        if _root is None:
+            _root = {"lock": threading.Lock(), "counters": {}, "gauges": {},
+                     "timings": {}, "sets": {}, "children": {}}
+        self._root = _root
+
+    def _scope(self, name: str) -> str:
+        if not self._tags:
+            return name
+        return ",".join(sorted(self._tags)) + ":" + name
+
+    def with_tags(self, *tags: str) -> "ExpvarStatsClient":
+        return ExpvarStatsClient(sorted(set(self._tags) | set(tags)), self._root)
+
+    def tags(self) -> List[str]:
+        return list(self._tags)
+
+    def count(self, name, value: int = 1, rate: float = 1.0, tags=None):
+        key = self._scope(name)
+        if tags:
+            key += "," + ",".join(tags)
+        with self._root["lock"]:
+            self._root["counters"][key] = self._root["counters"].get(key, 0) + value
+
+    def gauge(self, name, value: float, rate: float = 1.0):
+        with self._root["lock"]:
+            self._root["gauges"][self._scope(name)] = value
+
+    def histogram(self, name, value: float, rate: float = 1.0):
+        with self._root["lock"]:
+            self._root["timings"].setdefault(self._scope(name), []).append(value)
+
+    def set(self, name, value: str, rate: float = 1.0):
+        with self._root["lock"]:
+            self._root["sets"][self._scope(name)] = value
+
+    def timing(self, name, value_seconds: float, rate: float = 1.0):
+        self.histogram(name, value_seconds, rate)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._root["lock"]:
+            return {
+                "counters": dict(self._root["counters"]),
+                "gauges": dict(self._root["gauges"]),
+                "sets": dict(self._root["sets"]),
+                "timingCounts": {
+                    k: len(v) for k, v in self._root["timings"].items()
+                },
+            }
+
+
+class MultiStatsClient(StatsClient):
+    """Fan out to several backends (stats/stats.go:217-283)."""
+
+    def __init__(self, clients: List[StatsClient]):
+        self.clients = clients
+
+    def with_tags(self, *tags: str) -> "MultiStatsClient":
+        return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
+
+    def count(self, name, value: int = 1, rate: float = 1.0, tags=None):
+        for c in self.clients:
+            c.count(name, value, rate, tags)
+
+    def gauge(self, name, value: float, rate: float = 1.0):
+        for c in self.clients:
+            c.gauge(name, value, rate)
+
+    def histogram(self, name, value: float, rate: float = 1.0):
+        for c in self.clients:
+            c.histogram(name, value, rate)
+
+    def set(self, name, value: str, rate: float = 1.0):
+        for c in self.clients:
+            c.set(name, value, rate)
+
+    def timing(self, name, value_seconds: float, rate: float = 1.0):
+        for c in self.clients:
+            c.timing(name, value_seconds, rate)

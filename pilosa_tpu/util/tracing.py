@@ -1,0 +1,103 @@
+"""Tracing: spans around every hot path.
+
+Mirror of the reference's global Tracer / Span (tracing/tracing.go:11-66):
+``start_span`` wraps executor calls, per-shard kernels, API methods, and
+syncers.  The ProfilerTracer additionally brackets spans with
+``jax.profiler.TraceAnnotation`` so spans land in XPlane traces — the TPU
+equivalent of the reference's Jaeger adapter
+(tracing/opentracing/opentracing.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class Span:
+    __slots__ = ("name", "tags", "start", "duration", "children", "parent")
+
+    def __init__(self, name: str, tags: Optional[dict] = None, parent=None):
+        self.name = name
+        self.tags = tags or {}
+        self.start = time.monotonic()
+        self.duration = None
+        self.children: List["Span"] = []
+        self.parent = parent
+
+    def set_tag(self, key: str, value):
+        self.tags[key] = value
+
+    def finish(self):
+        self.duration = time.monotonic() - self.start
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "tags": self.tags,
+            "durationMs": None if self.duration is None else self.duration * 1e3,
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+class Tracer:
+    """Collects span trees per thread; cheap enough to keep always-on."""
+
+    def __init__(self, keep_finished: int = 0):
+        self._local = threading.local()
+        self.keep_finished = keep_finished
+        self._finished: List[Span] = []
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def start_span(self, name: str, **tags):
+        parent = getattr(self._local, "current", None)
+        span = Span(name, tags, parent)
+        if parent is not None:
+            parent.children.append(span)
+        self._local.current = span
+        try:
+            yield span
+        finally:
+            span.finish()
+            self._local.current = parent
+            if parent is None and self.keep_finished:
+                with self._lock:
+                    self._finished.append(span)
+                    if len(self._finished) > self.keep_finished:
+                        self._finished.pop(0)
+
+    def finished_spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._finished)
+
+    # HTTP header propagation for cross-node traces
+    # (tracing/tracing.go:18-28).
+    def inject_headers(self, headers: Dict[str, str]):
+        cur = getattr(self._local, "current", None)
+        if cur is not None:
+            headers["X-Trace-Name"] = cur.name
+
+    def extract_headers(self, headers: Dict[str, str]) -> Optional[str]:
+        return headers.get("X-Trace-Name")
+
+
+class NopTracer(Tracer):
+    @contextmanager
+    def start_span(self, name: str, **tags):
+        yield None
+
+
+class ProfilerTracer(Tracer):
+    """Tracer that also emits jax.profiler trace annotations, so spans are
+    visible in XPlane/TensorBoard device traces."""
+
+    @contextmanager
+    def start_span(self, name: str, **tags):
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            with super().start_span(name, **tags) as span:
+                yield span

@@ -1,0 +1,384 @@
+"""Executor behavioral tests — ported cases from the reference's
+executor_test.go (the behavioral spec for every PQL call)."""
+
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor, GroupCount, FieldRow, ValCount
+from pilosa_tpu.ops import SHARD_WIDTH
+
+
+@pytest.fixture
+def holder():
+    h = Holder()
+    h.open()
+    return h
+
+
+@pytest.fixture
+def ex(holder):
+    return Executor(holder)
+
+
+def cols(row):
+    return row.columns().tolist()
+
+
+def q(ex, query, index="i", **kw):
+    return ex.execute(index, query, **kw).results
+
+
+def test_row_and_count(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, f"Set(3, f=10) Set({SHARD_WIDTH+1}, f=10) Set(0, f=11)")
+    (row,) = q(ex, "Row(f=10)")
+    assert cols(row) == [3, SHARD_WIDTH + 1]
+    assert q(ex, "Count(Row(f=10))") == [2]
+
+
+def test_set_returns_changed(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    assert q(ex, "Set(1, f=1)") == [True]
+    assert q(ex, "Set(1, f=1)") == [False]
+
+
+def test_intersect_union_difference_xor(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(
+        ex,
+        f"""
+        Set(1, f=10) Set(2, f=10) Set({SHARD_WIDTH+2}, f=10)
+        Set(1, f=11) Set({SHARD_WIDTH+2}, f=11) Set(5, f=11)
+        """,
+    )
+    (r,) = q(ex, "Intersect(Row(f=10), Row(f=11))")
+    assert cols(r) == [1, SHARD_WIDTH + 2]
+    (r,) = q(ex, "Union(Row(f=10), Row(f=11))")
+    assert cols(r) == [1, 2, 5, SHARD_WIDTH + 2]
+    (r,) = q(ex, "Difference(Row(f=10), Row(f=11))")
+    assert cols(r) == [2]
+    (r,) = q(ex, "Xor(Row(f=10), Row(f=11))")
+    assert cols(r) == [2, 5]
+
+
+def test_empty_union(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, "Set(1, f=10)")
+    (r,) = q(ex, "Union()")
+    assert cols(r) == []
+
+
+def test_not(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, f"Set(1, f=10) Set(2, f=11) Set({SHARD_WIDTH+2}, f=12)")
+    (r,) = q(ex, "Not(Row(f=10))")
+    assert cols(r) == [2, SHARD_WIDTH + 2]
+    (r,) = q(ex, "Not(Union(Row(f=10), Row(f=11), Row(f=12)))")
+    assert cols(r) == []
+
+
+def test_clear(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, "Set(1, f=10) Set(2, f=10)")
+    assert q(ex, "Clear(1, f=10)") == [True]
+    assert q(ex, "Clear(1, f=10)") == [False]
+    (r,) = q(ex, "Row(f=10)")
+    assert cols(r) == [2]
+
+
+def test_clear_row(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, f"Set(1, f=10) Set({SHARD_WIDTH+5}, f=10) Set(2, f=11)")
+    assert q(ex, "ClearRow(f=10)") == [True]
+    (r,) = q(ex, "Row(f=10)")
+    assert cols(r) == []
+    (r,) = q(ex, "Row(f=11)")
+    assert cols(r) == [2]
+
+
+def test_store(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, f"Set(1, f=10) Set({SHARD_WIDTH+5}, f=10)")
+    assert q(ex, "Store(Row(f=10), f=20)") == [True]
+    (r,) = q(ex, "Row(f=20)")
+    assert cols(r) == [1, SHARD_WIDTH + 5]
+    # Store overwrites.
+    q(ex, "Set(3, f=11)")
+    q(ex, "Store(Row(f=11), f=20)")
+    (r,) = q(ex, "Row(f=20)")
+    assert cols(r) == [3]
+
+
+def test_mutex_field(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("m", FieldOptions(type="mutex"))
+    q(ex, "Set(1, m=10)")
+    q(ex, "Set(1, m=11)")
+    (r10,) = q(ex, "Row(m=10)")
+    (r11,) = q(ex, "Row(m=11)")
+    assert cols(r10) == []
+    assert cols(r11) == [1]
+
+
+def test_bool_field(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("b", FieldOptions(type="bool"))
+    q(ex, "Set(1, b=1) Set(2, b=0)")
+    (t,) = q(ex, "Row(b=1)")
+    (f,) = q(ex, "Row(b=0)")
+    assert cols(t) == [1]
+    assert cols(f) == [2]
+    q(ex, "Set(1, b=0)")  # flips via mutex semantics
+    (t,) = q(ex, "Row(b=1)")
+    (f,) = q(ex, "Row(b=0)")
+    assert cols(t) == []
+    assert cols(f) == [1, 2]
+
+
+def test_bsi_range_ops(holder, ex):
+    """The Range test block from executor_test.go:1640-1780."""
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("foo", FieldOptions(type="int", min=10, max=100))
+    idx.create_field("bar", FieldOptions(type="int", min=0, max=100000))
+    idx.create_field("other", FieldOptions(type="int", min=0, max=1000))
+    idx.create_field("edge", FieldOptions(type="int", min=-100, max=100))
+    q(
+        ex,
+        f"""
+        Set(0, f=0)
+        Set({SHARD_WIDTH+1}, f=0)
+        Set(50, foo=20)
+        Set(50, bar=2000)
+        Set({SHARD_WIDTH}, foo=30)
+        Set({SHARD_WIDTH+2}, foo=10)
+        Set({(5*SHARD_WIDTH)+100}, foo=20)
+        Set({SHARD_WIDTH+1}, foo=60)
+        Set(0, other=1000)
+        Set(0, edge=100)
+        Set(1, edge=-100)
+        """,
+    )
+    (r,) = q(ex, "Range(foo == 20)")
+    assert cols(r) == [50, (5 * SHARD_WIDTH) + 100]
+    (r,) = q(ex, "Range(other != null)")
+    assert cols(r) == [0]
+    (r,) = q(ex, "Range(foo != 20)")
+    assert cols(r) == [SHARD_WIDTH, SHARD_WIDTH + 1, SHARD_WIDTH + 2]
+    (r,) = q(ex, "Range(foo < 20)")
+    assert cols(r) == [SHARD_WIDTH + 2]
+    (r,) = q(ex, "Range(foo <= 20)")
+    assert cols(r) == [50, SHARD_WIDTH + 2, (5 * SHARD_WIDTH) + 100]
+    (r,) = q(ex, "Range(foo > 20)")
+    assert cols(r) == [SHARD_WIDTH, SHARD_WIDTH + 1]
+    (r,) = q(ex, "Range(foo >= 20)")
+    assert cols(r) == [50, SHARD_WIDTH, SHARD_WIDTH + 1, (5 * SHARD_WIDTH) + 100]
+    (r,) = q(ex, "Range(0 < other < 1000)")
+    assert cols(r) == [0]
+    (r,) = q(ex, "Range(-1 < other < 1000)")  # NotNull fast path
+    assert cols(r) == [0]
+    (r,) = q(ex, "Range(foo == 0)")  # below min
+    assert cols(r) == []
+    (r,) = q(ex, "Range(foo == 200)")  # above max
+    assert cols(r) == []
+    (r,) = q(ex, "Range(edge < 200)")  # LT above max -> notNull
+    assert cols(r) == [0, 1]
+    (r,) = q(ex, "Range(edge > -200)")  # GT below min -> notNull
+    assert cols(r) == [0, 1]
+    from pilosa_tpu.executor.executor import FieldNotFoundError
+
+    with pytest.raises(FieldNotFoundError):
+        q(ex, "Range(bad_field >= 20)")
+
+
+def test_sum_min_max(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("x")
+    idx.create_field("foo", FieldOptions(type="int", min=-100, max=2000))
+    q(
+        ex,
+        f"""
+        Set(0, x=0) Set({SHARD_WIDTH}, x=0)
+        Set(0, foo=20) Set({SHARD_WIDTH}, foo=-5) Set(2, foo=1000)
+        """,
+    )
+    assert q(ex, "Sum(field=foo)") == [ValCount(1015, 3)]
+    assert q(ex, "Min(field=foo)") == [ValCount(-5, 1)]
+    assert q(ex, "Max(field=foo)") == [ValCount(1000, 1)]
+    # Filtered by a row.
+    assert q(ex, "Sum(Row(x=0), field=foo)") == [ValCount(15, 2)]
+    assert q(ex, "Min(Row(x=0), field=foo)") == [ValCount(-5, 1)]
+    assert q(ex, "Max(Row(x=0), field=foo)") == [ValCount(20, 1)]
+
+
+def test_sum_empty(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("foo", FieldOptions(type="int", min=0, max=100))
+    assert q(ex, "Sum(field=foo)") == [ValCount(0, 0)]
+
+
+def test_topn(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    # row 10 -> 3 cols, row 11 -> 2, row 12 -> 1
+    q(
+        ex,
+        f"""
+        Set(0, f=10) Set(1, f=10) Set({SHARD_WIDTH}, f=10)
+        Set(0, f=11) Set(2, f=11)
+        Set(3, f=12)
+        """,
+    )
+    assert q(ex, "TopN(f, n=2)") == [[(10, 3), (11, 2)]]
+    assert q(ex, "TopN(f)") == [[(10, 3), (11, 2), (12, 1)]]
+    # explicit ids
+    assert q(ex, "TopN(f, ids=[11,12])") == [[(11, 2), (12, 1)]]
+    # src intersection
+    assert q(ex, "TopN(f, Row(f=11), n=5)") == [[(11, 2), (10, 1)]]
+
+
+def test_topn_attr_filter(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, "Set(0, f=1) Set(1, f=1) Set(0, f=2)")
+    q(ex, 'SetRowAttrs(f, 1, category="a") SetRowAttrs(f, 2, category="b")')
+    assert q(ex, 'TopN(f, n=5, attrName="category", attrValues=["a"])') == [
+        [(1, 2)]
+    ]
+
+
+def test_time_range(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f", FieldOptions(type="time", time_quantum="YMDH"))
+    q(ex, "Set(1, f=10, 2018-01-01T00:00)")
+    q(ex, "Set(2, f=10, 2018-02-01T00:00)")
+    q(ex, "Set(3, f=10, 2019-01-01T00:00)")
+    (r,) = q(ex, "Range(f=10, 2018-01-01T00:00, 2018-03-01T00:00)")
+    assert cols(r) == [1, 2]
+    (r,) = q(ex, "Range(f=10, 2018-01-01T00:00, 2020-01-01T00:00)")
+    assert cols(r) == [1, 2, 3]
+    # Standard view still answers Row().
+    (r,) = q(ex, "Row(f=10)")
+    assert cols(r) == [1, 2, 3]
+
+
+def test_rows(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, f"Set(0, f=1) Set(1, f=2) Set({SHARD_WIDTH}, f=5) Set(2, f=9)")
+    assert q(ex, "Rows(field=f)") == [[1, 2, 5, 9]]
+    assert q(ex, "Rows(field=f, previous=2)") == [[5, 9]]
+    assert q(ex, "Rows(field=f, limit=2)") == [[1, 2]]
+    assert q(ex, "Rows(field=f, column=1)") == [[2]]
+
+
+def test_group_by(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("a")
+    idx.create_field("b")
+    q(
+        ex,
+        """
+        Set(0, a=1) Set(1, a=1) Set(2, a=2)
+        Set(0, b=10) Set(1, b=11) Set(2, b=10)
+        """,
+    )
+    (res,) = q(ex, "GroupBy(Rows(field=a), Rows(field=b))")
+    assert res == [
+        GroupCount([FieldRow("a", 1), FieldRow("b", 10)], 1),
+        GroupCount([FieldRow("a", 1), FieldRow("b", 11)], 1),
+        GroupCount([FieldRow("a", 2), FieldRow("b", 10)], 1),
+    ]
+    (res,) = q(ex, "GroupBy(Rows(field=a), Rows(field=b), filter=Row(b=10))")
+    assert res == [
+        GroupCount([FieldRow("a", 1), FieldRow("b", 10)], 1),
+        GroupCount([FieldRow("a", 2), FieldRow("b", 10)], 1),
+    ]
+    (res,) = q(ex, "GroupBy(Rows(field=a), limit=1)")
+    assert res == [GroupCount([FieldRow("a", 1)], 2)]
+
+
+def test_group_by_multi_shard(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("a")
+    q(ex, f"Set(0, a=1) Set({SHARD_WIDTH}, a=1) Set({SHARD_WIDTH+1}, a=2)")
+    (res,) = q(ex, "GroupBy(Rows(field=a))")
+    assert res == [
+        GroupCount([FieldRow("a", 1)], 2),
+        GroupCount([FieldRow("a", 2)], 1),
+    ]
+
+
+def test_options_exclude_columns(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, "Set(1, f=10)")
+    (r,) = q(ex, "Options(Row(f=10), excludeColumns=true)")
+    assert cols(r) == []
+
+
+def test_options_shards(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, f"Set(1, f=10) Set({SHARD_WIDTH+1}, f=10) Set({2*SHARD_WIDTH+1}, f=10)")
+    (r,) = q(ex, "Options(Row(f=10), shards=[0, 2])")
+    assert cols(r) == [1, 2 * SHARD_WIDTH + 1]
+
+
+def test_row_attrs_attached(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, "Set(1, f=10)")
+    q(ex, 'SetRowAttrs(f, 10, foo="bar")')
+    (r,) = q(ex, "Row(f=10)")
+    assert r.attrs == {"foo": "bar"}
+    (r,) = q(ex, "Options(Row(f=10), excludeRowAttrs=true)")
+    assert r.attrs == {}
+
+
+def test_column_attrs(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    q(ex, "Set(1, f=10)")
+    q(ex, 'SetColumnAttrs(1, kind="vip")')
+    resp = ex.execute("i", "Options(Row(f=10), columnAttrs=true)")
+    assert resp.column_attr_sets is not None
+    assert resp.column_attr_sets[0].id == 1
+    assert resp.column_attr_sets[0].attrs == {"kind": "vip"}
+
+
+def test_existence_tracked_on_set(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=10))
+    q(ex, "Set(1, f=10) Set(9, v=3)")
+    (r,) = q(ex, "Not(Row(f=99))")
+    assert cols(r) == [1, 9]
+
+
+def test_set_value_and_requery(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=100))
+    q(ex, "Set(1, v=33)")
+    q(ex, "Set(1, v=7)")  # overwrite
+    assert q(ex, "Sum(field=v)") == [ValCount(7, 1)]
+
+
+def test_too_many_writes(holder):
+    h = holder
+    idx = h.create_index("i")
+    idx.create_field("f")
+    e = Executor(h, max_writes_per_request=2)
+    from pilosa_tpu.executor.executor import Error
+
+    with pytest.raises(Error):
+        e.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=1)")
