@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import sqlite3
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -27,6 +28,7 @@ class AttrStore:
         self._lock = threading.RLock()
         self._cache: Dict[int, dict] = {}
         if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._db = sqlite3.connect(path, check_same_thread=False)
             self._db.execute(
                 "CREATE TABLE IF NOT EXISTS attrs (id INTEGER PRIMARY KEY, doc TEXT NOT NULL)"
