@@ -1,0 +1,17 @@
+from .mesh import (
+    SHARD_AXIS,
+    make_mesh,
+    pad_shards,
+    shard_sharding,
+    replicated_sharding,
+)
+from .engine import MeshEngine
+
+__all__ = [
+    "MeshEngine",
+    "SHARD_AXIS",
+    "make_mesh",
+    "pad_shards",
+    "replicated_sharding",
+    "shard_sharding",
+]

@@ -1,0 +1,182 @@
+"""Sharded query kernels: per-shard device work + ICI collectives.
+
+The reference executes a query per shard in a goroutine and reduces
+results over channels/HTTP (executor.go mapReduce :2183-2321).  Here the
+shard axis lives on the device mesh: each kernel is a ``shard_map`` whose
+body does the per-shard bitmap math (one device handles its contiguous
+shard block as a batched leading axis) and whose reduce is an XLA
+collective (``psum``) riding ICI.
+
+All kernels take stacked inputs ``uint32[S, ..., WORDS]`` with S sharded
+over the mesh; padding shards are zero so AND/popcount reduces ignore
+them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops import bitops
+from .mesh import SHARD_AXIS
+
+
+def _pc(x):
+    return jax.lax.population_count(x).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _count_sharded(mesh, stack):
+    """Total popcount of uint32[S, W] sharded on S -> int32 (replicated)."""
+
+    def body(block):
+        local = jnp.sum(_pc(block))
+        return jax.lax.psum(local, SHARD_AXIS)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P()
+    )(stack)
+
+
+def count_sharded(mesh, stack):
+    return _count_sharded(mesh, stack)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _count_and_sharded(mesh, a, b):
+    """psum(popcount(a & b)) — the north-star Count(Intersect(...)) as one
+    fused pass + one ICI all-reduce."""
+
+    def body(x, y):
+        return jax.lax.psum(jnp.sum(_pc(jnp.bitwise_and(x, y))), SHARD_AXIS)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P()
+    )(a, b)
+
+
+def count_and_sharded(mesh, a, b):
+    return _count_and_sharded(mesh, a, b)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _topn_scores_sharded(mesh, candidates, src):
+    """Per-shard TopN candidate scoring: uint32[S, K, W] x uint32[S, W]
+    -> int32[S, K] (kept sharded; the host heap-merges per shard,
+    fragment.go top :1018)."""
+
+    def body(cands, s):
+        return jnp.sum(_pc(jnp.bitwise_and(cands, s[:, None, :])), axis=-1)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P(SHARD_AXIS)
+    )(candidates, src)
+
+
+def topn_scores_sharded(mesh, candidates, src):
+    return _topn_scores_sharded(mesh, candidates, src)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _sum_planes_sharded(mesh, planes, filt):
+    """BSI Sum over the mesh: planes uint32[S, D+1, W], filter uint32[S, W]
+    -> (int32[D] per-plane counts, int32 considered-count), both replicated.
+    The weighted Σ 2^i·counts[i] is assembled host-side in arbitrary
+    precision (fragment.go sum :716-742)."""
+
+    def body(p, f):
+        consider = jnp.bitwise_and(p[:, -1, :], f)
+        masked = jnp.bitwise_and(p[:, :-1, :], consider[:, None, :])
+        plane_counts = jnp.sum(_pc(masked), axis=(0, 2))
+        n = jnp.sum(_pc(consider))
+        return (
+            jax.lax.psum(plane_counts, SHARD_AXIS),
+            jax.lax.psum(n, SHARD_AXIS),
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P()),
+    )(planes, filt)
+
+
+def sum_planes_sharded(mesh, planes, filt):
+    return _sum_planes_sharded(mesh, planes, filt)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _range_count_sharded(mesh, planes, pred_bits, op_kind: int):
+    """Fused BSI range + count over the mesh: one pass computes the
+    predicate mask per shard (ops.bsi logic inlined over the local block)
+    and psums the popcount.  op_kind: 0=EQ 1=NEQ 2=LT 3=LTE 4=GT 5=GTE."""
+    from ..ops import bsi as bsi_ops
+
+    def body(p, bits):
+        depth = p.shape[1] - 1
+        if op_kind == 0:
+            mask = jax.vmap(lambda pl: bsi_ops.range_eq(pl, bits))(p)
+        elif op_kind == 1:
+            mask = jax.vmap(lambda pl: bsi_ops.range_neq(pl, bits))(p)
+        elif op_kind in (2, 3):
+            mask = jax.vmap(
+                lambda pl: bsi_ops.range_lt(pl, bits, op_kind == 3)
+            )(p)
+        else:
+            mask = jax.vmap(
+                lambda pl: bsi_ops.range_gt(pl, bits, op_kind == 5)
+            )(p)
+        return jax.lax.psum(jnp.sum(_pc(mask)), SHARD_AXIS)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P()), out_specs=P()
+    )(planes, pred_bits)
+
+
+def range_count_sharded(mesh, planes, pred_bits, op_kind):
+    return _range_count_sharded(mesh, planes, pred_bits, op_kind)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _import_step_sharded(mesh, fragment_stack, batch_stack):
+    """Bulk-import step: OR a batch of new bits into the resident fragment
+    matrices, all sharded — the device half of fragment.bulkImport
+    (fragment.go:1445), with no cross-device traffic (bits are routed to
+    their owning shard host-side, as api.go:835-845 routes to shard owners).
+    """
+
+    def body(frag, batch):
+        return jnp.bitwise_or(frag, batch)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P(SHARD_AXIS)
+    )(fragment_stack, batch_stack)
+
+
+def import_step_sharded(mesh, fragment_stack, batch_stack):
+    return _import_step_sharded(mesh, fragment_stack, batch_stack)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _group_counts_sharded(mesh, rows_a, rows_b):
+    """GroupBy pair-count kernel: int32[Ka, Kb] intersection counts of all
+    row pairs, psum'd over shards (executor.go executeGroupByShard :1056
+    without the host iterator when both Rows lists are materialized)."""
+
+    def body(a, b):
+        inter = jnp.bitwise_and(a[:, :, None, :], b[:, None, :, :])
+        counts = jnp.sum(_pc(inter), axis=(0, 3))
+        return jax.lax.psum(counts, SHARD_AXIS)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P()
+    )(rows_a, rows_b)
+
+
+def group_counts_sharded(mesh, rows_a, rows_b):
+    return _group_counts_sharded(mesh, rows_a, rows_b)

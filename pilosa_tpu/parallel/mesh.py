@@ -1,0 +1,73 @@
+"""Device mesh + shard placement.
+
+The TPU-native replacement for the reference's cluster shard routing
+(cluster.go shardNodes :840, jump-hash :905): shards are laid out
+contiguously along a 1-D ``jax.sharding.Mesh`` axis so that the per-query
+shard reduce (executor.go mapReduce :2183) becomes a single ``psum`` over
+ICI instead of goroutine fan-out + HTTP.
+
+Placement math: query shards are packed into a ``[n_shards_padded, ...]``
+leading axis, padded to a multiple of the mesh size; device d owns the
+contiguous block ``[d*k, (d+1)*k)``.  Contiguity keeps each device's
+working set dense in HBM and the reduce a pure tree over the mesh axis
+(SURVEY.md §5 long-axis note).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the shard axis.  ``n_devices`` trims/validates against
+    the available device count (virtual CPU devices in tests)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def shard_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis split over the shard mesh axis."""
+    return NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_shards(n_shards: int, mesh: Mesh) -> int:
+    """Shard count padded up to a multiple of the mesh size."""
+    n_dev = mesh.devices.size
+    return max(((n_shards + n_dev - 1) // n_dev) * n_dev, n_dev)
+
+
+def shard_owner(shard_index: int, n_shards_padded: int, mesh: Mesh) -> int:
+    """Mesh position owning a (packed) shard index."""
+    per_dev = n_shards_padded // mesh.devices.size
+    return shard_index // per_dev
+
+
+def stack_sharded(arrays: Sequence[np.ndarray], mesh: Mesh, pad_to: Optional[int] = None):
+    """Stack per-shard host arrays into a device array sharded over the
+    mesh axis, zero-padding to the mesh multiple."""
+    import jax.numpy as jnp
+
+    n = len(arrays)
+    padded = pad_to if pad_to is not None else pad_shards(n, mesh)
+    base = np.asarray(arrays[0])
+    out = np.zeros((padded,) + base.shape, dtype=base.dtype)
+    for i, a in enumerate(arrays):
+        out[i] = a
+    return jax.device_put(jnp.asarray(out), shard_sharding(mesh))

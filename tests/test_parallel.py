@@ -1,0 +1,137 @@
+"""Mesh/shard_map parallel path tests on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.parallel import MeshEngine, make_mesh, pad_shards
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture
+def holder():
+    h = Holder()
+    h.open()
+    return h
+
+
+def build_data(holder, n_shards=8):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    v = idx.create_field("v", FieldOptions(type="int", min=0, max=1000))
+    ef = idx.existence_field()
+    rows, cols, vals_c, vals_v = [], [], [], []
+    rng = np.random.default_rng(7)
+    for s in range(n_shards):
+        base = s * SHARD_WIDTH
+        picks = rng.choice(SHARD_WIDTH, size=500, replace=False)
+        for c in picks[:300]:
+            rows.append(10)
+            cols.append(base + int(c))
+        for c in picks[200:]:
+            rows.append(11)
+            cols.append(base + int(c))
+        for c in picks[:50]:
+            vals_c.append(base + int(c))
+            vals_v.append(int(rng.integers(0, 1000)))
+    f.import_bulk(rows, cols)
+    ef.import_bulk([0] * len(cols), cols)
+    v.import_values(vals_c, vals_v)
+    return idx
+
+
+def test_mesh_count_matches_executor(holder, mesh):
+    build_data(holder)
+    ex = Executor(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(8))
+    for q in [
+        "Row(f=10)",
+        "Intersect(Row(f=10), Row(f=11))",
+        "Union(Row(f=10), Row(f=11))",
+        "Difference(Row(f=10), Row(f=11))",
+        "Xor(Row(f=10), Row(f=11))",
+        "Not(Row(f=10))",
+    ]:
+        call = pql.parse(q).calls[0]
+        want = ex.execute("i", f"Count({q})").results[0]
+        got = eng.count("i", call, shards)
+        assert got == want, q
+
+
+def test_mesh_range_count(holder, mesh):
+    build_data(holder)
+    ex = Executor(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(8))
+    for q in [
+        "Range(v > 500)",
+        "Range(v <= 300)",
+        "Range(v == 7)",
+        "Range(v != null)",
+        "Range(100 < v < 900)",
+    ]:
+        call = pql.parse(q).calls[0]
+        want = ex.execute("i", f"Count({q})").results[0]
+        got = eng.count("i", call, shards)
+        assert got == want, q
+
+
+def test_mesh_bitmap_row_matches(holder, mesh):
+    build_data(holder)
+    ex = Executor(holder)
+    eng = MeshEngine(holder, mesh)
+    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    want = ex.execute("i", "Intersect(Row(f=10), Row(f=11))").results[0]
+    got = eng.bitmap_row("i", call, list(range(8)))
+    assert got.columns().tolist() == want.columns().tolist()
+
+
+def test_mesh_sum(holder, mesh):
+    build_data(holder)
+    ex = Executor(holder)
+    eng = MeshEngine(holder, mesh)
+    want = ex.execute("i", "Sum(field=v)").results[0]
+    total, n = eng.sum("i", "v", None, list(range(8)))
+    assert (total, n) == (want.val, want.count)
+    # Filtered.
+    filt = pql.parse("Row(f=10)").calls[0]
+    want = ex.execute("i", "Sum(Row(f=10), field=v)").results[0]
+    total, n = eng.sum("i", "v", filt, list(range(8)))
+    assert (total, n) == (want.val, want.count)
+
+
+def test_mesh_cache_invalidation(holder, mesh):
+    build_data(holder)
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder)
+    call = pql.parse("Row(f=10)").calls[0]
+    before = eng.count("i", call, list(range(8)))
+    ex.execute("i", f"Set({3*SHARD_WIDTH + 99}, f=10)")
+    after = eng.count("i", call, list(range(8)))
+    assert after == before + 1
+
+
+def test_pad_shards(mesh):
+    assert pad_shards(1, mesh) == 8
+    assert pad_shards(8, mesh) == 8
+    assert pad_shards(9, mesh) == 16
+
+
+def test_mesh_uneven_shards(holder, mesh):
+    """Shard count not a multiple of mesh size: padding shards are zero."""
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    cols = [0, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 2]
+    f.import_bulk([5, 5, 5], cols)
+    eng = MeshEngine(holder, mesh)
+    call = pql.parse("Row(f=5)").calls[0]
+    assert eng.count("i", call, [0, 1, 2]) == 3
