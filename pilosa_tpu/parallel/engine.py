@@ -1,17 +1,20 @@
 """MeshEngine: fused multi-device execution of PQL bitmap trees.
 
 The per-shard goroutine fan-out + reduce of the reference
-(executor.go mapReduce :2183-2321) becomes, per query:
+(executor.go mapReduce :2183-2321) becomes, per query, ONE jitted
+dispatch:
 
-1. resolve leaves (Row / BSI Range) against a device-resident sharded
-   field stack ``uint32[S, R, WORDS]`` (S = padded shard axis over the
-   mesh, R = union row table),
-2. evaluate the whole call tree in ONE ``shard_map`` body — the tree is
-   lowered to a static program so XLA fuses every AND/OR/ANDNOT/XOR/NOT
-   and the popcount into a single pass over HBM,
-3. reduce with ``psum`` over ICI.
+1. the call tree is lowered to a static program over a flat list of
+   device operands — field stacks ``uint32[S, R, WORDS]`` (S = padded
+   shard axis over the mesh, R = union row table), plus *traced* row
+   indices and BSI predicate bits, so queries that differ only in row id
+   or predicate value reuse the same compiled program;
+2. the whole tree — row gathers, BSI plane walks, every AND/OR/ANDNOT/
+   XOR/NOT, and the popcount — evaluates inside a single ``shard_map``
+   body that XLA fuses into one pass over HBM;
+3. the reduce is a ``psum`` over ICI.
 
-The stacks are cached per (index, field, view) and invalidated by
+Field stacks are cached per (index, field, view) and invalidated by
 fragment versions, replacing the reference's mmap residency
 (fragment.go:190-247) with an explicit HBM residency manager.
 """
@@ -31,7 +34,7 @@ from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
 from ..ops import bsi as bsi_ops
 from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
-from .mesh import SHARD_AXIS, pad_shards, shard_sharding
+from .mesh import SHARD_AXIS, pad_shards, replicated_sharding, shard_sharding
 
 
 class _FieldStack:
@@ -46,11 +49,56 @@ class _FieldStack:
         self.shards = shards
 
 
+class _Lowering:
+    """Flat operand list + per-operand shardings for one query program."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.operands: list = []
+        self.specs: list = []
+        self._mat_ids: Dict[int, int] = {}
+
+    def add_matrix(self, mat) -> int:
+        key = id(mat)
+        i = self._mat_ids.get(key)
+        if i is None:
+            i = len(self.operands)
+            self.operands.append(mat)
+            self.specs.append(P(SHARD_AXIS))
+            self._mat_ids[key] = i
+        return i
+
+    def add_replicated(self, arr) -> int:
+        self.operands.append(arr)
+        self.specs.append(P())
+        return len(self.operands) - 1
+
+
 class MeshEngine:
     def __init__(self, holder, mesh: Mesh):
         self.holder = holder
         self.mesh = mesh
         self._stacks: Dict[Tuple[str, str, str, Tuple[int, ...]], _FieldStack] = {}
+        self._zeros: Dict[int, object] = {}
+        self._scalars: Dict[int, object] = {}
+        self._bits: Dict[Tuple[int, int], object] = {}
+
+    def _scalar(self, v: int):
+        """Cached device int32 scalar (fresh device_puts per query are the
+        dominant dispatch cost through high-latency transports)."""
+        s = self._scalars.get(v)
+        if s is None:
+            s = jnp.int32(v)
+            self._scalars[v] = s
+        return s
+
+    def _bits_arr(self, value: int, depth: int):
+        key = (value, depth)
+        b = self._bits.get(key)
+        if b is None:
+            b = jnp.asarray(bsi_ops.to_bits(value, depth))
+            self._bits[key] = b
+        return b
 
     # -- residency ---------------------------------------------------------
 
@@ -59,12 +107,8 @@ class MeshEngine:
     ) -> Optional[_FieldStack]:
         """Sharded stack of every row of a view across ``shards``."""
         key = (index, field, view, tuple(shards))
-        frags = [
-            self.holder.fragment(index, field, view, s) for s in shards
-        ]
-        versions = tuple(
-            -1 if f is None else f._version for f in frags
-        )
+        frags = [self.holder.fragment(index, field, view, s) for s in shards]
+        versions = tuple(-1 if f is None else f._version for f in frags)
         cached = self._stacks.get(key)
         if cached is not None and cached.versions == versions:
             return cached
@@ -91,19 +135,30 @@ class MeshEngine:
         self._stacks[key] = stack
         return stack
 
+    def _zero_stack(self, shards):
+        """Cached zeros uint32[S, 1, WORDS] used as the empty-leaf operand."""
+        S = pad_shards(len(shards), self.mesh)
+        z = self._zeros.get(S)
+        if z is None:
+            z = jax.device_put(
+                jnp.zeros((S, 1, bitops.WORDS), dtype=jnp.uint32),
+                shard_sharding(self.mesh),
+            )
+            self._zeros[S] = z
+        return z
+
     # -- call-tree lowering -------------------------------------------------
 
-    def _lower(self, index: str, c: Call, shards, leaves: list):
-        """Lower a bitmap call tree to a hashable static program whose
-        leaves index into ``leaves`` (device uint32[S, WORDS] stacks)."""
+    def _lower(self, index: str, c: Call, shards, lw: _Lowering):
+        """Lower a bitmap call tree to a hashable static program over
+        ``lw``'s operand list."""
         name = c.name
         if name == "Row":
             field_name = c.field_arg()
             row_id, ok = c.uint_arg(field_name)
             if not ok:
                 raise ValueError("Row() requires a row id")
-            leaves.append(self._row_leaf(index, field_name, row_id, shards))
-            return ("leaf", len(leaves) - 1)
+            return self._lower_row(index, field_name, row_id, shards, lw)
         if name in ("Union", "Intersect", "Difference", "Xor"):
             op = {
                 "Union": "or",
@@ -112,85 +167,84 @@ class MeshEngine:
                 "Xor": "xor",
             }[name]
             subs = tuple(
-                self._lower(index, ch, shards, leaves) for ch in c.children
+                self._lower(index, ch, shards, lw) for ch in c.children
             )
             if not subs:
-                leaves.append(self._zero_leaf(shards))
-                return ("leaf", len(leaves) - 1)
+                return self._lower_zero(shards, lw)
             return (op,) + subs
         if name == "Not":
             from ..core.index import EXISTENCE_FIELD_NAME
 
-            leaves.append(
-                self._row_leaf(index, EXISTENCE_FIELD_NAME, 0, shards)
-            )
-            exist = ("leaf", len(leaves) - 1)
-            sub = self._lower(index, c.children[0], shards, leaves)
+            exist = self._lower_row(index, EXISTENCE_FIELD_NAME, 0, shards, lw)
+            sub = self._lower(index, c.children[0], shards, lw)
             return ("andnot", exist, sub)
         if name == "Range" and c.has_condition_arg():
-            leaves.append(self._range_leaf(index, c, shards))
-            return ("leaf", len(leaves) - 1)
+            return self._lower_range(index, c, shards, lw)
         raise ValueError(f"unsupported call for mesh path: {name}")
 
-    def _zero_leaf(self, shards):
-        S = pad_shards(len(shards), self.mesh)
-        return jax.device_put(
-            jnp.zeros((S, bitops.WORDS), dtype=jnp.uint32),
-            shard_sharding(self.mesh),
-        )
+    def _lower_zero(self, shards, lw: _Lowering):
+        return ("zero", lw.add_matrix(self._zero_stack(shards)))
 
-    def _row_leaf(self, index: str, field: str, row_id: int, shards):
+    def _lower_row(self, index, field, row_id, shards, lw: _Lowering):
         stack = self.field_stack(index, field, VIEW_STANDARD, shards)
         if stack is None or row_id not in stack.row_index:
-            return self._zero_leaf(shards)
-        return stack.matrix[:, stack.row_index[row_id], :]
+            return self._lower_zero(shards, lw)
+        i_mat = lw.add_matrix(stack.matrix)
+        i_idx = lw.add_replicated(self._scalar(stack.row_index[row_id]))
+        return ("row", i_mat, i_idx)
 
-    def _range_leaf(self, index: str, c: Call, shards):
-        """BSI Range leaf: vmapped predicate walk over the sharded plane
-        stack (same math as executor._execute_bsi_range_shard)."""
+    def _plane_spec(self, stack: _FieldStack, depth: int):
+        """Static layout of BSI planes 0..depth inside a stack: a
+        contiguous slice when possible, else a gather with -1 for
+        missing planes."""
+        idxs = [stack.row_index.get(r) for r in range(depth + 1)]
+        if None not in idxs and idxs == list(
+            range(idxs[0], idxs[0] + depth + 1)
+        ):
+            return ("slice", idxs[0], depth + 1)
+        return ("gather", tuple(-1 if i is None else i for i in idxs))
+
+    def _lower_range(self, index: str, c: Call, shards, lw: _Lowering):
+        """BSI Range leaf with the same out-of-range/notNull special cases
+        as executor._execute_bsi_range_shard (executor.go:1309-1440)."""
         (field_name, cond), = c.args.items()
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx is not None else None
         bsig = f.bsi_group(field_name) if f is not None else None
         if bsig is None:
             raise ValueError(f"field not found: {field_name}")
-        view = view_bsi_name(field_name)
         depth = bsig.bit_depth()
-        stack = self.field_stack(index, field_name, view, shards)
+        stack = self.field_stack(
+            index, field_name, view_bsi_name(field_name), shards
+        )
         if stack is None:
-            return self._zero_leaf(shards)
-        # Plane matrix rows 0..depth must exist in the row table.
-        idxs = [stack.row_index.get(r) for r in range(depth + 1)]
-        if any(i is None for i in idxs):
-            sel = [
-                stack.matrix[:, i, :]
-                if i is not None
-                else jnp.zeros_like(stack.matrix[:, 0, :])
-                for i in idxs
-            ]
-            planes = jnp.stack(sel, axis=1)
-        else:
-            planes = stack.matrix[:, idxs[0] : idxs[0] + depth + 1, :]
+            return self._lower_zero(shards, lw)
+        i_mat = lw.add_matrix(stack.matrix)
+        pspec = self._plane_spec(stack, depth)
 
-        not_null = planes[:, depth, :]
+        def not_null():
+            nn_idx = stack.row_index.get(depth)
+            if nn_idx is None:
+                return self._lower_zero(shards, lw)
+            i_idx = lw.add_replicated(self._scalar(nn_idx))
+            return ("row", i_mat, i_idx)
+
         if cond.op == NEQ and cond.value is None:
-            return not_null
+            return not_null()
         if cond.op == BETWEEN:
             lo_hi = cond.int_slice_value()
             lo, hi, out_of_range = bsig.base_value_between(*lo_hi)
             if out_of_range:
-                return self._zero_leaf(shards)
+                return self._lower_zero(shards, lw)
             if lo_hi[0] <= bsig.min and lo_hi[1] >= bsig.max:
-                return not_null
-            lo_bits = jnp.asarray(bsi_ops.to_bits(lo, depth))
-            hi_bits = jnp.asarray(bsi_ops.to_bits(hi, depth))
-            return jax.vmap(
-                lambda p: bsi_ops.range_between(p, lo_bits, hi_bits)
-            )(planes)
+                return not_null()
+            i_lo = lw.add_replicated(self._bits_arr(lo, depth))
+            i_hi = lw.add_replicated(self._bits_arr(hi, depth))
+            return ("between", i_mat, pspec, i_lo, i_hi)
         value = cond.value
         base, out_of_range = bsig.base_value(cond.op, value)
         if out_of_range and cond.op != NEQ:
-            return self._zero_leaf(shards)
+            return self._lower_zero(shards, lw)
         if (
             (cond.op == LT and value > bsig.max)
             or (cond.op == LTE and value >= bsig.max)
@@ -198,31 +252,32 @@ class MeshEngine:
             or (cond.op == GTE and value <= bsig.min)
             or (out_of_range and cond.op == NEQ)
         ):
-            return not_null
-        bits = jnp.asarray(bsi_ops.to_bits(base, depth))
-        if cond.op == EQ:
-            fn = lambda p: bsi_ops.range_eq(p, bits)
-        elif cond.op == NEQ:
-            fn = lambda p: bsi_ops.range_neq(p, bits)
-        elif cond.op in (LT, LTE):
-            fn = lambda p: bsi_ops.range_lt(p, bits, cond.op == LTE)
-        else:
-            fn = lambda p: bsi_ops.range_gt(p, bits, cond.op == GTE)
-        return jax.vmap(fn)(planes)
+            return not_null()
+        i_bits = lw.add_replicated(self._bits_arr(base, depth))
+        kind = {EQ: "eq", NEQ: "neq", LT: "lt", LTE: "lte", GT: "gt", GTE: "gte"}[
+            cond.op
+        ]
+        return ("range", kind, i_mat, pspec, i_bits)
 
     # -- fused evaluation ---------------------------------------------------
 
     def count(self, index: str, c: Call, shards: List[int]) -> int:
-        """Count(tree): one fused pass + one psum."""
-        leaves: list = []
-        prog = self._lower(index, c, shards, leaves)
-        return int(_count_tree(self.mesh, prog, tuple(leaves)))
+        """Count(tree): one fused dispatch, one psum."""
+        return int(self.count_async(index, c, shards))
+
+    def count_async(self, index: str, c: Call, shards: List[int]):
+        """Count(tree) returning the device scalar without host sync —
+        callers pipeline query streams and fetch results in one transfer
+        (the async analogue of mapReduce's result channel)."""
+        lw = _Lowering(self)
+        prog = self._lower(index, c, shards, lw)
+        return _count_tree(self.mesh, prog, tuple(lw.specs), *lw.operands)
 
     def bitmap_stack(self, index: str, c: Call, shards: List[int]):
         """Evaluate a tree to its sharded uint32[S, WORDS] row stack."""
-        leaves: list = []
-        prog = self._lower(index, c, shards, leaves)
-        return _eval_tree(self.mesh, prog, tuple(leaves))
+        lw = _Lowering(self)
+        prog = self._lower(index, c, shards, lw)
+        return _eval_tree(self.mesh, prog, tuple(lw.specs), *lw.operands)
 
     def bitmap_row(self, index: str, c: Call, shards: List[int]):
         """Evaluate a tree and materialize a core Row (host segments)."""
@@ -236,7 +291,7 @@ class MeshEngine:
         return Row(segs)
 
     def sum(self, index: str, field_name: str, filter_call: Optional[Call], shards):
-        """BSI Sum over the mesh (ValCount parts: total, count)."""
+        """BSI Sum over the mesh (returns the ValCount parts: total, count)."""
         from . import kernels
 
         idx = self.holder.index(index)
@@ -250,17 +305,7 @@ class MeshEngine:
         )
         if stack is None:
             return 0, 0
-        idxs = [stack.row_index.get(r) for r in range(depth + 1)]
-        if any(i is None for i in idxs):
-            sel = [
-                stack.matrix[:, i, :]
-                if i is not None
-                else jnp.zeros_like(stack.matrix[:, 0, :])
-                for i in idxs
-            ]
-            planes = jnp.stack(sel, axis=1)
-        else:
-            planes = stack.matrix[:, idxs[0] : idxs[0] + depth + 1, :]
+        planes = _gather_planes(stack.matrix, self._plane_spec(stack, depth))
         if filter_call is not None:
             filt = self.bitmap_stack(index, filter_call, shards)
         else:
@@ -275,7 +320,9 @@ class MeshEngine:
         n = int(n)
         return total + n * bsig.min, n
 
-    def topn_scores(self, index: str, field: str, candidate_rows: List[int], src_call: Call, shards):
+    def topn_scores(
+        self, index: str, field: str, candidate_rows: List[int], src_call: Call, shards
+    ):
         """Batched TopN phase-1 scoring: intersection counts of every
         candidate row x src tree, per shard."""
         from . import kernels
@@ -288,16 +335,47 @@ class MeshEngine:
         )
         cands = stack.matrix[:, idxs, :]
         src = self.bitmap_stack(index, src_call, shards)
-        return np.asarray(
-            kernels.topn_scores_sharded(self.mesh, cands, src)
-        )
+        return np.asarray(kernels.topn_scores_sharded(self.mesh, cands, src))
 
 
-def _apply_prog(prog, leaves):
+def _gather_planes(mat, pspec):
+    """uint32[S, R, W] -> uint32[S, depth+1, W] per the static layout."""
+    if pspec[0] == "slice":
+        _, start, n = pspec
+        return jax.lax.slice_in_dim(mat, start, start + n, axis=1)
+    idxs = pspec[1]
+    planes = [
+        mat[:, i, :] if i >= 0 else jnp.zeros_like(mat[:, 0, :]) for i in idxs
+    ]
+    return jnp.stack(planes, axis=1)
+
+
+def _apply_prog(prog, operands):
     kind = prog[0]
-    if kind == "leaf":
-        return leaves[prog[1]]
-    subs = [_apply_prog(p, leaves) for p in prog[1:]]
+    if kind == "zero":
+        return operands[prog[1]][:, 0, :]
+    if kind == "row":
+        mat, idx = operands[prog[1]], operands[prog[2]]
+        return jax.lax.dynamic_index_in_dim(mat, idx, axis=1, keepdims=False)
+    if kind == "range":
+        _, rk, i_mat, pspec, i_bits = prog
+        planes = _gather_planes(operands[i_mat], pspec)
+        bits = operands[i_bits]
+        fns = {
+            "eq": lambda p: bsi_ops.range_eq(p, bits),
+            "neq": lambda p: bsi_ops.range_neq(p, bits),
+            "lt": lambda p: bsi_ops.range_lt(p, bits, False),
+            "lte": lambda p: bsi_ops.range_lt(p, bits, True),
+            "gt": lambda p: bsi_ops.range_gt(p, bits, False),
+            "gte": lambda p: bsi_ops.range_gt(p, bits, True),
+        }
+        return jax.vmap(fns[rk])(planes)
+    if kind == "between":
+        _, i_mat, pspec, i_lo, i_hi = prog
+        planes = _gather_planes(operands[i_mat], pspec)
+        lo, hi = operands[i_lo], operands[i_hi]
+        return jax.vmap(lambda p: bsi_ops.range_between(p, lo, hi))(planes)
+    subs = [_apply_prog(p, operands) for p in prog[1:]]
     out = subs[0]
     for s in subs[1:]:
         if kind == "or":
@@ -313,24 +391,22 @@ def _apply_prog(prog, leaves):
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _count_tree(mesh, prog, leaves):
-    def body(*ls):
-        row = _apply_prog(prog, ls)
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _count_tree(mesh, prog, specs, *operands):
+    def body(*ops):
+        row = _apply_prog(prog, ops)
         return jax.lax.psum(
             jnp.sum(jax.lax.population_count(row).astype(jnp.int32)), SHARD_AXIS
         )
 
-    specs = tuple(P(SHARD_AXIS) for _ in leaves)
-    return shard_map(body, mesh=mesh, in_specs=specs, out_specs=P())(*leaves)
+    return shard_map(body, mesh=mesh, in_specs=specs, out_specs=P())(*operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _eval_tree(mesh, prog, leaves):
-    def body(*ls):
-        return _apply_prog(prog, ls)
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _eval_tree(mesh, prog, specs, *operands):
+    def body(*ops):
+        return _apply_prog(prog, ops)
 
-    specs = tuple(P(SHARD_AXIS) for _ in leaves)
-    return shard_map(body, mesh=mesh, in_specs=specs, out_specs=P(SHARD_AXIS))(
-        *leaves
-    )
+    return shard_map(
+        body, mesh=mesh, in_specs=specs, out_specs=P(SHARD_AXIS)
+    )(*operands)
