@@ -18,13 +18,16 @@ from .. import ops
 class Row:
     """Per-shard dense segments + result metadata (attrs, key)."""
 
-    __slots__ = ("segments", "attrs", "key")
+    __slots__ = ("segments", "attrs", "key", "keys")
 
     def __init__(self, segments: Optional[Dict[int, object]] = None):
         # shard -> uint32[WORDS] words (np.ndarray or jax.Array)
         self.segments: Dict[int, object] = segments or {}
         self.attrs: Optional[dict] = None
         self.key: Optional[str] = None
+        # Translated column keys when the index has keys enabled
+        # (row.go Row.Keys).
+        self.keys: Optional[List[str]] = None
 
     @classmethod
     def from_columns(cls, columns) -> "Row":

@@ -1,0 +1,249 @@
+"""Key translation: string key <-> uint64 id, bidirectional.
+
+Mirror of the reference's TranslateStore/TranslateFile (translate.go:39-53,
+55-432): ids are assigned from a per-(index) / per-(index, field)
+autoincrement sequence starting at 1, recorded in an append-only log file
+replayed on open, with an offset-based reader so replicas stream the log
+from the primary (translate.go Reader/:400-432, http/handler.go:271).
+
+The log is a length-prefixed binary format (one fsync'd record per append):
+    [u8 type][u32 len(index)][index][u32 len(field)][field]
+    [u32 n][ (u64 id, u32 len(key), key) * n ]
+(type 1 = column insert, 2 = row insert.)  The reference's robin-hood
+mmap index (translate.go:854-1008) is replaced by plain host dicts — the
+translate path never touches the device.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+LOG_INSERT_COLUMN = 1
+LOG_INSERT_ROW = 2
+
+
+class TranslateError(Exception):
+    pass
+
+
+class ReadOnlyError(TranslateError):
+    """Writes attempted on a replica (translate.go ErrTranslateStoreReadOnly)."""
+
+
+class _KeyMap:
+    __slots__ = ("seq", "id_by_key", "key_by_id")
+
+    def __init__(self):
+        self.seq = 0
+        self.id_by_key: Dict[str, int] = {}
+        self.key_by_id: Dict[int, str] = {}
+
+    def assign(self, key: str) -> int:
+        self.seq += 1
+        self.id_by_key[key] = self.seq
+        self.key_by_id[self.seq] = key
+        return self.seq
+
+    def apply(self, id: int, key: str):
+        self.id_by_key[key] = id
+        self.key_by_id[id] = key
+        if id > self.seq:
+            self.seq = id
+
+
+def _encode_entry(
+    typ: int, index: str, field: str, pairs: List[Tuple[int, str]]
+) -> bytes:
+    buf = io.BytesIO()
+    ib = index.encode()
+    fb = field.encode()
+    buf.write(struct.pack("<BII", typ, len(ib), len(fb)))
+    buf.write(ib)
+    buf.write(fb)
+    buf.write(struct.pack("<I", len(pairs)))
+    for id, key in pairs:
+        kb = key.encode()
+        buf.write(struct.pack("<QI", id, len(kb)))
+        buf.write(kb)
+    return buf.getvalue()
+
+
+def _decode_entries(data: bytes, start: int = 0):
+    """Yield (typ, index, field, pairs, end_offset); stops at truncation."""
+    off = start
+    n = len(data)
+    while off + 9 <= n:
+        typ, ilen, flen = struct.unpack_from("<BII", data, off)
+        p = off + 9
+        if p + ilen + flen + 4 > n:
+            break
+        index = data[p : p + ilen].decode()
+        p += ilen
+        field = data[p : p + flen].decode()
+        p += flen
+        (count,) = struct.unpack_from("<I", data, p)
+        p += 4
+        pairs = []
+        ok = True
+        for _ in range(count):
+            if p + 12 > n:
+                ok = False
+                break
+            id, klen = struct.unpack_from("<QI", data, p)
+            p += 12
+            if p + klen > n:
+                ok = False
+                break
+            pairs.append((id, data[p : p + klen].decode()))
+            p += klen
+        if not ok:
+            break
+        yield typ, index, field, pairs, p
+        off = p
+
+
+class TranslateFile:
+    """On-disk (or in-memory) translate store; single writer (the
+    coordinator), replicas replay the primary's log (translate.go:55)."""
+
+    def __init__(self, path: Optional[str] = None, read_only: bool = False):
+        self.path = path
+        self.read_only = read_only
+        self._lock = threading.RLock()
+        self._cols: Dict[str, _KeyMap] = {}
+        self._rows: Dict[Tuple[str, str], _KeyMap] = {}
+        self._file = None
+        self._size = 0
+        # Callbacks fired on append (the HTTP layer notifies streaming
+        # replica readers, translate.go WriteNotify :258).
+        self._write_listeners = []
+
+    def open(self):
+        if self.path is None:
+            return
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            self._replay(data)
+            self._size = len(data)
+        # read_only gates id assignment, not persistence: replicas mirror
+        # the primary's log to their own file (translate.go:400-432).
+        self._file = open(self.path, "ab")
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def _replay(self, data: bytes):
+        for typ, index, field, pairs, _ in _decode_entries(data):
+            self._apply(typ, index, field, pairs)
+
+    def _apply(self, typ: int, index: str, field: str, pairs):
+        if typ == LOG_INSERT_COLUMN:
+            m = self._cols.setdefault(index, _KeyMap())
+        else:
+            m = self._rows.setdefault((index, field), _KeyMap())
+        for id, key in pairs:
+            m.apply(id, key)
+
+    def _append(self, typ: int, index: str, field: str, pairs):
+        data = _encode_entry(typ, index, field, pairs)
+        if self._file is not None:
+            self._file.write(data)
+            self._file.flush()
+        self._size += len(data)
+        for fn in list(self._write_listeners):
+            fn()
+
+    def on_write(self, fn):
+        self._write_listeners.append(fn)
+
+    def size(self) -> int:
+        return self._size
+
+    # -- TranslateStore interface (translate.go:39-53) ---------------------
+
+    def translate_columns_to_uint64(self, index: str, keys: List[str]) -> List[int]:
+        with self._lock:
+            m = self._cols.get(index)
+            if m is not None and all(k in m.id_by_key for k in keys):
+                return [m.id_by_key[k] for k in keys]
+            if self.read_only:
+                raise ReadOnlyError("translate store is read-only")
+            if m is None:
+                m = self._cols.setdefault(index, _KeyMap())
+            out, new_pairs = [], []
+            for k in keys:
+                id = m.id_by_key.get(k)
+                if id is None:
+                    id = m.assign(k)
+                    new_pairs.append((id, k))
+                out.append(id)
+            if new_pairs:
+                self._append(LOG_INSERT_COLUMN, index, "", new_pairs)
+            return out
+
+    def translate_column_to_string(self, index: str, id: int) -> str:
+        with self._lock:
+            m = self._cols.get(index)
+            if m is None:
+                return ""
+            return m.key_by_id.get(id, "")
+
+    def translate_rows_to_uint64(
+        self, index: str, field: str, keys: List[str]
+    ) -> List[int]:
+        with self._lock:
+            m = self._rows.get((index, field))
+            if m is not None and all(k in m.id_by_key for k in keys):
+                return [m.id_by_key[k] for k in keys]
+            if self.read_only:
+                raise ReadOnlyError("translate store is read-only")
+            if m is None:
+                m = self._rows.setdefault((index, field), _KeyMap())
+            out, new_pairs = [], []
+            for k in keys:
+                id = m.id_by_key.get(k)
+                if id is None:
+                    id = m.assign(k)
+                    new_pairs.append((id, k))
+                out.append(id)
+            if new_pairs:
+                self._append(LOG_INSERT_ROW, index, field, new_pairs)
+            return out
+
+    def translate_row_to_string(self, index: str, field: str, id: int) -> str:
+        with self._lock:
+            m = self._rows.get((index, field))
+            if m is None:
+                return ""
+            return m.key_by_id.get(id, "")
+
+    # -- replication (translate.go:358-432) --------------------------------
+
+    def reader(self, offset: int) -> bytes:
+        """Raw log bytes from offset (the /internal/translate/data body)."""
+        if self.path is None:
+            raise TranslateError("in-memory translate store has no log")
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            return f.read()
+
+    def apply_log(self, data: bytes) -> int:
+        """Replica side: apply a chunk of the primary's log; returns bytes
+        consumed (entries may be truncated mid-record)."""
+        with self._lock:
+            consumed = 0
+            for typ, index, field, pairs, end in _decode_entries(data):
+                self._apply(typ, index, field, pairs)
+                consumed = end
+            if self._file is not None and consumed:
+                self._file.write(data[:consumed])
+                self._file.flush()
+            self._size += consumed
+            return consumed

@@ -1,0 +1,153 @@
+"""Key translation tests (translate.go semantics + executor_test.go keyed
+index/field cases)."""
+
+import os
+
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.translate import ReadOnlyError, TranslateFile
+from pilosa_tpu.executor import Executor, RowIdentifiers
+from pilosa_tpu.executor.translate import QueryTranslator
+
+
+def test_sequential_ids():
+    s = TranslateFile()
+    assert s.translate_columns_to_uint64("i", ["a", "b", "a"]) == [1, 2, 1]
+    assert s.translate_columns_to_uint64("i", ["c"]) == [3]
+    assert s.translate_column_to_string("i", 2) == "b"
+    assert s.translate_column_to_string("i", 99) == ""
+    # Rows have their own sequence per (index, field).
+    assert s.translate_rows_to_uint64("i", "f", ["x", "y"]) == [1, 2]
+    assert s.translate_rows_to_uint64("i", "g", ["x"]) == [1]
+    assert s.translate_row_to_string("i", "f", 2) == "y"
+
+
+def test_log_replay(tmp_path):
+    p = str(tmp_path / "translate.log")
+    s = TranslateFile(p)
+    s.open()
+    s.translate_columns_to_uint64("i", ["a", "b"])
+    s.translate_rows_to_uint64("i", "f", ["r1"])
+    s.close()
+
+    s2 = TranslateFile(p)
+    s2.open()
+    assert s2.translate_columns_to_uint64("i", ["b"]) == [2]
+    assert s2.translate_columns_to_uint64("i", ["new"]) == [3]
+    assert s2.translate_row_to_string("i", "f", 1) == "r1"
+    s2.close()
+
+
+def test_replication(tmp_path):
+    primary = TranslateFile(str(tmp_path / "primary.log"))
+    primary.open()
+    primary.translate_columns_to_uint64("i", ["a", "b"])
+
+    replica = TranslateFile(str(tmp_path / "replica.log"), read_only=True)
+    replica.open()
+    data = primary.reader(0)
+    consumed = replica.apply_log(data)
+    assert consumed == len(data)
+    assert replica.translate_column_to_string("i", 1) == "a"
+    assert replica.translate_columns_to_uint64("i", ["b"]) == [2]
+    with pytest.raises(ReadOnlyError):
+        replica.translate_columns_to_uint64("i", ["unseen"])
+    # Incremental tail from the consumed offset.
+    primary.translate_columns_to_uint64("i", ["c"])
+    tail = primary.reader(consumed)
+    replica.apply_log(tail)
+    assert replica.translate_column_to_string("i", 3) == "c"
+
+
+def test_truncated_log_chunk():
+    s = TranslateFile()
+    from pilosa_tpu.core.translate import _encode_entry, LOG_INSERT_COLUMN
+
+    data = _encode_entry(LOG_INSERT_COLUMN, "i", "", [(1, "abc"), (2, "def")])
+    # Feed only part of the record: nothing consumed.
+    assert s.apply_log(data[: len(data) - 2]) == 0
+    assert s.apply_log(data) == len(data)
+    assert s.translate_column_to_string("i", 2) == "def"
+
+
+@pytest.fixture
+def keyed_env():
+    h = Holder()
+    h.open()
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    idx.create_field("n")  # unkeyed field in keyed index
+    store = TranslateFile()
+    ex = Executor(h, translator=QueryTranslator(store))
+    return h, idx, ex, store
+
+
+def test_keyed_set_and_row(keyed_env):
+    h, idx, ex, store = keyed_env
+    ex.execute("i", 'Set("alpha", f="ten")')
+    ex.execute("i", 'Set("beta", f="ten")')
+    ex.execute("i", 'Set("alpha", f="eleven")')
+    (row,) = ex.execute("i", 'Row(f="ten")').results
+    assert sorted(row.keys) == ["alpha", "beta"]
+    (c,) = ex.execute("i", 'Count(Row(f="ten"))').results
+    assert c == 2
+
+
+def test_keyed_string_col_required(keyed_env):
+    h, idx, ex, store = keyed_env
+    from pilosa_tpu.executor.translate import TranslateError
+
+    with pytest.raises(TranslateError):
+        ex.execute("i", "Set(1, f=10)")
+
+
+def test_unkeyed_rejects_string(keyed_env):
+    h = Holder()
+    h.open()
+    h.create_index("u").create_field("f")
+    store = TranslateFile()
+    ex = Executor(h, translator=QueryTranslator(store))
+    from pilosa_tpu.executor.translate import TranslateError
+
+    with pytest.raises(TranslateError):
+        ex.execute("u", 'Set("foo", f=10)')
+
+
+def test_keyed_topn_and_rows(keyed_env):
+    h, idx, ex, store = keyed_env
+    ex.execute("i", 'Set("a", f="x") Set("b", f="x") Set("a", f="y")')
+    (pairs,) = ex.execute("i", "TopN(f, n=5)").results
+    assert pairs == [("x", 2), ("y", 1)]
+    (rows,) = ex.execute("i", "Rows(field=f)").results
+    assert isinstance(rows, RowIdentifiers)
+    assert rows.keys == ["x", "y"]
+
+
+def test_rows_identifiers_unkeyed(keyed_env):
+    h, idx, ex, store = keyed_env
+    ex.execute("i", 'Set("a", n=3)')
+    (rows,) = ex.execute("i", "Rows(field=n)").results
+    assert isinstance(rows, RowIdentifiers)
+    assert rows.rows == [3]
+
+
+def test_keyed_group_by(keyed_env):
+    h, idx, ex, store = keyed_env
+    ex.execute("i", 'Set("a", f="x") Set("b", f="y")')
+    (res,) = ex.execute("i", "GroupBy(Rows(field=f))").results
+    assert [(g.group[0].row_key, g.count) for g in res] == [("x", 1), ("y", 1)]
+
+
+def test_bool_field_translation():
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("b", FieldOptions(type="bool"))
+    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
+    ex.execute("i", "Set(1, b=true) Set(2, b=false)")
+    (t,) = ex.execute("i", "Row(b=true)").results
+    assert t.columns().tolist() == [1]
+    (f,) = ex.execute("i", "Row(b=false)").results
+    assert f.columns().tolist() == [2]
