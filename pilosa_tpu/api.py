@@ -1,0 +1,485 @@
+"""API façade between transport and engine.
+
+Mirror of the reference's API struct (api.go:39-1158): every HTTP route and
+CLI command lands here.  Single-node by default; when a cluster is
+attached, methods validate against cluster state and imports route to
+shard owners (api.go validate :93, Import :787-894).
+"""
+
+from __future__ import annotations
+
+import csv
+import datetime as dt
+import io
+from typing import Dict, List, Optional
+
+from . import __version__, pql
+from .core import timequantum
+from .core.field import FieldOptions
+from .core.fragment import SHARD_WIDTH
+from .core.holder import Holder
+from .core.translate import TranslateFile
+from .core.view import VIEW_STANDARD, view_bsi_name
+from .executor import ExecOptions, Executor, QueryResponse
+from .executor.executor import Error as ExecError
+from .executor.translate import QueryTranslator
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class QueryRequest:
+    """handler.go:21-47."""
+
+    def __init__(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[List[int]] = None,
+        column_attrs: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+        remote: bool = False,
+    ):
+        self.index = index
+        self.query = query
+        self.shards = shards
+        self.column_attrs = column_attrs
+        self.exclude_row_attrs = exclude_row_attrs
+        self.exclude_columns = exclude_columns
+        self.remote = remote
+
+
+class ImportRequest:
+    """internal/public.proto ImportRequest."""
+
+    def __init__(
+        self,
+        index: str,
+        field: str,
+        shard: int = 0,
+        row_ids: Optional[List[int]] = None,
+        column_ids: Optional[List[int]] = None,
+        row_keys: Optional[List[str]] = None,
+        column_keys: Optional[List[str]] = None,
+        timestamps: Optional[List[Optional[int]]] = None,
+    ):
+        self.index = index
+        self.field = field
+        self.shard = shard
+        self.row_ids = row_ids or []
+        self.column_ids = column_ids or []
+        self.row_keys = row_keys or []
+        self.column_keys = column_keys or []
+        self.timestamps = timestamps or []
+
+
+class ImportValueRequest:
+    def __init__(
+        self,
+        index: str,
+        field: str,
+        shard: int = 0,
+        column_ids: Optional[List[int]] = None,
+        column_keys: Optional[List[str]] = None,
+        values: Optional[List[int]] = None,
+    ):
+        self.index = index
+        self.field = field
+        self.shard = shard
+        self.column_ids = column_ids or []
+        self.column_keys = column_keys or []
+        self.values = values or []
+
+
+class API:
+    def __init__(
+        self,
+        holder: Optional[Holder] = None,
+        translate_store: Optional[TranslateFile] = None,
+        cluster=None,
+        node=None,
+        stats=None,
+        tracer=None,
+        mesh_engine=None,
+    ):
+        self.holder = holder if holder is not None else Holder()
+        if not self.holder.opened:
+            self.holder.open()
+        self.translate_store = (
+            translate_store if translate_store is not None else TranslateFile()
+        )
+        self.cluster = cluster
+        self._node = node
+        self.executor = Executor(
+            self.holder,
+            cluster=cluster,
+            node=node,
+            translator=QueryTranslator(self.translate_store),
+            stats=stats,
+            tracer=tracer,
+        )
+        self.mesh_engine = mesh_engine
+
+    # -- queries (api.go Query :102) ---------------------------------------
+
+    def query(self, req: QueryRequest) -> QueryResponse:
+        opt = ExecOptions(
+            remote=req.remote,
+            exclude_row_attrs=req.exclude_row_attrs,
+            exclude_columns=req.exclude_columns,
+            column_attrs=req.column_attrs,
+        )
+        return self.executor.execute(req.index, req.query, req.shards, opt)
+
+    # -- schema (api.go :129-386, 625-687) ---------------------------------
+
+    def create_index(
+        self, name: str, keys: bool = False, track_existence: bool = True
+    ):
+        idx = self.holder.create_index(
+            name, keys=keys, track_existence=track_existence
+        )
+        self._broadcast({"type": "create-index", "index": name, "meta": {"keys": keys}})
+        return idx
+
+    def index(self, name: str):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {name}")
+        return idx
+
+    def delete_index(self, name: str):
+        self.holder.delete_index(name)
+        self._broadcast({"type": "delete-index", "index": name})
+
+    def create_field(self, index_name: str, field_name: str, options=None):
+        idx = self.index(index_name)
+        if isinstance(options, dict):
+            options = FieldOptions.from_dict(options)
+        f = idx.create_field(field_name, options)
+        self._broadcast(
+            {
+                "type": "create-field",
+                "index": index_name,
+                "field": field_name,
+                "meta": f.options.to_dict(),
+            }
+        )
+        return f
+
+    def field(self, index_name: str, field_name: str):
+        f = self.index(index_name).field(field_name)
+        if f is None:
+            raise NotFoundError(f"field not found: {field_name}")
+        return f
+
+    def delete_field(self, index_name: str, field_name: str):
+        self.index(index_name).delete_field(field_name)
+        self._broadcast(
+            {"type": "delete-field", "index": index_name, "field": field_name}
+        )
+
+    def schema(self) -> List[dict]:
+        return self.holder.schema()
+
+    def views(self, index_name: str, field_name: str) -> List[str]:
+        return sorted(self.field(index_name, field_name).views)
+
+    def delete_view(self, index_name: str, field_name: str, view_name: str):
+        f = self.field(index_name, field_name)
+        v = f.views.pop(view_name, None)
+        if v is None:
+            raise NotFoundError(f"view not found: {view_name}")
+        v.close()
+        import os
+        import shutil
+
+        if v.path and os.path.isdir(v.path):
+            shutil.rmtree(v.path)
+        self._broadcast(
+            {
+                "type": "delete-view",
+                "index": index_name,
+                "field": field_name,
+                "view": view_name,
+            }
+        )
+
+    # -- imports (api.go Import :787, ImportValue :895, ImportRoaring :290) -
+
+    def import_bits(self, req: ImportRequest):
+        """Bulk bit import: translate keys, set existence, group to views.
+        With a cluster, bits are grouped by shard and forwarded to each
+        owner (api.go:835-860) — the transport layer calls this with
+        pre-sharded requests and remote=True."""
+        idx = self.index(req.index)
+        f = self.field(req.index, req.field)
+        col_ids = list(req.column_ids)
+        row_ids = list(req.row_ids)
+        if req.column_keys:
+            if not idx.keys:
+                raise ApiError("importing keys into unkeyed index")
+            col_ids = self.translate_store.translate_columns_to_uint64(
+                req.index, req.column_keys
+            )
+        if req.row_keys:
+            if not f.options.keys:
+                raise ApiError("importing keys into unkeyed field")
+            row_ids = self.translate_store.translate_rows_to_uint64(
+                req.index, req.field, req.row_keys
+            )
+        timestamps = None
+        if req.timestamps and any(t for t in req.timestamps):
+            timestamps = [
+                dt.datetime.fromtimestamp(t, dt.timezone.utc).replace(tzinfo=None)
+                if t
+                else None
+                for t in req.timestamps
+            ]
+        ef = idx.existence_field()
+        if ef is not None and col_ids:
+            ef.import_bulk([0] * len(col_ids), col_ids)
+        f.import_bulk(row_ids, col_ids, timestamps)
+
+    def import_values(self, req: ImportValueRequest):
+        idx = self.index(req.index)
+        f = self.field(req.index, req.field)
+        col_ids = list(req.column_ids)
+        if req.column_keys:
+            if not idx.keys:
+                raise ApiError("importing keys into unkeyed index")
+            col_ids = self.translate_store.translate_columns_to_uint64(
+                req.index, req.column_keys
+            )
+        ef = idx.existence_field()
+        if ef is not None and col_ids:
+            ef.import_bulk([0] * len(col_ids), col_ids)
+        f.import_values(col_ids, req.values)
+
+    def import_roaring(
+        self, index_name: str, field_name: str, shard: int, data: bytes, view: str = VIEW_STANDARD
+    ) -> int:
+        """Union a serialized roaring bitmap into a fragment — the fast
+        ingest path (api.go:290-349)."""
+        idx = self.index(index_name)
+        f = self.field(index_name, field_name)
+        v = f.view_if_not_exists(view)
+        frag = v.fragment_if_not_exists(shard)
+        n = frag.import_roaring(data)
+        ef = idx.existence_field()
+        if ef is not None:
+            from .roaring import codec
+
+            positions = codec.deserialize(data).values
+            if positions.size:
+                base = shard * SHARD_WIDTH
+                cols = (positions % SHARD_WIDTH) + base
+                ef.import_bulk([0] * len(cols), cols.tolist())
+        return n
+
+    # -- export (api.go ExportCSV :416) ------------------------------------
+
+    def export_csv(self, index_name: str, field_name: str, shard: int, w) -> None:
+        idx = self.index(index_name)
+        f = self.field(index_name, field_name)
+        frag = self.holder.fragment(index_name, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        writer = csv.writer(w)
+        base = shard * SHARD_WIDTH
+        for row_id in frag.row_ids():
+            import numpy as np
+
+            from .ops import bitops
+
+            for pos in bitops.words_to_positions(frag.rows[row_id].view("<u4")):
+                col = base + int(pos)
+                if f.options.keys:
+                    row_out = self.translate_store.translate_row_to_string(
+                        index_name, field_name, row_id
+                    )
+                else:
+                    row_out = row_id
+                if idx.keys:
+                    col_out = self.translate_store.translate_column_to_string(
+                        index_name, col
+                    )
+                else:
+                    col_out = col
+                writer.writerow([row_out, col_out])
+
+    # -- shards / fragments (api.go :493-563, 992-1010) --------------------
+
+    def shard_nodes(self, index_name: str, shard: int) -> List[dict]:
+        if self.cluster is not None:
+            return [n.to_dict() for n in self.cluster.shard_nodes(index_name, shard)]
+        return [self.node()]
+
+    def max_shards(self) -> Dict[str, int]:
+        out = {}
+        for name, idx in self.holder.indexes.items():
+            shards = list(idx.available_shards())
+            out[name] = max(shards) if shards else 0
+        return out
+
+    def available_shards_by_index(self) -> Dict[str, List[int]]:
+        return {
+            name: [int(s) for s in idx.available_shards()]
+            for name, idx in self.holder.indexes.items()
+        }
+
+    def fragment_blocks(
+        self, index_name: str, field_name: str, view_name: str, shard: int
+    ):
+        frag = self.holder.fragment(index_name, field_name, view_name, shard)
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        return [
+            {"id": blk, "checksum": digest.hex()}
+            for blk, digest in frag.checksum_blocks()
+        ]
+
+    def fragment_block_data(
+        self, index_name: str, field_name: str, view_name: str, shard: int, block: int
+    ):
+        frag = self.holder.fragment(index_name, field_name, view_name, shard)
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        rows, cols = frag.block_data(block)
+        return {"rows": rows.tolist(), "cols": cols.tolist()}
+
+    def delete_available_shard(self, index_name, field_name, shard: int):
+        f = self.field(index_name, field_name)
+        from .roaring import Bitmap
+
+        remaining = set(f.remote_available_shards) - {shard}
+        f.remote_available_shards = Bitmap(remaining)
+        f._save_available_shards()
+
+    def recalculate_caches(self):
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        frag.cache.recalculate()
+        self._broadcast({"type": "recalculate-caches"})
+
+    # -- attr diff (api.go :689-786) ----------------------------------------
+
+    def index_attr_diff(self, index_name: str, blocks: List[dict]) -> Dict[int, dict]:
+        idx = self.index(index_name)
+        return _attr_diff(idx.column_attr_store, blocks)
+
+    def field_attr_diff(
+        self, index_name: str, field_name: str, blocks: List[dict]
+    ) -> Dict[int, dict]:
+        f = self.field(index_name, field_name)
+        return _attr_diff(f.row_attr_store, blocks)
+
+    # -- cluster admin (api.go :564-623, 1057-1123) ------------------------
+
+    def hosts(self) -> List[dict]:
+        if self.cluster is not None:
+            return [n.to_dict() for n in self.cluster.nodes]
+        return [self.node()]
+
+    def node(self) -> dict:
+        if self._node is not None:
+            return self._node.to_dict()
+        return {"id": "local", "uri": "http://localhost:10101", "isCoordinator": True}
+
+    def state(self) -> str:
+        if self.cluster is not None:
+            return self.cluster.state
+        return "NORMAL"
+
+    def version(self) -> str:
+        return __version__
+
+    def info(self) -> dict:
+        return {"shardWidth": SHARD_WIDTH}
+
+    def cluster_message(self, msg: dict):
+        """Receive a broadcast control-plane message (server.go:485-580)."""
+        typ = msg.get("type")
+        if typ == "create-index":
+            self.holder.create_index_if_not_exists(
+                msg["index"], keys=msg.get("meta", {}).get("keys", False)
+            )
+        elif typ == "delete-index":
+            if self.holder.index(msg["index"]) is not None:
+                self.holder.delete_index(msg["index"])
+        elif typ == "create-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.create_field_if_not_exists(
+                    msg["field"], FieldOptions.from_dict(msg.get("meta", {}))
+                )
+        elif typ == "delete-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None and idx.field(msg["field"]) is not None:
+                idx.delete_field(msg["field"])
+        elif typ == "create-shard":
+            idx = self.holder.index(msg["index"])
+            f = idx.field(msg["field"]) if idx else None
+            if f is not None:
+                from .roaring import Bitmap
+
+                f.add_remote_available_shards(Bitmap([msg["shard"]]))
+        elif typ == "recalculate-caches":
+            for idx in self.holder.indexes.values():
+                for f in idx.fields.values():
+                    for v in f.views.values():
+                        for frag in v.fragments.values():
+                            frag.cache.recalculate()
+        elif self.cluster is not None:
+            self.cluster.receive_message(msg)
+
+    def set_coordinator(self, node_id: str):
+        if self.cluster is None:
+            raise ApiError("not clustered")
+        return self.cluster.set_coordinator(node_id)
+
+    def remove_node(self, node_id: str):
+        if self.cluster is None:
+            raise ApiError("not clustered")
+        return self.cluster.remove_node(node_id)
+
+    def resize_abort(self):
+        if self.cluster is None:
+            raise ApiError("not clustered")
+        self.cluster.abort_resize()
+
+    # -- translation (api.go :1124-1166) ------------------------------------
+
+    def get_translate_data(self, offset: int) -> bytes:
+        return self.translate_store.reader(offset)
+
+    def translate_keys(self, index: str, field: str, keys: List[str]) -> List[int]:
+        if field:
+            return self.translate_store.translate_rows_to_uint64(index, field, keys)
+        return self.translate_store.translate_columns_to_uint64(index, keys)
+
+    # -- internals ----------------------------------------------------------
+
+    def _broadcast(self, msg: dict):
+        if self.cluster is not None:
+            self.cluster.send_sync(msg)
+
+
+def _attr_diff(store, blocks: List[dict]) -> Dict[int, dict]:
+    """Attrs in local blocks whose checksums differ from the peer's
+    (api.go:689-786)."""
+    peer = {b["id"]: bytes.fromhex(b["checksum"]) for b in blocks}
+    out: Dict[int, dict] = {}
+    for blk, digest in store.blocks():
+        if peer.get(blk) == digest:
+            continue
+        out.update(store.block_data(blk))
+    return out

@@ -118,6 +118,9 @@ class TranslateFile:
         self._rows: Dict[Tuple[str, str], _KeyMap] = {}
         self._file = None
         self._size = 0
+        # In-memory stores keep the log in a buffer so reader()/replication
+        # still work without a file.
+        self._membuf = io.BytesIO() if path is None else None
         # Callbacks fired on append (the HTTP layer notifies streaming
         # replica readers, translate.go WriteNotify :258).
         self._write_listeners = []
@@ -156,6 +159,8 @@ class TranslateFile:
         if self._file is not None:
             self._file.write(data)
             self._file.flush()
+        elif self._membuf is not None:
+            self._membuf.write(data)
         self._size += len(data)
         for fn in list(self._write_listeners):
             fn()
@@ -229,7 +234,7 @@ class TranslateFile:
     def reader(self, offset: int) -> bytes:
         """Raw log bytes from offset (the /internal/translate/data body)."""
         if self.path is None:
-            raise TranslateError("in-memory translate store has no log")
+            return self._membuf.getvalue()[offset:]
         with open(self.path, "rb") as f:
             f.seek(offset)
             return f.read()

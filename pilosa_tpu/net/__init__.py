@@ -1,0 +1,4 @@
+from .server import Handler, serve
+from .client import InternalClient
+
+__all__ = ["Handler", "InternalClient", "serve"]

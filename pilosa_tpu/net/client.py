@@ -1,0 +1,216 @@
+"""InternalClient: node-to-node + CLI HTTP client.
+
+Mirror of the reference's InternalClient (http/client.go:69-1007 and the
+root-pkg interface client.go:32-60): query forwarding, imports, schema
+ensure, fragment block sync, whole-shard retrieval, cluster messages, and
+translate-log streaming — stdlib urllib only.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+from urllib.error import HTTPError, URLError
+from urllib.request import Request, urlopen
+
+
+class ClientError(Exception):
+    pass
+
+
+class InternalClient:
+    def __init__(self, uri: str, timeout: float = 30.0):
+        self.uri = uri.rstrip("/")
+        self.timeout = timeout
+
+    # -- low level ---------------------------------------------------------
+
+    def _do(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        content_type: str = "application/json",
+        raw: bool = False,
+    ):
+        req = Request(
+            self.uri + path,
+            data=body,
+            method=method,
+            headers={"Content-Type": content_type} if body is not None else {},
+        )
+        try:
+            with urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+        except HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise ClientError(f"{method} {path}: {e.code}: {detail}") from e
+        except URLError as e:
+            raise ClientError(f"{method} {path}: {e.reason}") from e
+        if raw:
+            return data
+        return json.loads(data) if data else {}
+
+    def _get(self, path: str, raw: bool = False):
+        return self._do("GET", path, raw=raw)
+
+    def _post(self, path: str, doc=None, body: Optional[bytes] = None, raw: bool = False):
+        if body is None:
+            body = json.dumps(doc if doc is not None else {}).encode()
+            ctype = "application/json"
+        else:
+            ctype = "application/octet-stream"
+        return self._do("POST", path, body, ctype, raw=raw)
+
+    # -- queries (http/client.go Query/QueryNode :217-266) -----------------
+
+    def query(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[List[int]] = None,
+        remote: bool = False,
+        column_attrs: bool = False,
+    ) -> dict:
+        doc = {"query": query}
+        if shards is not None:
+            doc["shards"] = shards
+        if remote:
+            doc["remote"] = True
+        if column_attrs:
+            doc["columnAttrs"] = True
+        return self._post(f"/index/{index}/query", doc)
+
+    # -- schema (http/client.go EnsureIndex/EnsureField :380-437) ----------
+
+    def schema(self) -> list:
+        return self._get("/schema")["indexes"]
+
+    def create_index(self, index: str, keys: bool = False):
+        self._post(f"/index/{index}", {"options": {"keys": keys}})
+
+    def ensure_index(self, index: str, keys: bool = False):
+        try:
+            self.create_index(index, keys)
+        except ClientError as e:
+            if "exists" not in str(e):
+                raise
+
+    def create_field(self, index: str, field: str, options: Optional[dict] = None):
+        self._post(f"/index/{index}/field/{field}", {"options": options or {}})
+
+    def ensure_field(self, index: str, field: str, options: Optional[dict] = None):
+        try:
+            self.create_field(index, field, options)
+        except ClientError as e:
+            if "exists" not in str(e):
+                raise
+
+    # -- imports (http/client.go Import :292-487) --------------------------
+
+    def import_bits(
+        self,
+        index: str,
+        field: str,
+        shard: int,
+        row_ids: List[int],
+        column_ids: List[int],
+        timestamps: Optional[List[Optional[int]]] = None,
+    ):
+        doc = {"shard": shard, "rowIDs": row_ids, "columnIDs": column_ids}
+        if timestamps:
+            doc["timestamps"] = timestamps
+        self._post(f"/index/{index}/field/{field}/import", doc)
+
+    def import_keyed_bits(
+        self, index: str, field: str, row_keys: List[str], column_keys: List[str]
+    ):
+        self._post(
+            f"/index/{index}/field/{field}/import",
+            {"rowKeys": row_keys, "columnKeys": column_keys},
+        )
+
+    def import_values(
+        self, index: str, field: str, shard: int, column_ids: List[int], values: List[int]
+    ):
+        self._post(
+            f"/index/{index}/field/{field}/import",
+            {"shard": shard, "columnIDs": column_ids, "values": values},
+        )
+
+    def import_roaring(
+        self, index: str, field: str, shard: int, data: bytes, view: str = "standard"
+    ) -> int:
+        out = self._post(
+            f"/index/{index}/field/{field}/import-roaring/{shard}?view={view}",
+            body=data,
+        )
+        return out.get("changed", 0)
+
+    # -- fragment sync (http/client.go :813-904) ---------------------------
+
+    def fragment_blocks(self, index: str, field: str, view: str, shard: int) -> list:
+        return self._get(
+            f"/internal/fragment/blocks?index={index}&field={field}"
+            f"&view={view}&shard={shard}"
+        )["blocks"]
+
+    def block_data(self, index: str, field: str, view: str, shard: int, block: int) -> dict:
+        return self._get(
+            f"/internal/fragment/block/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}&block={block}"
+        )
+
+    def retrieve_shard(self, index: str, field: str, shard: int, view: str = "standard") -> bytes:
+        """Whole-fragment roaring snapshot (RetrieveShardFromURI :708)."""
+        return self._get(
+            f"/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+            raw=True,
+        )
+
+    def send_fragment(
+        self, index: str, field: str, shard: int, data: bytes, view: str = "standard"
+    ):
+        self._post(
+            f"/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+            body=data,
+        )
+
+    # -- attrs (http/client.go ColumnAttrDiff/RowAttrDiff :905-1007) -------
+
+    def index_attr_diff(self, index: str, blocks: list) -> dict:
+        return self._post(f"/internal/index/{index}/attr/diff", {"blocks": blocks})[
+            "attrs"
+        ]
+
+    def field_attr_diff(self, index: str, field: str, blocks: list) -> dict:
+        return self._post(
+            f"/internal/index/{index}/field/{field}/attr/diff", {"blocks": blocks}
+        )["attrs"]
+
+    # -- cluster -----------------------------------------------------------
+
+    def send_message(self, msg: dict):
+        self._post("/internal/cluster/message", msg)
+
+    def nodes(self) -> list:
+        return self._get("/internal/nodes")
+
+    def status(self) -> dict:
+        return self._get("/status")
+
+    def max_shards(self) -> dict:
+        return self._get("/internal/shards/max")["standard"]
+
+    # -- translation -------------------------------------------------------
+
+    def translate_data(self, offset: int) -> bytes:
+        return self._get(f"/internal/translate/data?offset={offset}", raw=True)
+
+    def translate_keys(self, index: str, field: str, keys: List[str]) -> List[int]:
+        return self._post(
+            "/internal/translate/keys",
+            {"index": index, "field": field, "keys": keys},
+        )["ids"]

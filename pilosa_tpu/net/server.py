@@ -1,0 +1,374 @@
+"""HTTP server: the reference's route table on stdlib http.server.
+
+Routes mirror http/handler.go:237-272 — public JSON API plus /internal/*
+node-to-node endpoints.  gorilla/mux becomes a regex route table; the
+wire format is JSON throughout (the reference negotiates protobuf for
+query/import; JSON is its canonical public format and what its own
+examples use).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api import API, ApiError, ImportRequest, ImportValueRequest, NotFoundError, QueryRequest
+from ..executor.executor import Error as ExecError, FieldNotFoundError, IndexNotFoundError
+from ..executor.translate import TranslateError
+from ..pql import ParseError
+from .wire import response_to_json
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, fn: Callable):
+        self.method = method
+        self.regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$"
+        )
+        self.fn = fn
+
+
+class Handler:
+    """Dispatches requests to the API (http/handler.go Handler)."""
+
+    def __init__(self, api: API, logger=None):
+        self.api = api
+        self.logger = logger
+        self.routes: List[Route] = []
+        r = self._route
+        # Public routes (http/handler.go:237-259).
+        r("GET", "/", self._home)
+        r("GET", "/version", lambda q, b, **kw: {"version": self.api.version()})
+        r("GET", "/info", lambda q, b, **kw: self.api.info())
+        r("GET", "/schema", lambda q, b, **kw: {"indexes": self.api.schema()})
+        r("GET", "/status", self._status)
+        r("GET", "/index", lambda q, b, **kw: {"indexes": self.api.schema()})
+        r("GET", "/index/{index}", self._get_index)
+        r("POST", "/index/{index}", self._post_index)
+        r("DELETE", "/index/{index}", self._delete_index)
+        r("POST", "/index/{index}/field/{field}", self._post_field)
+        r("DELETE", "/index/{index}/field/{field}", self._delete_field)
+        r("POST", "/index/{index}/field/{field}/import", self._post_import)
+        r(
+            "POST",
+            "/index/{index}/field/{field}/import-roaring/{shard}",
+            self._post_import_roaring,
+        )
+        r("POST", "/index/{index}/query", self._post_query)
+        r("GET", "/export", self._get_export)
+        r("POST", "/recalculate-caches", self._recalculate_caches)
+        r("POST", "/cluster/resize/abort", self._resize_abort)
+        r("POST", "/cluster/resize/remove-node", self._remove_node)
+        r("POST", "/cluster/resize/set-coordinator", self._set_coordinator)
+        r("GET", "/debug/vars", self._debug_vars)
+        # Internal routes (http/handler.go:262-272).
+        r("POST", "/internal/cluster/message", self._cluster_message)
+        r("GET", "/internal/fragment/blocks", self._fragment_blocks)
+        r("GET", "/internal/fragment/block/data", self._fragment_block_data)
+        r("GET", "/internal/fragment/nodes", self._fragment_nodes)
+        r("GET", "/internal/nodes", lambda q, b, **kw: self.api.hosts())
+        r("GET", "/internal/shards/max", lambda q, b, **kw: {"standard": self.api.max_shards()})
+        r("POST", "/internal/index/{index}/attr/diff", self._index_attr_diff)
+        r(
+            "POST",
+            "/internal/index/{index}/field/{field}/attr/diff",
+            self._field_attr_diff,
+        )
+        r(
+            "DELETE",
+            "/internal/index/{index}/field/{field}/remote-available-shards/{shardID}",
+            self._delete_remote_available_shard,
+        )
+        r("GET", "/internal/translate/data", self._translate_data)
+        r("POST", "/internal/translate/keys", self._translate_keys)
+        r("POST", "/internal/fragment/data", self._post_fragment_data)
+        r("GET", "/internal/fragment/data", self._get_fragment_data)
+
+    def _route(self, method, pattern, fn):
+        self.routes.append(Route(method, pattern, fn))
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle(self, method: str, path: str, query: dict, body: bytes):
+        """Returns (status, content_type, payload bytes)."""
+        for route in self.routes:
+            if route.method != method:
+                continue
+            m = route.regex.match(path)
+            if m is None:
+                continue
+            try:
+                result = route.fn(query, body, **m.groupdict())
+            except (NotFoundError, IndexNotFoundError, FieldNotFoundError) as e:
+                return 404, "application/json", json.dumps({"error": str(e)}).encode()
+            except (ApiError, ExecError, ParseError, TranslateError, ValueError) as e:
+                return 400, "application/json", json.dumps({"error": str(e)}).encode()
+            except Exception as e:  # panic recovery (http/handler.go)
+                traceback.print_exc()
+                return 500, "application/json", json.dumps({"error": str(e)}).encode()
+            if isinstance(result, bytes):
+                return 200, "application/octet-stream", result
+            if isinstance(result, str):
+                return 200, "text/plain", result.encode()
+            return 200, "application/json", json.dumps(result).encode()
+        return 404, "application/json", b'{"error": "not found"}'
+
+    # -- handlers ----------------------------------------------------------
+
+    def _home(self, q, b, **kw):
+        return {"name": "pilosa-tpu", "version": self.api.version()}
+
+    def _status(self, q, b, **kw):
+        return {
+            "state": self.api.state(),
+            "nodes": self.api.hosts(),
+            "localID": self.api.node()["id"],
+        }
+
+    def _get_index(self, q, b, *, index, **kw):
+        idx = self.api.index(index)
+        return {"name": index, "options": {"keys": idx.keys}}
+
+    def _post_index(self, q, b, *, index, **kw):
+        doc = json.loads(b) if b else {}
+        opts = doc.get("options", {})
+        self.api.create_index(
+            index,
+            keys=opts.get("keys", False),
+            track_existence=opts.get("trackExistence", True),
+        )
+        return {}
+
+    def _delete_index(self, q, b, *, index, **kw):
+        self.api.delete_index(index)
+        return {}
+
+    def _post_field(self, q, b, *, index, field, **kw):
+        doc = json.loads(b) if b else {}
+        self.api.create_field(index, field, doc.get("options"))
+        return {}
+
+    def _delete_field(self, q, b, *, index, field, **kw):
+        self.api.delete_field(index, field)
+        return {}
+
+    def _post_query(self, q, b, *, index, **kw):
+        doc = json.loads(b) if b else {}
+        if isinstance(doc, str):  # raw PQL body
+            doc = {"query": doc}
+        shards = doc.get("shards") or _parse_shards(q)
+        req = QueryRequest(
+            index,
+            doc.get("query", ""),
+            shards=shards,
+            column_attrs=_qbool(q, "columnAttrs") or doc.get("columnAttrs", False),
+            exclude_row_attrs=_qbool(q, "excludeRowAttrs")
+            or doc.get("excludeRowAttrs", False),
+            exclude_columns=_qbool(q, "excludeColumns")
+            or doc.get("excludeColumns", False),
+            remote=_qbool(q, "remote") or doc.get("remote", False),
+        )
+        return response_to_json(self.api.query(req))
+
+    def _post_import(self, q, b, *, index, field, **kw):
+        doc = json.loads(b)
+        if "values" in doc:
+            self.api.import_values(
+                ImportValueRequest(
+                    index,
+                    field,
+                    shard=doc.get("shard", 0),
+                    column_ids=doc.get("columnIDs"),
+                    column_keys=doc.get("columnKeys"),
+                    values=doc.get("values"),
+                )
+            )
+        else:
+            self.api.import_bits(
+                ImportRequest(
+                    index,
+                    field,
+                    shard=doc.get("shard", 0),
+                    row_ids=doc.get("rowIDs"),
+                    column_ids=doc.get("columnIDs"),
+                    row_keys=doc.get("rowKeys"),
+                    column_keys=doc.get("columnKeys"),
+                    timestamps=doc.get("timestamps"),
+                )
+            )
+        return {}
+
+    def _post_import_roaring(self, q, b, *, index, field, shard, **kw):
+        view = q.get("view", ["standard"])[0]
+        n = self.api.import_roaring(index, field, int(shard), b, view=view)
+        return {"changed": n}
+
+    def _get_export(self, q, b, **kw):
+        import io
+
+        index = q.get("index", [""])[0]
+        field = q.get("field", [""])[0]
+        shard = int(q.get("shard", ["0"])[0])
+        buf = io.StringIO()
+        self.api.export_csv(index, field, shard, buf)
+        return buf.getvalue()
+
+    def _recalculate_caches(self, q, b, **kw):
+        self.api.recalculate_caches()
+        return {}
+
+    def _resize_abort(self, q, b, **kw):
+        self.api.resize_abort()
+        return {}
+
+    def _remove_node(self, q, b, **kw):
+        doc = json.loads(b) if b else {}
+        node = self.api.remove_node(doc.get("id", ""))
+        return {"remove": node}
+
+    def _set_coordinator(self, q, b, **kw):
+        doc = json.loads(b) if b else {}
+        old, new = self.api.set_coordinator(doc.get("id", ""))
+        return {"old": old, "new": new}
+
+    def _debug_vars(self, q, b, **kw):
+        stats = getattr(self.api.executor, "stats", None)
+        if stats is not None and hasattr(stats, "snapshot"):
+            return stats.snapshot()
+        return {}
+
+    def _cluster_message(self, q, b, **kw):
+        self.api.cluster_message(json.loads(b))
+        return {}
+
+    def _fragment_blocks(self, q, b, **kw):
+        return {
+            "blocks": self.api.fragment_blocks(
+                q["index"][0], q["field"][0], q["view"][0], int(q["shard"][0])
+            )
+        }
+
+    def _fragment_block_data(self, q, b, **kw):
+        return self.api.fragment_block_data(
+            q["index"][0],
+            q["field"][0],
+            q["view"][0],
+            int(q["shard"][0]),
+            int(q["block"][0]),
+        )
+
+    def _fragment_nodes(self, q, b, **kw):
+        return self.api.shard_nodes(q["index"][0], int(q["shard"][0]))
+
+    def _index_attr_diff(self, q, b, *, index, **kw):
+        doc = json.loads(b)
+        attrs = self.api.index_attr_diff(index, doc.get("blocks", []))
+        return {"attrs": {str(k): v for k, v in attrs.items()}}
+
+    def _field_attr_diff(self, q, b, *, index, field, **kw):
+        doc = json.loads(b)
+        attrs = self.api.field_attr_diff(index, field, doc.get("blocks", []))
+        return {"attrs": {str(k): v for k, v in attrs.items()}}
+
+    def _delete_remote_available_shard(self, q, b, *, index, field, shardID, **kw):
+        self.api.delete_available_shard(index, field, int(shardID))
+        return {}
+
+    def _translate_data(self, q, b, **kw):
+        offset = int(q.get("offset", ["0"])[0])
+        return self.api.get_translate_data(offset)
+
+    def _translate_keys(self, q, b, **kw):
+        doc = json.loads(b)
+        ids = self.api.translate_keys(
+            doc.get("index", ""), doc.get("field", ""), doc.get("keys", [])
+        )
+        return {"ids": ids}
+
+    def _post_fragment_data(self, q, b, **kw):
+        """Whole-fragment ingest for resize/sync (cluster.go:1251-1347)."""
+        n = self.api.import_roaring(
+            q["index"][0],
+            q["field"][0],
+            int(q["shard"][0]),
+            b,
+            view=q.get("view", ["standard"])[0],
+        )
+        return {"changed": n}
+
+    def _get_fragment_data(self, q, b, **kw):
+        """Whole-fragment export (http/client.go RetrieveShardFromURI :708)."""
+        frag = self.api.holder.fragment(
+            q["index"][0],
+            q["field"][0],
+            q.get("view", ["standard"])[0],
+            int(q["shard"][0]),
+        )
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        from ..roaring import codec
+
+        return codec.serialize(frag.positions())
+
+
+def _qbool(q: dict, name: str) -> bool:
+    return q.get(name, ["false"])[0].lower() == "true"
+
+
+def _parse_shards(q: dict) -> Optional[List[int]]:
+    raw = q.get("shards", [""])[0]
+    if not raw:
+        return None
+    return [int(s) for s in raw.split(",")]
+
+
+class _HTTPRequestHandler(BaseHTTPRequestHandler):
+    handler: Handler = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _dispatch(self, method):
+        parsed = urlparse(self.path)
+        query = parse_qs(parsed.query)
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        status, ctype, payload = self.handler.handle(
+            method, parsed.path, query, body
+        )
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+def serve(
+    api: API, host: str = "localhost", port: int = 10101
+) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Start the HTTP server on a background thread; returns (server,
+    thread).  port=0 binds an ephemeral port (test harness pattern,
+    test/pilosa.go:38-103)."""
+    handler = Handler(api)
+    cls = type(
+        "_BoundHandler", (_HTTPRequestHandler,), {"handler": handler}
+    )
+    srv = ThreadingHTTPServer((host, port), cls)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    return srv, thread

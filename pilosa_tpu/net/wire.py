@@ -1,0 +1,51 @@
+"""Wire serialization of query results.
+
+JSON shapes mirror the reference's MarshalJSON implementations
+(http/handler.go QueryResponse :30-77, row.go, executor.go FieldRow
+:982-1001): Row -> {attrs, columns|keys}, ValCount -> {value, count},
+TopN pairs -> [{id|key, count}], Rows -> {rows|keys}, GroupBy ->
+[{group, count}].
+"""
+
+from __future__ import annotations
+
+from ..core.row import Row
+from ..executor import GroupCount, RowIdentifiers, ValCount
+
+
+def result_to_json(result):
+    if result is None:
+        return None
+    if isinstance(result, Row):
+        out = {"attrs": result.attrs or {}}
+        if result.keys is not None:
+            out["keys"] = result.keys
+        else:
+            out["columns"] = [int(c) for c in result.columns()]
+        return out
+    if isinstance(result, bool):
+        return result
+    if isinstance(result, int):
+        return result
+    if isinstance(result, ValCount):
+        return result.to_dict()
+    if isinstance(result, RowIdentifiers):
+        return result.to_dict()
+    if isinstance(result, list):
+        if result and isinstance(result[0], tuple):
+            # TopN pairs: (id_or_key, count)
+            return [
+                {("key" if isinstance(i, str) else "id"): i, "count": c}
+                for i, c in result
+            ]
+        if result and isinstance(result[0], GroupCount):
+            return [g.to_dict() for g in result]
+        return result
+    return result
+
+
+def response_to_json(resp) -> dict:
+    out = {"results": [result_to_json(r) for r in resp.results]}
+    if resp.column_attr_sets is not None:
+        out["columnAttrs"] = [c.to_dict() for c in resp.column_attr_sets]
+    return out

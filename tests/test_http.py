@@ -1,0 +1,157 @@
+"""HTTP API tests: real server on an ephemeral port + InternalClient
+(the reference's handler tests via test/handler.go + http/client.go)."""
+
+import json
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.net import InternalClient, serve
+from pilosa_tpu.net.client import ClientError
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.roaring import Bitmap
+
+
+@pytest.fixture
+def server():
+    api = API()
+    srv, thread = serve(api, port=0)
+    uri = f"http://localhost:{srv.server_address[1]}"
+    yield api, InternalClient(uri)
+    srv.shutdown()
+
+
+def test_version_and_schema(server):
+    api, client = server
+    assert client.status()["state"] == "NORMAL"
+    client.create_index("i")
+    client.create_field("i", "f", {"type": "set"})
+    schema = client.schema()
+    assert schema[0]["name"] == "i"
+    assert schema[0]["fields"][0]["name"] == "f"
+
+
+def test_query_roundtrip(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    out = client.query("i", "Set(1, f=10) Set(2, f=10)")
+    assert out["results"] == [True, True]
+    out = client.query("i", "Row(f=10)")
+    assert out["results"][0]["columns"] == [1, 2]
+    out = client.query("i", "Count(Row(f=10))")
+    assert out["results"] == [2]
+    out = client.query("i", "TopN(f, n=1)")
+    assert out["results"][0] == [{"id": 10, "count": 2}]
+
+
+def test_query_shards_arg(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", f"Set(1, f=10) Set({SHARD_WIDTH+1}, f=10)")
+    out = client.query("i", "Count(Row(f=10))", shards=[1])
+    assert out["results"] == [1]
+
+
+def test_import_endpoint(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.import_bits("i", "f", 0, [7, 7, 8], [1, 2, 3])
+    out = client.query("i", "Row(f=7)")
+    assert out["results"][0]["columns"] == [1, 2]
+
+
+def test_import_values_endpoint(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "v", {"type": "int", "min": 0, "max": 100})
+    client.import_values("i", "v", 0, [1, 2], [10, 20])
+    out = client.query("i", "Sum(field=v)")
+    assert out["results"][0] == {"value": 30, "count": 2}
+
+
+def test_import_roaring_endpoint(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    # row 4, cols 0..2 -> positions row*2^20 + col
+    bm = Bitmap([4 * SHARD_WIDTH + c for c in (0, 1, 2)])
+    changed = client.import_roaring("i", "f", 0, bm.to_bytes())
+    assert changed == 3
+    out = client.query("i", "Row(f=4)")
+    assert out["results"][0]["columns"] == [0, 1, 2]
+
+
+def test_fragment_blocks_and_data(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", "Set(5, f=1)")
+    blocks = client.fragment_blocks("i", "f", "standard", 0)
+    assert blocks[0]["id"] == 0
+    data = client.block_data("i", "f", "standard", 0, 0)
+    assert data == {"rows": [1], "cols": [5]}
+
+
+def test_retrieve_and_send_fragment(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", "Set(3, f=9)")
+    raw = client.retrieve_shard("i", "f", 0)
+    client.create_index("j")
+    client.create_field("j", "f")
+    client.send_fragment("j", "f", 0, raw)
+    out = client.query("j", "Row(f=9)")
+    assert out["results"][0]["columns"] == [3]
+
+
+def test_export_csv(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", "Set(1, f=10) Set(2, f=11)")
+    csv_text = client._get("/export?index=i&field=f&shard=0", raw=True).decode()
+    lines = sorted(csv_text.strip().splitlines())
+    assert lines == ["10,1", "11,2"]
+
+
+def test_error_statuses(server):
+    api, client = server
+    with pytest.raises(ClientError) as e:
+        client.query("missing", "Row(f=1)")
+    assert "404" in str(e.value)
+    client.create_index("i")
+    with pytest.raises(ClientError) as e:
+        client.query("i", "NotACall???")
+    assert "400" in str(e.value)
+
+
+def test_translate_endpoints(server):
+    api, client = server
+    ids = client.translate_keys("i", "", ["a", "b"])
+    assert ids == [1, 2]
+    data = client.translate_data(0)
+    assert len(data) > 0
+    ids2 = client.translate_keys("i", "f", ["x"])
+    assert ids2 == [1]
+
+
+def test_cluster_message_schema_sync(server):
+    api, client = server
+    client.send_message(
+        {"type": "create-index", "index": "remote_idx", "meta": {"keys": False}}
+    )
+    assert api.holder.index("remote_idx") is not None
+
+
+def test_delete_endpoints(server):
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    client._do("DELETE", "/index/i/field/f")
+    assert api.holder.index("i").field("f") is None
+    client._do("DELETE", "/index/i")
+    assert api.holder.index("i") is None
