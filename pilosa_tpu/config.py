@@ -1,0 +1,215 @@
+"""Server configuration: TOML file + env vars + CLI flags.
+
+Mirror of the reference's Config (server/config.go:36-152) with the same
+TOML key names and precedence (flags > env > file > defaults,
+cmd/server.go).  Env vars use the reference's convention with the
+PILOSA_TPU_ prefix: ``PILOSA_TPU_DATA_DIR``, ``PILOSA_TPU_BIND``,
+``PILOSA_TPU_CLUSTER_COORDINATOR``, ...
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+ENV_PREFIX = "PILOSA_TPU_"
+
+
+def _parse_duration(v) -> float:
+    """Go-style duration strings ("10m", "1h30m", "500ms") -> seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    import re
+
+    total = 0.0
+    for num, unit in re.findall(r"([0-9.]+)(ms|us|s|m|h)", v):
+        total += float(num) * {
+            "us": 1e-6,
+            "ms": 1e-3,
+            "s": 1.0,
+            "m": 60.0,
+            "h": 3600.0,
+        }[unit]
+    return total
+
+
+class Config:
+    def __init__(self):
+        # server/config.go NewConfig defaults :110-152
+        self.data_dir = "~/.pilosa-tpu"
+        self.bind = ":10101"
+        self.max_writes_per_request = 5000
+        self.log_path = ""
+        self.verbose = False
+        # cluster
+        self.cluster_disabled = False
+        self.cluster_coordinator = False
+        self.cluster_replicas = 1
+        self.cluster_hosts: List[str] = []
+        self.cluster_long_query_time = 60.0
+        # gossip (SWIM membership)
+        self.gossip_port = 14000
+        self.gossip_seeds: List[str] = []
+        self.gossip_probe_interval = 1.0
+        self.gossip_probe_timeout = 0.5
+        self.gossip_push_pull_interval = 30.0
+        self.gossip_suspicion_mult = 4
+        # anti-entropy
+        self.anti_entropy_interval = 600.0
+        # metrics
+        self.metric_service = "none"  # statsd | expvar | none
+        self.metric_host = ""
+        self.metric_poll_interval = 0.0
+        self.metric_diagnostics = True
+        # tracing
+        self.tracing_sampler_type = "none"  # profiler | span | none
+        self.tracing_sampler_param = 0.001
+        # translation
+        self.translation_primary_url = ""
+        # mesh (TPU-native: devices for the shard mesh; 0 = all)
+        self.mesh_devices = 0
+
+    # -- loading -----------------------------------------------------------
+
+    def load_file(self, path: str):
+        import tomllib
+
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        self.apply_dict(doc)
+
+    def apply_dict(self, doc: dict):
+        self.data_dir = doc.get("data-dir", self.data_dir)
+        self.bind = doc.get("bind", self.bind)
+        self.max_writes_per_request = doc.get(
+            "max-writes-per-request", self.max_writes_per_request
+        )
+        self.log_path = doc.get("log-path", self.log_path)
+        self.verbose = doc.get("verbose", self.verbose)
+        cl = doc.get("cluster", {})
+        self.cluster_disabled = cl.get("disabled", self.cluster_disabled)
+        self.cluster_coordinator = cl.get("coordinator", self.cluster_coordinator)
+        self.cluster_replicas = cl.get("replicas", self.cluster_replicas)
+        self.cluster_hosts = cl.get("hosts", self.cluster_hosts)
+        if "long-query-time" in cl:
+            self.cluster_long_query_time = _parse_duration(cl["long-query-time"])
+        g = doc.get("gossip", {})
+        self.gossip_port = int(g.get("port", self.gossip_port))
+        self.gossip_seeds = g.get("seeds", self.gossip_seeds)
+        if "probe-interval" in g:
+            self.gossip_probe_interval = _parse_duration(g["probe-interval"])
+        if "probe-timeout" in g:
+            self.gossip_probe_timeout = _parse_duration(g["probe-timeout"])
+        if "push-pull-interval" in g:
+            self.gossip_push_pull_interval = _parse_duration(
+                g["push-pull-interval"]
+            )
+        self.gossip_suspicion_mult = g.get(
+            "suspicion-mult", self.gossip_suspicion_mult
+        )
+        ae = doc.get("anti-entropy", {})
+        if "interval" in ae:
+            self.anti_entropy_interval = _parse_duration(ae["interval"])
+        m = doc.get("metric", {})
+        self.metric_service = m.get("service", self.metric_service)
+        self.metric_host = m.get("host", self.metric_host)
+        if "poll-interval" in m:
+            self.metric_poll_interval = _parse_duration(m["poll-interval"])
+        self.metric_diagnostics = m.get("diagnostics", self.metric_diagnostics)
+        t = doc.get("tracing", {})
+        self.tracing_sampler_type = t.get("sampler-type", self.tracing_sampler_type)
+        self.tracing_sampler_param = t.get(
+            "sampler-param", self.tracing_sampler_param
+        )
+        tr = doc.get("translation", {})
+        self.translation_primary_url = tr.get(
+            "primary-url", self.translation_primary_url
+        )
+        mesh = doc.get("mesh", {})
+        self.mesh_devices = mesh.get("devices", self.mesh_devices)
+
+    def load_env(self, environ=None):
+        env = environ if environ is not None else os.environ
+
+        def get(name, cast=str):
+            v = env.get(ENV_PREFIX + name)
+            if v is None:
+                return None
+            if cast is bool:
+                return v.lower() in ("1", "true", "yes")
+            if cast is list:
+                return [s for s in v.split(",") if s]
+            return cast(v)
+
+        for attr, name, cast in [
+            ("data_dir", "DATA_DIR", str),
+            ("bind", "BIND", str),
+            ("max_writes_per_request", "MAX_WRITES_PER_REQUEST", int),
+            ("log_path", "LOG_PATH", str),
+            ("verbose", "VERBOSE", bool),
+            ("cluster_disabled", "CLUSTER_DISABLED", bool),
+            ("cluster_coordinator", "CLUSTER_COORDINATOR", bool),
+            ("cluster_replicas", "CLUSTER_REPLICAS", int),
+            ("cluster_hosts", "CLUSTER_HOSTS", list),
+            ("gossip_port", "GOSSIP_PORT", int),
+            ("gossip_seeds", "GOSSIP_SEEDS", list),
+            ("anti_entropy_interval", "ANTI_ENTROPY_INTERVAL", _parse_duration),
+            ("metric_service", "METRIC_SERVICE", str),
+            ("metric_host", "METRIC_HOST", str),
+            ("tracing_sampler_type", "TRACING_SAMPLER_TYPE", str),
+            ("translation_primary_url", "TRANSLATION_PRIMARY_URL", str),
+            ("mesh_devices", "MESH_DEVICES", int),
+        ]:
+            v = get(name, cast)
+            if v is not None:
+                setattr(self, attr, v)
+
+    # -- generation (ctl/generate_config.go) -------------------------------
+
+    def to_toml(self) -> str:
+        hosts = ", ".join(f'"{h}"' for h in self.cluster_hosts)
+        seeds = ", ".join(f'"{s}"' for s in self.gossip_seeds)
+        return f"""data-dir = "{self.data_dir}"
+bind = "{self.bind}"
+max-writes-per-request = {self.max_writes_per_request}
+log-path = "{self.log_path}"
+verbose = {str(self.verbose).lower()}
+
+[cluster]
+disabled = {str(self.cluster_disabled).lower()}
+coordinator = {str(self.cluster_coordinator).lower()}
+replicas = {self.cluster_replicas}
+hosts = [{hosts}]
+long-query-time = "{int(self.cluster_long_query_time)}s"
+
+[gossip]
+port = {self.gossip_port}
+seeds = [{seeds}]
+probe-interval = "{self.gossip_probe_interval}s"
+probe-timeout = "{self.gossip_probe_timeout}s"
+push-pull-interval = "{self.gossip_push_pull_interval}s"
+suspicion-mult = {self.gossip_suspicion_mult}
+
+[anti-entropy]
+interval = "{int(self.anti_entropy_interval)}s"
+
+[metric]
+service = "{self.metric_service}"
+host = "{self.metric_host}"
+poll-interval = "{int(self.metric_poll_interval)}s"
+diagnostics = {str(self.metric_diagnostics).lower()}
+
+[tracing]
+sampler-type = "{self.tracing_sampler_type}"
+sampler-param = {self.tracing_sampler_param}
+
+[translation]
+primary-url = "{self.translation_primary_url}"
+
+[mesh]
+devices = {self.mesh_devices}
+"""
+
+    def bind_host_port(self):
+        host, _, port = self.bind.rpartition(":")
+        return host or "0.0.0.0", int(port or 10101)

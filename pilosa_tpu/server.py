@@ -1,0 +1,183 @@
+"""Server: the long-running node process.
+
+Mirror of the reference's pilosa.Server + server.Command assembly
+(server.go:100-801, server/server.go:56-414): owns the holder, translate
+store, cluster, API, and HTTP listener; Open() brings them up in the
+reference's order (translate -> cluster -> holder -> monitors,
+server.go:334-428) and spawns the anti-entropy / metrics loops.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Optional
+
+from .api import API
+from .config import Config
+from .core.holder import Holder
+from .core.translate import TranslateFile
+from .net import serve
+from .util import (
+    ExpvarStatsClient,
+    NopLogger,
+    NopStatsClient,
+    NopTracer,
+    ProfilerTracer,
+    StandardLogger,
+    Tracer,
+    VerboseLogger,
+)
+
+
+class Server:
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        self.data_dir = os.path.expanduser(self.config.data_dir)
+        self.logger = self._make_logger()
+        self.stats = self._make_stats()
+        self.tracer = self._make_tracer()
+        self.holder = Holder(os.path.join(self.data_dir))
+        self.translate_store = TranslateFile(
+            os.path.join(self.data_dir, ".keys")
+        )
+        self.cluster = None
+        self.node_id = self._load_node_id()
+        self.api: Optional[API] = None
+        self._http = None
+        self._http_thread = None
+        self._closing = threading.Event()
+        self._monitors = []
+
+    # -- assembly ----------------------------------------------------------
+
+    def _make_logger(self):
+        if self.config.verbose:
+            return VerboseLogger()
+        return StandardLogger()
+
+    def _make_stats(self):
+        svc = self.config.metric_service
+        if svc == "expvar":
+            return ExpvarStatsClient()
+        if svc == "statsd":
+            try:
+                from .util.statsd import StatsdClient
+
+                return StatsdClient(self.config.metric_host)
+            except Exception:
+                return NopStatsClient()
+        return NopStatsClient()
+
+    def _make_tracer(self):
+        t = self.config.tracing_sampler_type
+        if t == "profiler":
+            return ProfilerTracer()
+        if t == "span":
+            return Tracer(keep_finished=64)
+        return NopTracer()
+
+    def _load_node_id(self) -> str:
+        """Stable node ID persisted to .id (server.go:409)."""
+        os.makedirs(self.data_dir, exist_ok=True)
+        p = os.path.join(self.data_dir, ".id")
+        if os.path.exists(p):
+            with open(p) as f:
+                return f.read().strip()
+        node_id = uuid.uuid4().hex[:16]
+        with open(p, "w") as f:
+            f.write(node_id)
+        return node_id
+
+    # -- lifecycle (server.go Open :334) -----------------------------------
+
+    def open(self, port_override: Optional[int] = None):
+        host, port = self.config.bind_host_port()
+        if port_override is not None:
+            port = port_override
+        self.translate_store.open()
+        self._setup_cluster(host, port)
+        self.holder.open()
+        mesh_engine = None
+        self.api = API(
+            holder=self.holder,
+            translate_store=self.translate_store,
+            cluster=self.cluster,
+            stats=self.stats,
+            tracer=self.tracer,
+            mesh_engine=mesh_engine,
+        )
+        self._http, self._http_thread = serve(
+            self.api, host if host not in ("", "0.0.0.0") else "0.0.0.0", port
+        )
+        self.logger.printf(
+            "pilosa-tpu listening on %s:%d (node %s)", host, port, self.node_id
+        )
+        self._start_monitors()
+        return self
+
+    def _setup_cluster(self, host: str, port: int):
+        """Wire the cluster when hosts are configured (server/server.go
+        setupNetworking :302); single-node otherwise."""
+        if self.config.cluster_disabled or not self.config.cluster_hosts:
+            return
+        from .cluster import Cluster, Node
+
+        uri = f"http://{host or 'localhost'}:{port}"
+        self.cluster = Cluster(
+            node=Node(self.node_id, uri, self.config.cluster_coordinator),
+            replica_n=self.config.cluster_replicas,
+            hosts=self.config.cluster_hosts,
+            logger=self.logger,
+        )
+
+    @property
+    def port(self) -> int:
+        return self._http.server_address[1]
+
+    def _start_monitors(self):
+        # Cache flush ticker (holder.go cacheFlushInterval :78).
+        self._spawn(self._monitor_cache_flush, 60.0)
+        # Runtime metrics loop (server.go monitorRuntime :726).
+        if self.config.metric_poll_interval > 0:
+            self._spawn(self._monitor_runtime, self.config.metric_poll_interval)
+        # Anti-entropy requires a cluster; wired by the cluster module.
+
+    def _spawn(self, fn, interval: float):
+        def loop():
+            while not self._closing.wait(interval):
+                try:
+                    fn()
+                except Exception as e:  # monitors never kill the server
+                    self.logger.printf("monitor error: %s", e)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._monitors.append(t)
+
+    def _monitor_cache_flush(self):
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        frag.flush_cache()
+
+    def _monitor_runtime(self):
+        import resource
+
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        self.stats.gauge("maxrss_kb", usage.ru_maxrss)
+        self.stats.gauge("threads", threading.active_count())
+        try:
+            self.stats.gauge("openFiles", len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+
+    def close(self):
+        self._closing.set()
+        if self._http is not None:
+            self._http.shutdown()
+        self.holder.close()
+        self.translate_store.close()

@@ -1,0 +1,117 @@
+"""Server assembly + CLI tests (ctl/*_test.go equivalents)."""
+
+import json
+import os
+
+import pytest
+
+from pilosa_tpu.cli import main as cli_main
+from pilosa_tpu.config import Config
+from pilosa_tpu.net import InternalClient
+from pilosa_tpu.server import Server
+
+
+@pytest.fixture
+def server(tmp_path):
+    cfg = Config()
+    cfg.data_dir = str(tmp_path / "data")
+    cfg.bind = "localhost:0"
+    srv = Server(cfg).open(port_override=0)
+    yield srv
+    srv.close()
+
+
+def test_server_boots_and_serves(server):
+    client = InternalClient(f"http://localhost:{server.port}")
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", "Set(1, f=10)")
+    out = client.query("i", "Row(f=10)")
+    assert out["results"][0]["columns"] == [1]
+
+
+def test_server_restart_recovers(tmp_path):
+    cfg = Config()
+    cfg.data_dir = str(tmp_path / "data")
+    cfg.bind = "localhost:0"
+    srv = Server(cfg).open(port_override=0)
+    client = InternalClient(f"http://localhost:{srv.port}")
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", "Set(1, f=10) Set(2, f=10)")
+    node_id = srv.node_id
+    srv.close()
+
+    srv2 = Server(cfg).open(port_override=0)
+    try:
+        assert srv2.node_id == node_id  # .id file persisted
+        client2 = InternalClient(f"http://localhost:{srv2.port}")
+        out = client2.query("i", "Row(f=10)")
+        assert out["results"][0]["columns"] == [1, 2]
+    finally:
+        srv2.close()
+
+
+def test_config_file_env_precedence(tmp_path, monkeypatch):
+    p = tmp_path / "cfg.toml"
+    p.write_text('data-dir = "/from/file"\nbind = ":7777"\n[cluster]\nreplicas = 3\n')
+    cfg = Config()
+    cfg.load_file(str(p))
+    assert cfg.data_dir == "/from/file"
+    assert cfg.cluster_replicas == 3
+    monkeypatch.setenv("PILOSA_TPU_DATA_DIR", "/from/env")
+    cfg.load_env()
+    assert cfg.data_dir == "/from/env"
+    assert cfg.bind == ":7777"
+
+
+def test_generate_config_roundtrip(tmp_path):
+    cfg = Config()
+    toml_text = cfg.to_toml()
+    p = tmp_path / "gen.toml"
+    p.write_text(toml_text)
+    cfg2 = Config()
+    cfg2.load_file(str(p))
+    assert cfg2.bind == cfg.bind
+    assert cfg2.cluster_replicas == cfg.cluster_replicas
+    assert cfg2.anti_entropy_interval == cfg.anti_entropy_interval
+
+
+def test_cli_import_export_inspect_check(tmp_path, server, capsys):
+    host = f"http://localhost:{server.port}"
+    csv_path = tmp_path / "bits.csv"
+    csv_path.write_text("1,10\n1,11\n2,10\n")
+    rc = cli_main(
+        ["import", "--host", host, "-i", "ci", "-f", "f",
+         "--create-field-type", "set", str(csv_path)]
+    )
+    assert rc == 0
+    client = InternalClient(host)
+    out = client.query("ci", "Row(f=1)")
+    assert out["results"][0]["columns"] == [10, 11]
+
+    out_path = tmp_path / "out.csv"
+    rc = cli_main(
+        ["export", "--host", host, "-i", "ci", "-f", "f", "-o", str(out_path)]
+    )
+    assert rc == 0
+    assert sorted(out_path.read_text().strip().splitlines()) == [
+        "1,10", "1,11", "2,10",
+    ]
+
+    # inspect + check against the on-disk fragment file
+    frag_path = os.path.join(
+        server.data_dir, "ci", "f", "views", "standard", "fragments", "0"
+    )
+    assert os.path.exists(frag_path)
+    assert cli_main(["inspect", frag_path]) == 0
+    assert cli_main(["check", frag_path]) == 0
+    captured = capsys.readouterr()
+    assert "bits: 3" in captured.out
+    assert "ok" in captured.out
+
+
+def test_cli_generate_config(capsys):
+    assert cli_main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    assert "data-dir" in out and "[cluster]" in out
