@@ -125,6 +125,29 @@ class API:
             tracer=tracer,
         )
         self.mesh_engine = mesh_engine
+        if cluster is not None:
+            self.attach_cluster(cluster, node)
+
+    def attach_cluster(self, cluster, node=None):
+        """Wire the cluster into the executor and install the create-shard
+        broadcast hook (view.go:226 CreateShardMessage)."""
+        self.cluster = cluster
+        self._node = node if node is not None else cluster.node
+        self.executor.cluster = cluster
+        if cluster.holder is None:
+            cluster.holder = self.holder
+
+        def on_create_shard(index, field, shard):
+            cluster.send_sync(
+                {
+                    "type": "create-shard",
+                    "index": index,
+                    "field": field,
+                    "shard": shard,
+                }
+            )
+
+        self.holder.set_on_create_shard(on_create_shard)
 
     # -- queries (api.go Query :102) ---------------------------------------
 
@@ -213,11 +236,10 @@ class API:
 
     # -- imports (api.go Import :787, ImportValue :895, ImportRoaring :290) -
 
-    def import_bits(self, req: ImportRequest):
-        """Bulk bit import: translate keys, set existence, group to views.
-        With a cluster, bits are grouped by shard and forwarded to each
-        owner (api.go:835-860) — the transport layer calls this with
-        pre-sharded requests and remote=True."""
+    def import_bits(self, req: ImportRequest, remote: bool = False):
+        """Bulk bit import: translate keys, group bits by shard, forward
+        each shard group to every replica of its owner set, apply locally
+        when this node is an owner (api.go Import :787-894)."""
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
         col_ids = list(req.column_ids)
@@ -234,20 +256,49 @@ class API:
             row_ids = self.translate_store.translate_rows_to_uint64(
                 req.index, req.field, req.row_keys
             )
-        timestamps = None
-        if req.timestamps and any(t for t in req.timestamps):
-            timestamps = [
+        timestamps = req.timestamps if any(t for t in req.timestamps) else []
+
+        if self.cluster is None or remote:
+            self._import_local(idx, f, row_ids, col_ids, timestamps)
+            return
+
+        # Group by shard, forward to owners (api.go:835-860).
+        groups: Dict[int, list] = {}
+        for i, c in enumerate(col_ids):
+            groups.setdefault(c // SHARD_WIDTH, []).append(i)
+        for shard, idxs in sorted(groups.items()):
+            s_rows = [row_ids[i] for i in idxs]
+            s_cols = [col_ids[i] for i in idxs]
+            s_ts = [timestamps[i] for i in idxs] if timestamps else []
+            for node in self.cluster.shard_nodes(req.index, shard):
+                if node.id == self.cluster.node.id:
+                    self._import_local(idx, f, s_rows, s_cols, s_ts)
+                else:
+                    self.cluster.client(node).import_bits(
+                        req.index,
+                        req.field,
+                        shard,
+                        s_rows,
+                        s_cols,
+                        timestamps=s_ts or None,
+                        remote=True,
+                    )
+
+    def _import_local(self, idx, f, row_ids, col_ids, timestamps):
+        ts = None
+        if timestamps:
+            ts = [
                 dt.datetime.fromtimestamp(t, dt.timezone.utc).replace(tzinfo=None)
                 if t
                 else None
-                for t in req.timestamps
+                for t in timestamps
             ]
         ef = idx.existence_field()
         if ef is not None and col_ids:
             ef.import_bulk([0] * len(col_ids), col_ids)
-        f.import_bulk(row_ids, col_ids, timestamps)
+        f.import_bulk(row_ids, col_ids, ts)
 
-    def import_values(self, req: ImportValueRequest):
+    def import_values(self, req: ImportValueRequest, remote: bool = False):
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
         col_ids = list(req.column_ids)
@@ -257,23 +308,48 @@ class API:
             col_ids = self.translate_store.translate_columns_to_uint64(
                 req.index, req.column_keys
             )
-        ef = idx.existence_field()
-        if ef is not None and col_ids:
-            ef.import_bulk([0] * len(col_ids), col_ids)
-        f.import_values(col_ids, req.values)
+
+        def apply_local(cols, values):
+            ef = idx.existence_field()
+            if ef is not None and cols:
+                ef.import_bulk([0] * len(cols), cols)
+            f.import_values(cols, values)
+
+        if self.cluster is None or remote:
+            apply_local(col_ids, req.values)
+            return
+        groups: Dict[int, list] = {}
+        for i, c in enumerate(col_ids):
+            groups.setdefault(c // SHARD_WIDTH, []).append(i)
+        for shard, idxs in sorted(groups.items()):
+            cols = [col_ids[i] for i in idxs]
+            values = [req.values[i] for i in idxs]
+            for node in self.cluster.shard_nodes(req.index, shard):
+                if node.id == self.cluster.node.id:
+                    apply_local(cols, values)
+                else:
+                    self.cluster.client(node).import_values(
+                        req.index, req.field, shard, cols, values, remote=True
+                    )
 
     def import_roaring(
-        self, index_name: str, field_name: str, shard: int, data: bytes, view: str = VIEW_STANDARD
+        self,
+        index_name: str,
+        field_name: str,
+        shard: int,
+        data: bytes,
+        view: str = VIEW_STANDARD,
+        clear: bool = False,
     ) -> int:
-        """Union a serialized roaring bitmap into a fragment — the fast
-        ingest path (api.go:290-349)."""
+        """Union (or clear) a serialized roaring bitmap into a fragment —
+        the fast ingest path (api.go:290-349, ImportRoaringRequest.Clear)."""
         idx = self.index(index_name)
         f = self.field(index_name, field_name)
         v = f.view_if_not_exists(view)
         frag = v.fragment_if_not_exists(shard)
-        n = frag.import_roaring(data)
+        n = frag.import_roaring(data, clear=clear)
         ef = idx.existence_field()
-        if ef is not None:
+        if ef is not None and not clear:
             from .roaring import codec
 
             positions = codec.deserialize(data).values
@@ -432,6 +508,21 @@ class API:
                 from .roaring import Bitmap
 
                 f.add_remote_available_shards(Bitmap([msg["shard"]]))
+        elif typ == "node-status":
+            from .roaring import Bitmap
+
+            for index_name, info in msg.get("indexes", {}).items():
+                idx = self.holder.create_index_if_not_exists(
+                    index_name, keys=info.get("keys", False)
+                )
+                for field_name, finfo in info.get("fields", {}).items():
+                    f = idx.create_field_if_not_exists(
+                        field_name,
+                        FieldOptions.from_dict(finfo.get("options", {})),
+                    )
+                    f.add_remote_available_shards(
+                        Bitmap(finfo.get("availableShards", []))
+                    )
         elif typ == "recalculate-caches":
             for idx in self.holder.indexes.values():
                 for f in idx.fields.values():

@@ -1,0 +1,21 @@
+from .cluster import (
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    STATE_STARTING,
+    Cluster,
+    Node,
+    fnv1a64,
+    jump_hash,
+)
+
+__all__ = [
+    "Cluster",
+    "Node",
+    "STATE_DEGRADED",
+    "STATE_NORMAL",
+    "STATE_RESIZING",
+    "STATE_STARTING",
+    "fnv1a64",
+    "jump_hash",
+]

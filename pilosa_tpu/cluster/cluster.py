@@ -1,0 +1,487 @@
+"""Cluster: membership + shard placement + elastic resize.
+
+Mirror of the reference's cluster (cluster.go:172-2042):
+
+- Placement: partition = fnv1a64(index || shard_be8) % 256
+  (cluster.go partition :828-838), primary node = jump-consistent-hash
+  of the partition over the sorted node list (jmphasher :905-913),
+  replicas = the next replicaN-1 nodes around the ring
+  (partitionNodes :857-878).
+- States STARTING / NORMAL / DEGRADED / RESIZING (cluster.go:44-49),
+  DEGRADED when fewer than replicaN-1 extra nodes are lost
+  (determineClusterState :522).
+- Membership changes arrive as join/leave events (from gossip or admin
+  RPC, cluster.go ReceiveEvent :1658-1818); the coordinator builds a
+  resize job diffing old/new fragment placement (fragSources :741-826,
+  resizeJob :1383-1497) and nodes fetch missing shards over the data
+  plane (followResizeInstruction :1251-1347).
+- Topology persisted to ``.topology`` (cluster.go:1593-1628).
+
+The TPU-native deployment note: inside one pod the query data plane is
+the device mesh (pilosa_tpu.parallel); this layer is the *host* control
+plane that places shards on hosts and streams fragments between them —
+DCN traffic, as SURVEY.md §2.3 prescribes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+from typing import Callable, Dict, List, Optional
+
+DEFAULT_PARTITION_N = 256
+
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_DEGRADED = "DEGRADED"
+STATE_RESIZING = "RESIZING"
+
+
+def fnv1a64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def jump_hash(key: int, n: int) -> int:
+    """Jump consistent hash (cluster.go jmphasher :905-913)."""
+    b, j = -1, 0
+    key &= 0xFFFFFFFFFFFFFFFF
+    while j < n:
+        b = j
+        key = (key * 2862933555777941757 + 1) & 0xFFFFFFFFFFFFFFFF
+        j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+class Node:
+    __slots__ = ("id", "uri", "is_coordinator", "state")
+
+    def __init__(self, id: str, uri: str, is_coordinator: bool = False):
+        self.id = id
+        self.uri = uri
+        self.is_coordinator = is_coordinator
+        self.state = "READY"
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "uri": self.uri,
+            "isCoordinator": self.is_coordinator,
+            "state": self.state,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        n = cls(d["id"], d["uri"], d.get("isCoordinator", False))
+        n.state = d.get("state", "READY")
+        return n
+
+    def __repr__(self):
+        return f"Node({self.id}@{self.uri})"
+
+
+class ResizeSource:
+    """One fragment to fetch during a resize (internal ResizeSource)."""
+
+    __slots__ = ("node", "index", "field", "view", "shard")
+
+    def __init__(self, node: Node, index: str, field: str, view: str, shard: int):
+        self.node = node
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+
+    def __repr__(self):
+        return (
+            f"ResizeSource({self.index}/{self.field}/{self.view}/{self.shard}"
+            f" from {self.node.id})"
+        )
+
+
+class Cluster:
+    def __init__(
+        self,
+        node: Node,
+        replica_n: int = 1,
+        partition_n: int = DEFAULT_PARTITION_N,
+        hosts: Optional[List[str]] = None,
+        path: Optional[str] = None,
+        client_factory: Optional[Callable[[str], object]] = None,
+        logger=None,
+    ):
+        self.node = node
+        self.replica_n = max(replica_n, 1)
+        self.partition_n = partition_n
+        self.path = path
+        self.state = STATE_STARTING
+        self.nodes: List[Node] = [node]
+        self._lock = threading.RLock()
+        self.logger = logger
+        self.holder = None  # attached by the server/harness
+        if client_factory is None:
+            from ..net import InternalClient
+
+            client_factory = InternalClient
+        self._client_factory = client_factory
+        self._clients: Dict[str, object] = {}
+        self.hosts = hosts or []
+        self.event_listeners: List[Callable] = []
+        self.load_topology()
+
+    # -- clients -----------------------------------------------------------
+
+    def client(self, node: Node):
+        c = self._clients.get(node.uri)
+        if c is None:
+            c = self._client_factory(node.uri)
+            self._clients[node.uri] = c
+        return c
+
+    # -- placement (cluster.go :828-913) -----------------------------------
+
+    def partition(self, index: str, shard: int) -> int:
+        data = index.encode() + shard.to_bytes(8, "big")
+        return fnv1a64(data) % self.partition_n
+
+    def partition_nodes(self, partition_id: int) -> List[Node]:
+        with self._lock:
+            n = len(self.nodes)
+            if n == 0:
+                return []
+            replica_n = min(self.replica_n, n)
+            start = jump_hash(partition_id, n)
+            return [self.nodes[(start + i) % n] for i in range(replica_n)]
+
+    def shard_nodes(self, index: str, shard: int) -> List[Node]:
+        return self.partition_nodes(self.partition(index, shard))
+
+    def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
+        return any(n.id == node_id for n in self.shard_nodes(index, shard))
+
+    def primary_shard_node(self, index: str, shard: int) -> Node:
+        return self.shard_nodes(index, shard)[0]
+
+    def shards_by_node(
+        self, index: str, shards: List[int]
+    ) -> Dict[str, List[int]]:
+        """Assign each shard to one owner, preferring this node (the
+        reference's mapper assignment, executor.go:2245-2281)."""
+        out: Dict[str, List[int]] = {}
+        for s in shards:
+            owners = self.shard_nodes(index, s)
+            target = next(
+                (n for n in owners if n.id == self.node.id), owners[0]
+            )
+            out.setdefault(target.id, []).append(s)
+        return out
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        with self._lock:
+            for n in self.nodes:
+                if n.id == node_id:
+                    return n
+        return None
+
+    def coordinator(self) -> Optional[Node]:
+        with self._lock:
+            for n in self.nodes:
+                if n.is_coordinator:
+                    return n
+        return None
+
+    def is_coordinator(self) -> bool:
+        return self.node.is_coordinator
+
+    # -- membership (cluster.go ReceiveEvent :1658-1818) -------------------
+
+    def _sort_nodes(self):
+        self.nodes.sort(key=lambda n: n.id)
+
+    def add_node(self, node: Node, resize: bool = True):
+        """Node join: re-place fragments when data exists (nodeJoin
+        :1697)."""
+        with self._lock:
+            if any(n.id == node.id for n in self.nodes):
+                return
+            old_nodes = list(self.nodes)
+            self.nodes.append(node)
+            self._sort_nodes()
+            self.save_topology()
+        self._emit("join", node)
+        if resize and self.is_coordinator() and self.holder is not None:
+            self._run_resize(old_nodes)
+        self._determine_state()
+
+    def remove_node(self, node_id: str, resize: bool = True) -> Optional[Node]:
+        with self._lock:
+            node = self.node_by_id(node_id)
+            if node is None:
+                return None
+            old_nodes = list(self.nodes)
+            self.nodes = [n for n in self.nodes if n.id != node_id]
+            self.save_topology()
+        self._emit("leave", node)
+        if resize and self.is_coordinator() and self.holder is not None:
+            self._run_resize(old_nodes)
+        self._determine_state()
+        return node
+
+    def node_failed(self, node_id: str):
+        """Failure detector verdict (gossip NotifyLeave): mark and degrade;
+        data is NOT re-placed until an admin removes the node
+        (cluster.go nodeLeave :1733)."""
+        node = self.node_by_id(node_id)
+        if node is not None:
+            node.state = "DOWN"
+        self._determine_state()
+
+    def node_recovered(self, node_id: str):
+        node = self.node_by_id(node_id)
+        if node is not None:
+            node.state = "READY"
+        self._determine_state()
+
+    def _determine_state(self):
+        """determineClusterState (cluster.go:522)."""
+        with self._lock:
+            if self.state == STATE_RESIZING:
+                return
+            down = sum(1 for n in self.nodes if n.state == "DOWN")
+            if down == 0:
+                self.state = STATE_NORMAL
+            elif down < self.replica_n:
+                self.state = STATE_DEGRADED
+            else:
+                self.state = STATE_STARTING
+
+    def set_state(self, state: str):
+        with self._lock:
+            self.state = state
+
+    def _emit(self, kind: str, node: Node):
+        for fn in self.event_listeners:
+            fn(kind, node)
+
+    def set_coordinator(self, node_id: str):
+        with self._lock:
+            old = self.coordinator()
+            new = self.node_by_id(node_id)
+            if new is None:
+                raise ValueError(f"node not found: {node_id}")
+            for n in self.nodes:
+                n.is_coordinator = n.id == node_id
+            self.node.is_coordinator = self.node.id == node_id
+            self.save_topology()
+        return (
+            old.to_dict() if old else None,
+            new.to_dict(),
+        )
+
+    def abort_resize(self):
+        with self._lock:
+            if self.state == STATE_RESIZING:
+                self.state = STATE_NORMAL
+
+    def receive_message(self, msg: dict):
+        typ = msg.get("type")
+        if typ == "node-join":
+            self.add_node(Node.from_dict(msg["node"]), resize=msg.get("resize", True))
+        elif typ == "node-leave":
+            self.remove_node(msg["node"]["id"], resize=msg.get("resize", True))
+        elif typ == "set-state":
+            self.set_state(msg["state"])
+        elif typ == "resize-instruction":
+            self.follow_resize_instruction(msg)
+
+    # -- broadcast (broadcast.go SendSync, server.go:582-604) --------------
+
+    def send_sync(self, msg: dict):
+        """POST the message to every other node."""
+        for n in list(self.nodes):
+            if n.id == self.node.id:
+                continue
+            try:
+                self.client(n).send_message(msg)
+            except Exception as e:
+                if self.logger:
+                    self.logger.printf("broadcast to %s failed: %s", n.id, e)
+
+    def send_to(self, node: Node, msg: dict):
+        self.client(node).send_message(msg)
+
+    # -- resize (cluster.go :741-826, 1150-1497) ---------------------------
+
+    def frag_sources(
+        self, old_nodes: List[Node], new_nodes: List[Node]
+    ) -> Dict[str, List[ResizeSource]]:
+        """Per-node list of fragments to fetch after placement changed
+        (cluster.go fragSources :741-826)."""
+        if self.holder is None:
+            return {}
+
+        def placement(nodes: List[Node], index: str, shard: int) -> List[Node]:
+            n = len(nodes)
+            if n == 0:
+                return []
+            replica_n = min(self.replica_n, n)
+            start = jump_hash(self.partition(index, shard), n)
+            return [nodes[(start + i) % n] for i in range(replica_n)]
+
+        out: Dict[str, List[ResizeSource]] = {n.id: [] for n in new_nodes}
+        for index_name, idx in self.holder.indexes.items():
+            for shard in idx.available_shards():
+                shard = int(shard)
+                old_owners = placement(old_nodes, index_name, shard)
+                new_owners = placement(new_nodes, index_name, shard)
+                old_ids = {n.id for n in old_owners}
+                for target in new_owners:
+                    if target.id in old_ids:
+                        continue
+                    source = next(
+                        (n for n in old_owners if any(
+                            m.id == n.id for m in new_nodes
+                        )),
+                        old_owners[0] if old_owners else None,
+                    )
+                    if source is None:
+                        continue
+                    for f in idx.fields.values():
+                        for view_name in f.views:
+                            out[target.id].append(
+                                ResizeSource(
+                                    source, index_name, f.name, view_name, shard
+                                )
+                            )
+        return out
+
+    def _run_resize(self, old_nodes: List[Node]):
+        """Coordinator-driven synchronous resize: compute per-node
+        sources, broadcast instructions, wait for completion
+        (generateResizeJob :1150 + followResizeInstruction :1251)."""
+        with self._lock:
+            new_nodes = list(self.nodes)
+        self.set_state(STATE_RESIZING)
+        self.send_sync({"type": "set-state", "state": STATE_RESIZING})
+        try:
+            sources = self.frag_sources(old_nodes, new_nodes)
+            for node in new_nodes:
+                node_sources = sources.get(node.id, [])
+                if not node_sources:
+                    continue
+                instruction = {
+                    "type": "resize-instruction",
+                    "sources": [
+                        {
+                            "uri": s.node.uri,
+                            "index": s.index,
+                            "field": s.field,
+                            "view": s.view,
+                            "shard": s.shard,
+                        }
+                        for s in node_sources
+                    ],
+                }
+                if node.id == self.node.id:
+                    self.follow_resize_instruction(instruction)
+                else:
+                    self.send_to(node, instruction)
+        finally:
+            self.set_state(STATE_NORMAL)
+            self.send_sync({"type": "set-state", "state": STATE_NORMAL})
+            # Let every node route to every shard (NodeStatus exchange).
+            self.send_sync(self.node_status())
+
+    def node_status(self) -> dict:
+        """Schema + per-field available shards (server.go NodeStatus
+        :626-674) — exchanged on join and periodically so every node can
+        route queries to shards it doesn't hold."""
+        status = {"type": "node-status", "indexes": {}}
+        if self.holder is None:
+            return status
+        for name, idx in self.holder.indexes.items():
+            fields = {}
+            for fname, f in idx.fields.items():
+                fields[fname] = {
+                    "options": f.options.to_dict(),
+                    "availableShards": [int(s) for s in f.available_shards()],
+                }
+            status["indexes"][name] = {"keys": idx.keys, "fields": fields}
+        return status
+
+    def follow_resize_instruction(self, instruction: dict):
+        """Fetch each missing fragment from its source over the data plane
+        (followResizeInstruction :1251-1347)."""
+        for src in instruction.get("sources", []):
+            try:
+                client = self._clients.get(src["uri"])
+                if client is None:
+                    client = self._client_factory(src["uri"])
+                    self._clients[src["uri"]] = client
+                data = client.retrieve_shard(
+                    src["index"], src["field"], src["shard"], view=src["view"]
+                )
+            except Exception as e:
+                if self.logger:
+                    self.logger.printf(
+                        "resize fetch %s failed: %s", src, e
+                    )
+                continue
+            if self.holder is None:
+                continue
+            idx = self.holder.index(src["index"])
+            if idx is None:
+                continue
+            f = idx.field(src["field"])
+            if f is None:
+                continue
+            frag = f.view_if_not_exists(src["view"]).fragment_if_not_exists(
+                src["shard"]
+            )
+            frag.import_roaring(data)
+
+    # -- holder cleaner (holder.go holderCleaner :852-902) -----------------
+
+    def clean_holder(self):
+        """Remove fragments this node no longer owns."""
+        if self.holder is None:
+            return
+        for index_name, idx in self.holder.indexes.items():
+            for f in idx.fields.values():
+                for view in f.views.values():
+                    for shard in list(view.fragments):
+                        if not self.owns_shard(self.node.id, index_name, shard):
+                            frag = view.fragments.pop(shard)
+                            frag.close()
+
+    # -- topology persistence (cluster.go :1593-1628) ----------------------
+
+    def _topology_path(self) -> Optional[str]:
+        if self.path is None:
+            return None
+        return os.path.join(self.path, ".topology")
+
+    def save_topology(self):
+        p = self._topology_path()
+        if p is None:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        with open(p, "w") as f:
+            json.dump({"nodes": [n.to_dict() for n in self.nodes]}, f)
+
+    def load_topology(self):
+        p = self._topology_path()
+        if p is None or not os.path.exists(p):
+            return
+        with open(p) as f:
+            doc = json.load(f)
+        nodes = [Node.from_dict(d) for d in doc.get("nodes", [])]
+        with self._lock:
+            by_id = {n.id: n for n in nodes}
+            by_id[self.node.id] = self.node
+            self.nodes = sorted(by_id.values(), key=lambda n: n.id)

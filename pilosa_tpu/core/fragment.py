@@ -427,14 +427,41 @@ class Fragment:
             self.set_value(c, bit_depth, v)
         self.snapshot()
 
-    def import_roaring(self, data: bytes) -> int:
-        """Union a serialized roaring bitmap straight into storage — the
-        fast ingest path (fragment.go importRoaring :1659)."""
+    def import_roaring(self, data: bytes, clear: bool = False) -> int:
+        """Union (or with ``clear``, subtract) a serialized roaring bitmap
+        straight into storage — the fast ingest path
+        (fragment.go importRoaring :1659; ImportRoaringRequest.Clear)."""
         dec = codec.deserialize(data)
         before = sum(self.row_counts.values())
-        self._union_positions(dec.values)
+        if clear:
+            self._difference_positions(dec.values)
+        else:
+            self._union_positions(dec.values)
         self.snapshot()
-        return sum(self.row_counts.values()) - before
+        return abs(sum(self.row_counts.values()) - before)
+
+    def _difference_positions(self, positions: np.ndarray):
+        if positions.size == 0:
+            return
+        row_ids = (positions >> np.uint64(ops.SHARD_WIDTH_EXP)).astype(np.int64)
+        in_row = positions & np.uint64(SHARD_WIDTH - 1)
+        order = np.argsort(row_ids, kind="stable")
+        row_ids, in_row = row_ids[order], in_row[order]
+        uniq, starts = np.unique(row_ids, return_index=True)
+        bounds = np.append(starts, row_ids.size)
+        for i, r in enumerate(uniq):
+            r = int(r)
+            words = self.rows.get(r)
+            if words is None:
+                continue
+            mask = ops.positions_to_words(in_row[bounds[i] : bounds[i + 1]]).view(
+                "<u8"
+            )
+            self.rows[r] = words & ~mask
+            self.row_counts[r] = int(bitops.popcount_np(self.rows[r]))
+            self._touch(r)
+            self.cache.bulk_add(r, self.row_counts[r])
+        self.cache.invalidate()
 
     def _union_positions(self, positions: np.ndarray):
         if positions.size == 0:

@@ -62,6 +62,17 @@ class Holder:
             attr_store_factory=self.attr_store_factory,
         )
 
+    def set_on_create_shard(self, fn):
+        """Install the create-shard broadcast hook (view.go:226) on this
+        holder and every already-created index/field/view."""
+        self.on_create_shard = fn
+        for idx in self.indexes.values():
+            idx.on_create_shard = fn
+            for f in idx.fields.values():
+                f.on_create_shard = fn
+                for v in f.views.values():
+                    v.on_create_shard = fn
+
     def index(self, name: str) -> Optional[Index]:
         return self.indexes.get(name)
 

@@ -423,19 +423,64 @@ class Executor:
     # -- map/reduce over shards (executor.go mapReduce :2183) --------------
 
     def map_reduce(self, index, shards, call, opt, map_fn, reduce_fn):
-        """Per-shard map + pairwise reduce.  Single-node: every shard is
-        local.  The cluster layer (stage 6) overrides node routing by
-        passing a sharded client; reduce order is shard-ascending so
-        non-commutative merges behave like the reference's channel drain."""
+        """Per-shard map + reduce (executor.go mapReduce :2183-2321).
+
+        Single-node (or remote re-entry): every shard maps locally.  With
+        a cluster, shards group by owning node; remote groups execute the
+        serialized call on their peer in one RPC (remoteExec :2142) and
+        the partial merges into the same reduce.  A failed peer's shards
+        retry on the next replica (executor.go :2216-2231)."""
+        if self.cluster is None or opt.remote:
+            result = None
+            for shard in shards:
+                result = reduce_fn(result, map_fn(shard))
+            return result
+        return self._mapper(index, shards, call, opt, map_fn, reduce_fn, set())
+
+    def _mapper(self, index, shards, call, opt, map_fn, reduce_fn, down_ids):
+        by_node = {}
+        for s in shards:
+            owners = [
+                n
+                for n in self.cluster.shard_nodes(index, s)
+                if n.id not in down_ids
+            ]
+            if not owners:
+                raise Error(f"no available node for shard {s}")
+            target = next(
+                (n for n in owners if n.id == self.cluster.node.id), owners[0]
+            )
+            by_node.setdefault(target.id, (target, []))[1].append(s)
+
         result = None
-        first = True
-        for shard in shards:
-            v = map_fn(shard)
-            if first:
-                result = reduce_fn(None, v)
-                first = False
-            else:
-                result = reduce_fn(result, v)
+        for node_id, (node, node_shards) in sorted(by_node.items()):
+            if node_id == self.cluster.node.id:
+                for shard in node_shards:
+                    result = reduce_fn(result, map_fn(shard))
+                continue
+            try:
+                doc = self.cluster.client(node).query(
+                    index, str(call), shards=node_shards, remote=True
+                )
+            except Exception:
+                # Retry this node's shards on other replicas.
+                self.cluster.node_failed(node_id)
+                sub = self._mapper(
+                    index,
+                    node_shards,
+                    call,
+                    opt,
+                    map_fn,
+                    reduce_fn,
+                    down_ids | {node_id},
+                )
+                if sub is not None:
+                    result = reduce_fn(result, sub)
+                continue
+            from ..net.wire import result_from_json
+
+            v = result_from_json(call.name, doc["results"][0])
+            result = reduce_fn(result, v)
         return result
 
     # -- bitmap calls ------------------------------------------------------
@@ -975,7 +1020,9 @@ class Executor:
             value, ok = c.int_arg(field_name)
             if not ok:
                 raise Error("Set() row argument required")
-            return f.set_value(col_id, value)
+            return self._write_replicated(
+                index, c, col_id, opt, lambda: f.set_value(col_id, value)
+            )
 
         row_id, ok = c.uint_arg(field_name)
         if not ok:
@@ -989,7 +1036,9 @@ class Executor:
                 raise Error(f"invalid date: {ts}")
         if f.options.type == FIELD_TYPE_BOOL and row_id not in (0, 1):
             raise Error("bool field rows must be 0 or 1")
-        return f.set_bit(row_id, col_id, timestamp)
+        return self._write_replicated(
+            index, c, col_id, opt, lambda: f.set_bit(row_id, col_id, timestamp)
+        )
 
     def _execute_clear_bit(self, index, c: Call, opt) -> bool:
         field_name = c.field_arg()
@@ -1005,7 +1054,40 @@ class Executor:
         col_id, ok = c.uint_arg("_col")
         if not ok:
             raise Error("Clear() col argument required")
-        return f.clear_bit(row_id, col_id)
+        return self._write_replicated(
+            index, c, col_id, opt, lambda: f.clear_bit(row_id, col_id)
+        )
+
+    def _write_replicated(self, index, c: Call, col_id: int, opt, local_fn):
+        """Apply a single-bit write on every replica of the column's shard:
+        locally when this node is an owner, forwarded otherwise
+        (executor.go executeSetBitField :1865-1898).  Single-node: just
+        local."""
+        if self.cluster is None:
+            return local_fn()
+        shard = col_id // SHARD_WIDTH
+        ret = False
+        for node in self.cluster.shard_nodes(index, shard):
+            if node.id == self.cluster.node.id:
+                if local_fn():
+                    ret = True
+                continue
+            if opt.remote:
+                continue
+            doc = self.cluster.client(node).query(index, str(c), remote=True)
+            if doc["results"][0]:
+                ret = True
+        return ret
+
+    def _forward_to_all(self, index, c: Call, opt):
+        """Forward an attr write to every other node (executor.go
+        :1964-1993)."""
+        if self.cluster is None or opt.remote:
+            return
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.node.id:
+                continue
+            self.cluster.client(node).query(index, str(c), remote=True)
 
     def _execute_clear_row(self, index, c: Call, shards, opt) -> bool:
         field_name = c.field_arg()
@@ -1072,6 +1154,7 @@ class Executor:
             k: v for k, v in c.args.items() if k not in ("_field", "_row")
         }
         f.row_attr_store.set_attrs(row_id, attrs)
+        self._forward_to_all(index, c, opt)
 
     def _execute_bulk_set_row_attrs(self, index, calls: List[Call], opt):
         by_field: Dict[str, Dict[int, dict]] = {}
@@ -1090,6 +1173,8 @@ class Executor:
         for field_name, m in by_field.items():
             f = self.holder_field(index, field_name)
             f.row_attr_store.set_bulk_attrs(m)
+        for c in calls:
+            self._forward_to_all(index, c, opt)
         return [None] * len(calls)
 
     def _execute_set_column_attrs(self, index, c: Call, opt):
@@ -1103,6 +1188,7 @@ class Executor:
             k: v for k, v in c.args.items() if k not in ("_col", "field")
         }
         idx.column_attr_store.set_attrs(col, attrs)
+        self._forward_to_all(index, c, opt)
 
 
 class _GroupByIterator:

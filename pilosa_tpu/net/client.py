@@ -116,11 +116,13 @@ class InternalClient:
         row_ids: List[int],
         column_ids: List[int],
         timestamps: Optional[List[Optional[int]]] = None,
+        remote: bool = False,
     ):
         doc = {"shard": shard, "rowIDs": row_ids, "columnIDs": column_ids}
         if timestamps:
             doc["timestamps"] = timestamps
-        self._post(f"/index/{index}/field/{field}/import", doc)
+        suffix = "?remote=true" if remote else ""
+        self._post(f"/index/{index}/field/{field}/import{suffix}", doc)
 
     def import_keyed_bits(
         self, index: str, field: str, row_keys: List[str], column_keys: List[str]
@@ -131,18 +133,32 @@ class InternalClient:
         )
 
     def import_values(
-        self, index: str, field: str, shard: int, column_ids: List[int], values: List[int]
+        self,
+        index: str,
+        field: str,
+        shard: int,
+        column_ids: List[int],
+        values: List[int],
+        remote: bool = False,
     ):
+        suffix = "?remote=true" if remote else ""
         self._post(
-            f"/index/{index}/field/{field}/import",
+            f"/index/{index}/field/{field}/import{suffix}",
             {"shard": shard, "columnIDs": column_ids, "values": values},
         )
 
     def import_roaring(
-        self, index: str, field: str, shard: int, data: bytes, view: str = "standard"
+        self,
+        index: str,
+        field: str,
+        shard: int,
+        data: bytes,
+        view: str = "standard",
+        clear: bool = False,
     ) -> int:
         out = self._post(
-            f"/index/{index}/field/{field}/import-roaring/{shard}?view={view}",
+            f"/index/{index}/field/{field}/import-roaring/{shard}"
+            f"?view={view}&clear={'true' if clear else 'false'}",
             body=data,
         )
         return out.get("changed", 0)

@@ -177,6 +177,7 @@ class Handler:
 
     def _post_import(self, q, b, *, index, field, **kw):
         doc = json.loads(b)
+        remote = _qbool(q, "remote")
         if "values" in doc:
             self.api.import_values(
                 ImportValueRequest(
@@ -186,7 +187,8 @@ class Handler:
                     column_ids=doc.get("columnIDs"),
                     column_keys=doc.get("columnKeys"),
                     values=doc.get("values"),
-                )
+                ),
+                remote=remote,
             )
         else:
             self.api.import_bits(
@@ -199,13 +201,17 @@ class Handler:
                     row_keys=doc.get("rowKeys"),
                     column_keys=doc.get("columnKeys"),
                     timestamps=doc.get("timestamps"),
-                )
+                ),
+                remote=remote,
             )
         return {}
 
     def _post_import_roaring(self, q, b, *, index, field, shard, **kw):
         view = q.get("view", ["standard"])[0]
-        n = self.api.import_roaring(index, field, int(shard), b, view=view)
+        clear = _qbool(q, "clear")
+        n = self.api.import_roaring(
+            index, field, int(shard), b, view=view, clear=clear
+        )
         return {"changed": n}
 
     def _get_export(self, q, b, **kw):

@@ -10,7 +10,7 @@ TopN pairs -> [{id|key, count}], Rows -> {rows|keys}, GroupBy ->
 from __future__ import annotations
 
 from ..core.row import Row
-from ..executor import GroupCount, RowIdentifiers, ValCount
+from ..executor import FieldRow, GroupCount, RowIdentifiers, ValCount
 
 
 def result_to_json(result):
@@ -49,3 +49,47 @@ def response_to_json(resp) -> dict:
     if resp.column_attr_sets is not None:
         out["columnAttrs"] = [c.to_dict() for c in resp.column_attr_sets]
     return out
+
+
+def result_from_json(call_name: str, doc):
+    """Decode a remote node's partial result back into executor types
+    (the JSON analogue of encoding/proto's QueryResponse decode used by
+    remoteExec, executor.go:2142-2158)."""
+    if doc is None:
+        return None
+    if isinstance(doc, bool):
+        return doc
+    if isinstance(doc, (int, float)):
+        return int(doc)
+    if isinstance(doc, dict):
+        if "columns" in doc or ("attrs" in doc and "keys" not in doc):
+            row = Row.from_columns(doc.get("columns", []))
+            row.attrs = doc.get("attrs") or None
+            return row
+        if "value" in doc and "count" in doc:
+            return ValCount(doc["value"], doc["count"])
+        if "rows" in doc or "keys" in doc:
+            return RowIdentifiers(doc.get("rows", []), doc.get("keys"))
+    if isinstance(doc, list):
+        if not doc:
+            return [] if call_name in ("TopN", "Rows", "GroupBy") else doc
+        first = doc[0]
+        if isinstance(first, dict) and "count" in first and "id" in first:
+            return [(d["id"], d["count"]) for d in doc]
+        if isinstance(first, dict) and "count" in first and "key" in first:
+            return [(d["key"], d["count"]) for d in doc]
+        if isinstance(first, dict) and "group" in first:
+            return [
+                GroupCount(
+                    [
+                        FieldRow(
+                            g["field"], g.get("rowID", 0), g.get("rowKey", "")
+                        )
+                        for g in d["group"]
+                    ],
+                    d["count"],
+                )
+                for d in doc
+            ]
+        return [int(x) for x in doc]
+    return doc

@@ -113,12 +113,28 @@ class _Parser:
         self.expect("(")
         self.sp()
         if handler is not None:
-            handler(call)
+            # PEG ordered choice: if the special positional form fails —
+            # including failing to reach the closing paren, as when
+            # re-parsing the canonical serialization "Set(_col=2, f=10)" —
+            # backtrack to the generic allargs production.
+            save = self.pos
+            try:
+                handler(call)
+                self.sp()
+                self.expect(")")
+            except ParseError:
+                self.pos = save
+                call.args.clear()
+                call.children.clear()
+                self._allargs(call)
+                self.comma()
+                self.sp()
+                self.expect(")")
         else:
             self._allargs(call)
             self.comma()
-        self.sp()
-        self.expect(")")
+            self.sp()
+            self.expect(")")
         self.sp()
         return call
 
@@ -226,6 +242,8 @@ class _Parser:
         return ok
 
     def _at_arg(self) -> bool:
+        if any(self.peek(r) for r in _RESERVED_FIELDS):
+            return True
         save = self.pos
         ok = self.match(_FIELD_RE) is not None
         self.pos = save

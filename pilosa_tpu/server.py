@@ -100,11 +100,14 @@ class Server:
         self.translate_store.open()
         self._setup_cluster(host, port)
         self.holder.open()
+        if self.cluster is not None:
+            self.cluster.holder = self.holder
         mesh_engine = None
         self.api = API(
             holder=self.holder,
             translate_store=self.translate_store,
             cluster=self.cluster,
+            node=self.cluster.node if self.cluster else None,
             stats=self.stats,
             tracer=self.tracer,
             mesh_engine=mesh_engine,

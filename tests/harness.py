@@ -1,0 +1,67 @@
+"""In-process multi-node cluster harness.
+
+The equivalent of the reference's ``test.MustRunCluster`` (test/pilosa.go
+:298-355): N real Server processes' worth of stack — holder, translate
+store, executor, HTTP listener on an ephemeral port — in one process,
+talking loopback HTTP, with a statically-assembled membership (the
+cluster tests in cluster_internal_test.go likewise use fake node lists
+instead of live gossip)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from pilosa_tpu.cluster import Cluster, Node
+from pilosa_tpu.config import Config
+from pilosa_tpu.net import InternalClient
+from pilosa_tpu.server import Server
+
+
+class ClusterHarness:
+    def __init__(self, servers: List[Server]):
+        self.servers = servers
+
+    def __getitem__(self, i: int) -> Server:
+        return self.servers[i]
+
+    def __len__(self):
+        return len(self.servers)
+
+    def client(self, i: int = 0) -> InternalClient:
+        return InternalClient(f"http://localhost:{self.servers[i].port}")
+
+    def close(self):
+        for s in self.servers:
+            s.close()
+
+
+def run_cluster(tmp_path, n: int, replica_n: int = 1) -> ClusterHarness:
+    servers: List[Server] = []
+    for i in range(n):
+        cfg = Config()
+        cfg.data_dir = str(tmp_path / f"node{i}")
+        cfg.bind = "localhost:0"
+        srv = Server(cfg)
+        srv.node_id = f"node{i}"
+        srv.open(port_override=0)
+        servers.append(srv)
+
+    nodes = [
+        Node(s.node_id, f"http://localhost:{s.port}", is_coordinator=(i == 0))
+        for i, s in enumerate(servers)
+    ]
+    for i, srv in enumerate(servers):
+        cluster = Cluster(
+            node=nodes[i],
+            replica_n=replica_n,
+            path=srv.data_dir,
+            logger=srv.logger,
+        )
+        cluster.nodes = sorted(
+            [nodes[j] for j in range(n)], key=lambda nd: nd.id
+        )
+        cluster.holder = srv.holder
+        cluster.state = "NORMAL"
+        srv.cluster = cluster
+        srv.api.attach_cluster(cluster, nodes[i])
+    return ClusterHarness(servers)
