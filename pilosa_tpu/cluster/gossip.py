@@ -1,0 +1,300 @@
+"""SWIM gossip membership: UDP probing + piggybacked dissemination.
+
+The stand-in for the reference's memberlist transport (gossip/gossip.go
+:170-541): each node runs a UDP listener and a probe loop.  Protocol
+(JSON datagrams):
+
+- ``ping`` / ``ack``     direct failure-detection probe
+- ``ping-req``           indirect probe through k proxies on timeout
+- ``join``               push/pull: joiner gets the full member list
+- every message piggybacks recent membership updates
+  (alive/suspect/dead + incarnation numbers, memberlist's
+  broadcast queue)
+
+State machine per member: ALIVE -> SUSPECT (probe failed) -> DEAD
+(suspicion timeout = suspicion_mult * probe_interval), with refutation:
+a node seeing itself suspected re-broadcasts alive with a bumped
+incarnation.  Events (join/leave) feed cluster.add_node /
+cluster.node_failed the way memberlist events feed
+cluster.ReceiveEvent (cluster.go:1658).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+_MAX_PIGGYBACK = 8
+
+
+class Member:
+    __slots__ = ("id", "addr", "meta", "state", "incarnation", "since")
+
+    def __init__(self, id, addr, meta=None, state=ALIVE, incarnation=0):
+        self.id = id
+        self.addr = tuple(addr)
+        self.meta = meta or {}
+        self.state = state
+        self.incarnation = incarnation
+        self.since = time.monotonic()
+
+    def to_update(self) -> dict:
+        return {
+            "id": self.id,
+            "addr": list(self.addr),
+            "meta": self.meta,
+            "state": self.state,
+            "inc": self.incarnation,
+        }
+
+
+class GossipNode:
+    def __init__(
+        self,
+        node_id: str,
+        meta: Optional[dict] = None,
+        bind: str = "127.0.0.1",
+        port: int = 0,
+        probe_interval: float = 0.3,
+        probe_timeout: float = 0.2,
+        suspicion_mult: int = 4,
+        indirect_checks: int = 2,
+        on_join: Optional[Callable] = None,
+        on_leave: Optional[Callable] = None,
+        logger=None,
+    ):
+        self.node_id = node_id
+        self.meta = meta or {}
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.suspicion_timeout = suspicion_mult * probe_interval
+        self.indirect_checks = indirect_checks
+        self.on_join = on_join
+        self.on_leave = on_leave
+        self.logger = logger
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((bind, port))
+        self._sock.settimeout(0.1)
+        self.addr = self._sock.getsockname()
+
+        self._lock = threading.RLock()
+        self.members: Dict[str, Member] = {
+            node_id: Member(node_id, self.addr, self.meta)
+        }
+        self.incarnation = 0
+        self._acks: Dict[str, threading.Event] = {}
+        self._updates: List[dict] = []  # piggyback broadcast queue
+        self._closing = threading.Event()
+        self._threads = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        for fn in (self._listen_loop, self._probe_loop, self._reap_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def join(self, seed_addr):
+        """Push/pull state with a seed (memberlist Join)."""
+        self._send(tuple(seed_addr), {"type": "join"})
+
+    def close(self):
+        self._closing.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- wire --------------------------------------------------------------
+
+    def _send(self, addr, msg: dict):
+        msg["from"] = self.node_id
+        with self._lock:
+            msg["updates"] = self._updates[-_MAX_PIGGYBACK:] + [
+                self.members[self.node_id].to_update()
+            ]
+        try:
+            self._sock.sendto(json.dumps(msg).encode(), tuple(addr))
+        except OSError:
+            pass
+
+    def _queue_update(self, update: dict):
+        with self._lock:
+            self._updates.append(update)
+            if len(self._updates) > 64:
+                self._updates = self._updates[-64:]
+
+    # -- loops -------------------------------------------------------------
+
+    def _listen_loop(self):
+        while not self._closing.is_set():
+            try:
+                data, addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg = json.loads(data)
+            except json.JSONDecodeError:
+                continue
+            self._handle(msg, addr)
+
+    def _handle(self, msg: dict, addr):
+        for update in msg.get("updates", []):
+            self._apply_update(update)
+        typ = msg.get("type")
+        if typ == "ping":
+            self._send(addr, {"type": "ack", "seq": msg.get("seq")})
+        elif typ == "ack":
+            ev = self._acks.get(msg.get("seq"))
+            if ev is not None:
+                ev.set()
+        elif typ == "ping-req":
+            # Probe the target on behalf of the requester.
+            target = msg.get("target")
+            with self._lock:
+                m = self.members.get(target)
+            if m is not None and self._probe_once(m):
+                self._send(addr, {"type": "ack", "seq": msg.get("seq")})
+        elif typ == "join":
+            with self._lock:
+                full = [m.to_update() for m in self.members.values()]
+            self._send(addr, {"type": "state", "members": full})
+        elif typ == "state":
+            for update in msg.get("members", []):
+                self._apply_update(update)
+
+    def _apply_update(self, u: dict):
+        uid = u["id"]
+        if uid == self.node_id:
+            # Refute suspicion about ourselves (memberlist aliveness).
+            if u["state"] in (SUSPECT, DEAD) and u["inc"] >= self.incarnation:
+                self.incarnation = u["inc"] + 1
+                with self._lock:
+                    me = self.members[self.node_id]
+                    me.incarnation = self.incarnation
+                    me.state = ALIVE
+                self._queue_update(me.to_update())
+            return
+        joined = False
+        left = False
+        with self._lock:
+            m = self.members.get(uid)
+            if m is None:
+                if u["state"] == DEAD:
+                    return
+                m = Member(uid, u["addr"], u.get("meta"), u["state"], u["inc"])
+                self.members[uid] = m
+                joined = True
+            else:
+                # Higher incarnation wins; equal incarnation: worse state
+                # wins (suspect over alive).
+                rank = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
+                if u["inc"] < m.incarnation:
+                    return
+                if u["inc"] == m.incarnation and rank[u["state"]] <= rank[m.state]:
+                    return
+                was_dead = m.state == DEAD
+                m.state = u["state"]
+                m.incarnation = u["inc"]
+                m.since = time.monotonic()
+                if m.state == DEAD and not was_dead:
+                    left = True
+                if was_dead and m.state == ALIVE:
+                    joined = True
+            self._queue_update(m.to_update())
+        if joined and self.on_join:
+            self.on_join(m)
+        if left and self.on_leave:
+            self.on_leave(m)
+
+    def _probe_loop(self):
+        while not self._closing.wait(self.probe_interval):
+            with self._lock:
+                candidates = [
+                    m
+                    for m in self.members.values()
+                    if m.id != self.node_id and m.state != DEAD
+                ]
+            if not candidates:
+                continue
+            target = random.choice(candidates)
+            if self._probe_once(target):
+                self._mark(target.id, ALIVE)
+                continue
+            # Indirect probes through k proxies (SWIM ping-req).
+            proxies = [m for m in candidates if m.id != target.id]
+            random.shuffle(proxies)
+            seq = f"{self.node_id}-{time.monotonic()}"
+            ev = threading.Event()
+            self._acks[seq] = ev
+            for proxy in proxies[: self.indirect_checks]:
+                self._send(
+                    proxy.addr,
+                    {"type": "ping-req", "target": target.id, "seq": seq},
+                )
+            ok = ev.wait(self.probe_timeout * 2)
+            self._acks.pop(seq, None)
+            if ok:
+                self._mark(target.id, ALIVE)
+            else:
+                self._mark(target.id, SUSPECT)
+
+    def _probe_once(self, m: Member) -> bool:
+        seq = f"{self.node_id}-{time.monotonic()}-{random.random()}"
+        ev = threading.Event()
+        self._acks[seq] = ev
+        self._send(m.addr, {"type": "ping", "seq": seq})
+        ok = ev.wait(self.probe_timeout)
+        self._acks.pop(seq, None)
+        return ok
+
+    def _mark(self, uid: str, state: str):
+        left = False
+        with self._lock:
+            m = self.members.get(uid)
+            if m is None or m.state == state:
+                return
+            if m.state == DEAD and state != ALIVE:
+                return
+            was_dead = m.state == DEAD
+            m.state = state
+            m.since = time.monotonic()
+            if state == DEAD and not was_dead:
+                left = True
+            self._queue_update(m.to_update())
+        if left and self.on_leave:
+            self.on_leave(m)
+
+    def _reap_loop(self):
+        """Promote timed-out suspects to dead (suspicion timeout)."""
+        while not self._closing.wait(self.probe_interval):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for m in self.members.values():
+                    if (
+                        m.state == SUSPECT
+                        and now - m.since > self.suspicion_timeout
+                    ):
+                        dead.append(m.id)
+            for uid in dead:
+                self._mark(uid, DEAD)
+
+    # -- introspection -----------------------------------------------------
+
+    def alive_members(self) -> List[Member]:
+        with self._lock:
+            return [m for m in self.members.values() if m.state == ALIVE]

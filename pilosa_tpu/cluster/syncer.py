@@ -1,0 +1,181 @@
+"""Anti-entropy: background repair of replica divergence.
+
+Mirror of the reference's holderSyncer + fragmentSyncer
+(holder.go:630-911, fragment.go:2170-2390, server.go monitorAntiEntropy
+:430-483): walk the schema; for every owned shard compare 100-row block
+checksums across replicas, fetch differing blocks, merge by majority
+vote, apply locally and push per-peer set/clear diffs as roaring
+payloads; diff row/column attributes by block checksum.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.fragment import SHARD_WIDTH
+from ..roaring import Bitmap
+
+
+class HolderSyncer:
+    def __init__(self, holder, cluster, logger=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.logger = logger
+        self.closing = False
+
+    # -- entry (holder.go SyncHolder :659) ---------------------------------
+
+    def sync_holder(self):
+        for index_name, idx in list(self.holder.indexes.items()):
+            self._sync_index_attrs(index_name, idx)
+            for field_name, f in list(idx.fields.items()):
+                if self.closing:
+                    return
+                self._sync_field_attrs(index_name, field_name, f)
+                for view_name, view in list(f.views.items()):
+                    for shard in list(view.fragments):
+                        if self.closing:
+                            return
+                        if not self.cluster.owns_shard(
+                            self.cluster.node.id, index_name, shard
+                        ):
+                            continue
+                        try:
+                            self.sync_fragment(
+                                index_name, field_name, view_name, shard
+                            )
+                        except Exception as e:
+                            if self.logger:
+                                self.logger.printf(
+                                    "sync %s/%s/%s/%d failed: %s",
+                                    index_name,
+                                    field_name,
+                                    view_name,
+                                    shard,
+                                    e,
+                                )
+
+    # -- fragment sync (fragment.go syncFragment :2191) --------------------
+
+    def _replicas(self, index: str, shard: int):
+        return [
+            n
+            for n in self.cluster.shard_nodes(index, shard)
+            if n.id != self.cluster.node.id and n.state != "DOWN"
+        ]
+
+    def sync_fragment(self, index: str, field: str, view: str, shard: int):
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            return
+        replicas = self._replicas(index, shard)
+        if not replicas:
+            return
+
+        local_blocks = dict(frag.checksum_blocks())
+        # Gather remote checksums; any differing or missing block syncs.
+        remote_blocks = []
+        for node in replicas:
+            blocks = self.cluster.client(node).fragment_blocks(
+                index, field, view, shard
+            )
+            remote_blocks.append(
+                {b["id"]: bytes.fromhex(b["checksum"]) for b in blocks}
+            )
+        block_ids = set(local_blocks)
+        for rb in remote_blocks:
+            block_ids.update(rb)
+        for blk in sorted(block_ids):
+            checksums = [local_blocks.get(blk)] + [
+                rb.get(blk) for rb in remote_blocks
+            ]
+            if all(c == checksums[0] for c in checksums):
+                continue
+            self._sync_block(frag, index, field, view, shard, blk, replicas)
+
+    def _sync_block(self, frag, index, field, view, shard, block, replicas):
+        """fragment.go syncBlock :2262-2360."""
+        peer_pairs = []
+        for node in replicas:
+            data = self.cluster.client(node).block_data(
+                index, field, view, shard, block
+            )
+            peer_pairs.append(
+                (
+                    np.asarray(data["rows"], dtype=np.uint64),
+                    np.asarray(data["cols"], dtype=np.uint64),
+                )
+            )
+        sets, clears = frag.merge_block(block, peer_pairs)
+        # Push per-peer diffs as roaring payloads (bitsToRoaringData).
+        for node, s, c in zip(replicas, sets, clears):
+            if s:
+                self.cluster.client(node).import_roaring(
+                    index, field, shard, _pairs_to_roaring(s), view=view
+                )
+            if c:
+                self.cluster.client(node).import_roaring(
+                    index,
+                    field,
+                    shard,
+                    _pairs_to_roaring(c),
+                    view=view,
+                    clear=True,
+                )
+
+    # -- attr sync (holder.go :723-815) ------------------------------------
+
+    def _sync_index_attrs(self, index_name: str, idx):
+        if idx.column_attr_store is None:
+            return
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.node.id or node.state == "DOWN":
+                continue
+            try:
+                blocks = [
+                    {"id": b, "checksum": d.hex()}
+                    for b, d in idx.column_attr_store.blocks()
+                ]
+                attrs = self.cluster.client(node).index_attr_diff(
+                    index_name, blocks
+                )
+                if attrs:
+                    idx.column_attr_store.set_bulk_attrs(
+                        {int(k): v for k, v in attrs.items()}
+                    )
+            except Exception as e:
+                if self.logger:
+                    self.logger.printf("index attr sync failed: %s", e)
+
+    def _sync_field_attrs(self, index_name: str, field_name: str, f):
+        if f.row_attr_store is None:
+            return
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.node.id or node.state == "DOWN":
+                continue
+            try:
+                blocks = [
+                    {"id": b, "checksum": d.hex()}
+                    for b, d in f.row_attr_store.blocks()
+                ]
+                attrs = self.cluster.client(node).field_attr_diff(
+                    index_name, field_name, blocks
+                )
+                if attrs:
+                    f.row_attr_store.set_bulk_attrs(
+                        {int(k): v for k, v in attrs.items()}
+                    )
+            except Exception as e:
+                if self.logger:
+                    self.logger.printf("field attr sync failed: %s", e)
+
+
+def _pairs_to_roaring(pairs: List[tuple]) -> bytes:
+    """(row, in-shard col) pairs -> serialized roaring positions
+    (fragment.go bitsToRoaringData :2377)."""
+    bm = Bitmap(
+        int(r) * SHARD_WIDTH + (int(c) % SHARD_WIDTH) for r, c in pairs
+    )
+    return bm.to_bytes()

@@ -122,9 +122,11 @@ class Server:
         return self
 
     def _setup_cluster(self, host: str, port: int):
-        """Wire the cluster when hosts are configured (server/server.go
-        setupNetworking :302); single-node otherwise."""
-        if self.config.cluster_disabled or not self.config.cluster_hosts:
+        """Wire the cluster when hosts or gossip seeds are configured
+        (server/server.go setupNetworking :302); single-node otherwise."""
+        if self.config.cluster_disabled or not (
+            self.config.cluster_hosts or self.config.gossip_seeds
+        ):
             return
         from .cluster import Cluster, Node
 
@@ -133,8 +135,44 @@ class Server:
             node=Node(self.node_id, uri, self.config.cluster_coordinator),
             replica_n=self.config.cluster_replicas,
             hosts=self.config.cluster_hosts,
+            path=self.data_dir,
             logger=self.logger,
         )
+        if self.config.gossip_seeds or self.config.gossip_port:
+            self._setup_gossip(uri)
+
+    def _setup_gossip(self, uri: str):
+        """SWIM membership feeding cluster join/leave events
+        (gossip/gossip.go eventReceiver :317-396)."""
+        from .cluster import Node
+        from .cluster.gossip import GossipNode
+
+        cluster = self.cluster
+
+        def on_join(member):
+            node_uri = member.meta.get("uri")
+            if node_uri:
+                cluster.add_node(
+                    Node(member.id, node_uri, member.meta.get("coordinator", False))
+                )
+
+        def on_leave(member):
+            cluster.node_failed(member.id)
+
+        self.gossip = GossipNode(
+            self.node_id,
+            meta={"uri": uri, "coordinator": self.config.cluster_coordinator},
+            port=self.config.gossip_port,
+            probe_interval=self.config.gossip_probe_interval,
+            probe_timeout=self.config.gossip_probe_timeout,
+            suspicion_mult=self.config.gossip_suspicion_mult,
+            on_join=on_join,
+            on_leave=on_leave,
+            logger=self.logger,
+        ).start()
+        for seed in self.config.gossip_seeds:
+            h, _, p = seed.rpartition(":")
+            self.gossip.join((h or "127.0.0.1", int(p)))
 
     @property
     def port(self) -> int:
@@ -146,7 +184,21 @@ class Server:
         # Runtime metrics loop (server.go monitorRuntime :726).
         if self.config.metric_poll_interval > 0:
             self._spawn(self._monitor_runtime, self.config.metric_poll_interval)
-        # Anti-entropy requires a cluster; wired by the cluster module.
+        if self.cluster is not None:
+            self.start_anti_entropy()
+
+    def start_anti_entropy(self, interval: Optional[float] = None):
+        """Spawn the anti-entropy loop (server.go monitorAntiEntropy
+        :430-483).  Callable after a late cluster attach (test harness)."""
+        from .cluster.syncer import HolderSyncer
+
+        self.syncer = HolderSyncer(self.holder, self.cluster, self.logger)
+        self._spawn(
+            self.syncer.sync_holder,
+            interval
+            if interval is not None
+            else self.config.anti_entropy_interval,
+        )
 
     def _spawn(self, fn, interval: float):
         def loop():
@@ -180,6 +232,8 @@ class Server:
 
     def close(self):
         self._closing.set()
+        if getattr(self, "gossip", None) is not None:
+            self.gossip.close()
         if self._http is not None:
             self._http.shutdown()
         self.holder.close()

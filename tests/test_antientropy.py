@@ -1,0 +1,75 @@
+"""Anti-entropy sync tests (holder.go holderSyncer + fragment
+syncFragment/mergeBlock behavior)."""
+
+import pytest
+
+from pilosa_tpu.cluster.syncer import HolderSyncer
+from pilosa_tpu.ops import SHARD_WIDTH
+
+from harness import run_cluster
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    h = run_cluster(tmp_path, 3, replica_n=3)
+    yield h
+    h.close()
+
+
+def test_fragment_sync_repairs_divergence(cluster3):
+    h = cluster3
+    client = h.client(0)
+    client.create_index("i")
+    client.create_field("i", "f")
+    client.query("i", "Set(1, f=10) Set(2, f=10)")  # replicated to all 3
+
+    # Diverge: drop a bit from node1's replica, add a stray bit on node2.
+    h[1].holder.fragment("i", "f", "standard", 0).clear_bit(10, 1)
+    h[2].holder.fragment("i", "f", "standard", 0).set_bit(10, 5)
+
+    syncer = HolderSyncer(h[0].holder, h[0].cluster)
+    syncer.sync_holder()
+
+    # Majority vote: bit (10,1) present on 2/3 -> restored on node1;
+    # bit (10,5) present on 1/3 -> cleared from node2.
+    for i in range(3):
+        frag = h[i].holder.fragment("i", "f", "standard", 0)
+        assert frag.bit(10, 1), f"node {i} lost (10,1)"
+        assert frag.bit(10, 2), f"node {i} lost (10,2)"
+        assert not frag.bit(10, 5), f"node {i} kept stray (10,5)"
+
+
+def test_attr_sync(cluster3):
+    h = cluster3
+    client = h.client(0)
+    client.create_index("i")
+    client.create_field("i", "f")
+    # Write attrs on node1 only (bypassing broadcast).
+    h[1].holder.index("i").field("f").row_attr_store.set_attrs(
+        7, {"color": "red"}
+    )
+    h[1].holder.index("i").column_attr_store.set_attrs(3, {"vip": True})
+
+    syncer = HolderSyncer(h[0].holder, h[0].cluster)
+    syncer.sync_holder()
+
+    assert h[0].holder.index("i").field("f").row_attr_store.attrs(7) == {
+        "color": "red"
+    }
+    assert h[0].holder.index("i").column_attr_store.attrs(3) == {"vip": True}
+
+
+def test_sync_multi_shard(cluster3):
+    h = cluster3
+    client = h.client(0)
+    client.create_index("i")
+    client.create_field("i", "f")
+    cols = [s * SHARD_WIDTH + 9 for s in range(4)]
+    client.import_bits("i", "f", 0, [5] * len(cols), cols)
+    # Wipe one replica's fragment for shard 2 entirely.
+    h[2].holder.fragment("i", "f", "standard", 2).clear_row(5)
+
+    syncer = HolderSyncer(h[0].holder, h[0].cluster)
+    syncer.sync_holder()
+    frag = h[2].holder.fragment("i", "f", "standard", 2)
+    assert frag.bit(5, 2 * SHARD_WIDTH + 9)

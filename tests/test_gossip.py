@@ -1,0 +1,77 @@
+"""SWIM gossip membership tests (gossip/gossip.go behavior: join
+propagation, failure detection, refutation)."""
+
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.gossip import ALIVE, DEAD, GossipNode
+
+
+def wait_until(fn, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def nodes():
+    created = []
+
+    def make(n, **kw):
+        out = []
+        for i in range(n):
+            g = GossipNode(
+                f"g{i}",
+                meta={"uri": f"http://h{i}"},
+                probe_interval=0.1,
+                probe_timeout=0.15,
+                suspicion_mult=3,
+                **kw,
+            ).start()
+            out.append(g)
+            created.append(g)
+        return out
+
+    yield make
+    for g in created:
+        g.close()
+
+
+def test_join_propagates(nodes):
+    g = nodes(3)
+    g[1].join(g[0].addr)
+    g[2].join(g[0].addr)
+    assert wait_until(
+        lambda: all(len(x.alive_members()) == 3 for x in g)
+    ), [len(x.alive_members()) for x in g]
+
+
+def test_failure_detection(nodes):
+    g = nodes(3)
+    g[1].join(g[0].addr)
+    g[2].join(g[0].addr)
+    assert wait_until(lambda: all(len(x.alive_members()) == 3 for x in g))
+    events = []
+    g[0].on_leave = lambda m: events.append(m.id)
+    g[2].close()  # hard kill
+    assert wait_until(
+        lambda: g[0].members["g2"].state == DEAD, timeout=10
+    ), g[0].members["g2"].state
+    assert "g2" in events
+
+
+def test_join_callback(nodes):
+    g = nodes(1)
+    joined = []
+    g[0].on_join = lambda m: joined.append(m.id)
+    g2 = GossipNode("late", probe_interval=0.1).start()
+    try:
+        g2.join(g[0].addr)
+        assert wait_until(lambda: "late" in joined)
+        assert g[0].members["late"].meta == {}
+    finally:
+        g2.close()
