@@ -1,0 +1,81 @@
+"""Native (C++) components, loaded via ctypes.
+
+The reference's performance-critical host code is Go with unsafe casts
+(roaring/roaring.go:934-944); here it is C++ compiled on demand with the
+system toolchain.  Import never fails: when no compiler is available the
+callers fall back to the pure-NumPy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "roaring_codec.cpp")
+_LIB = os.path.join(_HERE, "libroaring_codec.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-o",
+        _LIB,
+        _SRC,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, cwd=_HERE, timeout=120
+        )
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError):
+        return False
+
+
+def load():
+    """The codec library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = not os.path.exists(_LIB) or os.path.getmtime(
+            _LIB
+        ) < os.path.getmtime(_SRC)
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.rc_abi_version.restype = ctypes.c_int32
+        if lib.rc_abi_version() != 1:
+            return None
+        lib.rc_deserialize.restype = ctypes.c_int64
+        lib.rc_deserialize.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.rc_serialize.restype = ctypes.c_int64
+        lib.rc_serialize.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+        ]
+        _lib = lib
+        return _lib

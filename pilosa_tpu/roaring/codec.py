@@ -96,8 +96,33 @@ def _words_to_lows(words: np.ndarray) -> np.ndarray:
     return np.flatnonzero(bits).astype(np.uint16)
 
 
+def _native():
+    """The C++ codec (pilosa_tpu/native/roaring_codec.cpp) or None."""
+    from .. import native
+
+    return native.load()
+
+
 def serialize(values: np.ndarray) -> bytes:
     """Serialize a sorted unique u64 vector to pilosa-roaring bytes."""
+    values = np.ascontiguousarray(values, dtype=np.uint64)
+    lib = _native()
+    if lib is not None:
+        import ctypes
+
+        ptr = values.ctypes.data_as(ctypes.c_void_p)
+        size = lib.rc_serialize(ptr, values.size, None, 0)
+        if size >= 0:
+            out = np.empty(size, dtype=np.uint8)
+            rc = lib.rc_serialize(
+                ptr, values.size, out.ctypes.data_as(ctypes.c_void_p), size
+            )
+            if rc == size:
+                return out.tobytes()
+    return _serialize_py(values)
+
+
+def _serialize_py(values: np.ndarray) -> bytes:
     values = np.asarray(values, dtype=np.uint64)
     highs = (values >> np.uint64(16)).astype(np.uint64)
     lows_all = (values & np.uint64(0xFFFF)).astype(np.uint16)
@@ -154,8 +179,31 @@ def deserialize(data: bytes) -> _Decoded:
     Accepts both Pilosa's 64-bit format (cookie 12348, with op-log replay,
     mirroring unmarshalPilosaRoaring roaring.go:886-974) and the official
     32-bit roaring interchange format (cookies 12346/12347,
-    roaring.go:3885-3925).
+    roaring.go:3885-3925).  Uses the C++ codec when available.
     """
+    lib = _native()
+    if lib is not None and len(data) >= HEADER_BASE_SIZE:
+        import ctypes
+
+        op_n = ctypes.c_int64(0)
+        count = lib.rc_deserialize(data, len(data), None, 0, ctypes.byref(op_n))
+        if count >= 0:
+            out = np.empty(count, dtype=np.uint64)
+            rc = lib.rc_deserialize(
+                data,
+                len(data),
+                out.ctypes.data_as(ctypes.c_void_p),
+                count,
+                ctypes.byref(op_n),
+            )
+            if rc == count:
+                return _Decoded(out, int(op_n.value), [])
+        # Negative: corrupt data — surface the python decoder's error
+        # message for parity with the reference's errors.
+    return _deserialize_py(data)
+
+
+def _deserialize_py(data: bytes) -> _Decoded:
     if len(data) < HEADER_BASE_SIZE:
         raise ValueError("roaring: data too small")
     magic = struct.unpack_from("<H", data, 0)[0]
