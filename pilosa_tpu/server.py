@@ -186,6 +186,14 @@ class Server:
             self._spawn(self._monitor_runtime, self.config.metric_poll_interval)
         if self.cluster is not None:
             self.start_anti_entropy()
+        # Diagnostics loop (server.go monitorDiagnostics :675); endpoint
+        # unset by default so nothing leaves the host.
+        if self.config.metric_diagnostics:
+            from .util.diagnostics import Diagnostics
+
+            self.diagnostics = Diagnostics(
+                api=self.api, logger=self.logger
+            ).start()
 
     def start_anti_entropy(self, interval: Optional[float] = None):
         """Spawn the anti-entropy loop (server.go monitorAntiEntropy

@@ -1,0 +1,111 @@
+"""Diagnostics: periodic anonymized usage reporting.
+
+Mirror of the reference's diagnostics collector (diagnostics.go:42-249):
+gathers version, platform, schema shape, and runtime stats into a JSON
+document and POSTs it to an endpoint on an interval.  Off unless enabled
+(``metric.diagnostics``); the flush is best-effort and never raises.
+"""
+
+from __future__ import annotations
+
+import json
+import platform
+import threading
+import time
+import uuid
+from typing import Optional
+
+
+DEFAULT_INTERVAL = 3600.0
+
+
+class Diagnostics:
+    def __init__(
+        self,
+        api=None,
+        endpoint: str = "",
+        interval: float = DEFAULT_INTERVAL,
+        logger=None,
+    ):
+        self.api = api
+        self.endpoint = endpoint
+        self.interval = interval
+        self.logger = logger
+        self.host_id = uuid.uuid4().hex[:16]
+        self.start_time = time.time()
+        self._closing = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_report: Optional[dict] = None  # inspectable for tests
+
+    # -- payload (diagnostics.go:180-249) ----------------------------------
+
+    def collect(self) -> dict:
+        doc = {
+            "id": self.host_id,
+            "version": self.api.version() if self.api else "",
+            "os": platform.system(),
+            "arch": platform.machine(),
+            "pythonVersion": platform.python_version(),
+            "uptimeSeconds": int(time.time() - self.start_time),
+        }
+        if self.api is not None:
+            num_fields = 0
+            field_types = set()
+            time_quantum_used = False
+            for idx_info in self.api.schema():
+                for f in idx_info["fields"]:
+                    num_fields += 1
+                    field_types.add(f["options"]["type"])
+                    if f["options"].get("timeQuantum"):
+                        time_quantum_used = True
+            doc.update(
+                {
+                    "numIndexes": len(self.api.schema()),
+                    "numFields": num_fields,
+                    "fieldTypes": sorted(field_types),
+                    "timeQuantumEnabled": time_quantum_used,
+                    "clusterSize": len(self.api.hosts()),
+                }
+            )
+        try:
+            import jax
+
+            doc["numDevices"] = len(jax.devices())
+            doc["devicePlatform"] = jax.devices()[0].platform
+        except Exception:
+            pass
+        return doc
+
+    def flush(self):
+        """Collect and (when an endpoint is configured) POST; always
+        stores the report locally."""
+        doc = self.collect()
+        self.last_report = doc
+        if not self.endpoint:
+            return
+        try:
+            from urllib.request import Request, urlopen
+
+            req = Request(
+                self.endpoint,
+                data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urlopen(req, timeout=10).read()
+        except Exception as e:
+            if self.logger:
+                self.logger.debugf("diagnostics flush failed: %s", e)
+
+    # -- loop (server.go monitorDiagnostics :675) --------------------------
+
+    def start(self):
+        def loop():
+            while not self._closing.wait(self.interval):
+                self.flush()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._closing.set()

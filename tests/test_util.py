@@ -1,0 +1,72 @@
+"""Tests for stats / tracing / diagnostics / statsd / logger utilities."""
+
+import socket
+import time
+
+from pilosa_tpu.api import API
+from pilosa_tpu.util import ExpvarStatsClient, MultiStatsClient, Tracer
+from pilosa_tpu.util.diagnostics import Diagnostics
+from pilosa_tpu.util.statsd import StatsdClient
+
+
+def test_expvar_stats():
+    s = ExpvarStatsClient()
+    s.count("queries", 2)
+    s.count("queries", 3)
+    scoped = s.with_tags("index:i")
+    scoped.count("queries", 1)
+    scoped.gauge("heap", 42.0)
+    snap = s.snapshot()
+    assert snap["counters"]["queries"] == 5
+    assert snap["counters"]["index:i:queries"] == 1
+    assert snap["gauges"]["index:i:heap"] == 42.0
+
+
+def test_multi_stats():
+    a, b = ExpvarStatsClient(), ExpvarStatsClient()
+    m = MultiStatsClient([a, b])
+    m.count("x", 1)
+    assert a.snapshot()["counters"]["x"] == 1
+    assert b.snapshot()["counters"]["x"] == 1
+
+
+def test_tracer_span_tree():
+    t = Tracer(keep_finished=4)
+    with t.start_span("outer", index="i") as outer:
+        with t.start_span("inner") as inner:
+            pass
+    spans = t.finished_spans()
+    assert spans[-1].name == "outer"
+    assert spans[-1].children[0].name == "inner"
+    assert spans[-1].duration is not None
+    d = spans[-1].to_dict()
+    assert d["tags"] == {"index": "i"}
+
+
+def test_diagnostics_payload():
+    api = API()
+    api.create_index("i")
+    api.create_field("i", "f", {"type": "set"})
+    d = Diagnostics(api=api)
+    d.flush()  # no endpoint: stores locally only
+    doc = d.last_report
+    assert doc["numIndexes"] == 1
+    assert doc["numFields"] == 1
+    assert "set" in doc["fieldTypes"]
+    assert doc["clusterSize"] == 1
+
+
+def test_statsd_datagrams():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2)
+    port = recv.getsockname()[1]
+    c = StatsdClient(f"127.0.0.1:{port}")
+    c.count("hits", 3)
+    msg = recv.recv(1024).decode()
+    assert msg == "pilosa_tpu.hits:3|c"
+    c.with_tags("index:i").timing("latency", 0.25)
+    msg = recv.recv(1024).decode()
+    assert msg == "pilosa_tpu.latency:250|ms|#index:i"
+    recv.close()
+    c.close()
