@@ -81,7 +81,9 @@ def test_native_speedup_sanity(rng):
     """The native path should not be slower than python on a big decode."""
     import time
 
-    vals = random_values(rng, 500000, span=1 << 26)
+    # Sparse span -> array containers, where the python per-container
+    # loop is slowest (native is ~100x+ faster there).
+    vals = random_values(rng, 200000, span=1 << 40)
     data = codec.serialize(vals)
 
     t0 = time.perf_counter()
@@ -90,4 +92,4 @@ def test_native_speedup_sanity(rng):
     t0 = time.perf_counter()
     codec._deserialize_py(data)
     t_py = time.perf_counter() - t0
-    assert t_native < t_py * 2  # generous bound; typically ~10x faster
+    assert t_native < t_py
