@@ -123,6 +123,7 @@ class API:
             translator=QueryTranslator(self.translate_store),
             stats=stats,
             tracer=tracer,
+            mesh_engine=mesh_engine,
         )
         self.mesh_engine = mesh_engine
         if cluster is not None:

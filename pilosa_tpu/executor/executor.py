@@ -288,6 +288,7 @@ class Executor:
         max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST,
         stats=None,
         tracer=None,
+        mesh_engine=None,
     ):
         self.holder = holder
         self.cluster = cluster
@@ -295,6 +296,10 @@ class Executor:
         self.client = client
         self.translator = translator
         self.max_writes_per_request = max_writes_per_request
+        # Optional fused device path (parallel.MeshEngine): local shards of
+        # supported read calls execute as one sharded dispatch instead of
+        # the per-shard python loop.
+        self.mesh_engine = mesh_engine
         from ..util.stats import NopStatsClient
         from ..util.tracing import NopTracer
 
@@ -712,14 +717,58 @@ class Executor:
         if len(c.children) != 1:
             raise Error("Count() requires a single bitmap input")
 
+        fused = self._mesh_count(index, c.children[0], shards, opt)
+
         def map_fn(shard):
             row = self._execute_bitmap_call_shard(index, c.children[0], shard)
             return row.count()
+
+        if fused is not None:
+            local_shards, fused_count = fused
+
+            def map_fn(shard):  # noqa: F811 — remote shards still loop
+                raise Error("unexpected local shard in fused count")
+
+            remote = [s for s in shards if s not in local_shards]
+            result = (
+                self.map_reduce(
+                    index,
+                    remote,
+                    c,
+                    opt,
+                    map_fn,
+                    lambda p, v: (p or 0) + v,
+                )
+                if remote
+                else 0
+            )
+            return (result or 0) + fused_count
 
         result = self.map_reduce(
             index, shards, c, opt, map_fn, lambda p, v: (p or 0) + v
         )
         return result or 0
+
+    def _mesh_count(self, index, child: Call, shards, opt):
+        """Fused Count over the local shard set via the mesh engine;
+        returns (local_shards, count) or None when unsupported."""
+        if self.mesh_engine is None:
+            return None
+        if self.cluster is None:
+            local = list(shards)
+        else:
+            local = [
+                s
+                for s in shards
+                if self.cluster.owns_shard(self.cluster.node.id, index, s)
+            ]
+        if not local:
+            return None
+        try:
+            return set(local), self.mesh_engine.count(index, child, local)
+        except ValueError:
+            # Unsupported call shape: fall back to the per-shard path.
+            return None
 
     def _bsi_shard_ctx(self, index, c: Call, shard: int):
         """(fragment, bsig, filter_words) for Sum/Min/Max shard kernels."""

@@ -102,7 +102,7 @@ class Server:
         self.holder.open()
         if self.cluster is not None:
             self.cluster.holder = self.holder
-        mesh_engine = None
+        mesh_engine = self._make_mesh_engine()
         self.api = API(
             holder=self.holder,
             translate_store=self.translate_store,
@@ -121,6 +121,20 @@ class Server:
         self._start_monitors()
         return self
 
+    def _make_mesh_engine(self):
+        """Fused device query path over the local mesh (parallel package);
+        None when no usable devices (the per-shard path still works)."""
+        if self.config.mesh_devices < 0:
+            return None
+        try:
+            from .parallel import MeshEngine, make_mesh
+
+            mesh = make_mesh(self.config.mesh_devices or None)
+            return MeshEngine(self.holder, mesh)
+        except Exception as e:
+            self.logger.printf("mesh engine unavailable: %s", e)
+            return None
+
     def _setup_cluster(self, host: str, port: int):
         """Wire the cluster when hosts or gossip seeds are configured
         (server/server.go setupNetworking :302); single-node otherwise."""
@@ -138,8 +152,7 @@ class Server:
             path=self.data_dir,
             logger=self.logger,
         )
-        if self.config.gossip_seeds or self.config.gossip_port:
-            self._setup_gossip(uri)
+        self._setup_gossip(uri)
 
     def _setup_gossip(self, uri: str):
         """SWIM membership feeding cluster join/leave events
