@@ -94,8 +94,32 @@ class Handler:
 
     # -- dispatch ----------------------------------------------------------
 
-    def handle(self, method: str, path: str, query: dict, body: bytes):
+    def handle(
+        self,
+        method: str,
+        path: str,
+        query: dict,
+        body: bytes,
+        headers: Optional[dict] = None,
+    ):
         """Returns (status, content_type, payload bytes)."""
+        headers = headers or {}
+        # Protobuf negotiation on the query/import routes, as the
+        # reference does (http/handler.go Accept/Content-Type
+        # application/x-protobuf).
+        from . import proto
+
+        ctype = headers.get("Content-Type", "")
+        accept = headers.get("Accept", "")
+        if method == "POST" and (
+            proto.CONTENT_TYPE in ctype or proto.CONTENT_TYPE in accept
+        ):
+            m = re.match(r"^/index/([^/]+)/query$", path)
+            if m:
+                return self._query_proto(m.group(1), query, body, ctype, accept)
+            m = re.match(r"^/index/([^/]+)/field/([^/]+)/import$", path)
+            if m and proto.CONTENT_TYPE in ctype:
+                return self._import_proto(m.group(1), m.group(2), query, body)
         for route in self.routes:
             if route.method != method:
                 continue
@@ -117,6 +141,55 @@ class Handler:
                 return 200, "text/plain", result.encode()
             return 200, "application/json", json.dumps(result).encode()
         return 404, "application/json", b'{"error": "not found"}'
+
+    # -- protobuf handlers -------------------------------------------------
+
+    def _query_proto(self, index, q, body, ctype, accept):
+        from . import proto
+
+        if proto.CONTENT_TYPE in ctype:
+            doc = proto.decode_query_request(body)
+        else:
+            doc = json.loads(body) if body else {}
+        req = QueryRequest(
+            index,
+            doc.get("query", ""),
+            shards=doc.get("shards") or _parse_shards(q),
+            column_attrs=doc.get("columnAttrs", False),
+            exclude_row_attrs=doc.get("excludeRowAttrs", False),
+            exclude_columns=doc.get("excludeColumns", False),
+            remote=doc.get("remote", False) or _qbool(q, "remote"),
+        )
+        try:
+            resp = self.api.query(req)
+        except Exception as e:  # errors travel in QueryResponse.Err
+            from ..executor import QueryResponse as _QR
+
+            payload = proto.encode_query_response(_QR([]), err=str(e))
+            return 400, proto.CONTENT_TYPE, payload
+        if proto.CONTENT_TYPE in accept:
+            return 200, proto.CONTENT_TYPE, proto.encode_query_response(resp)
+        return 200, "application/json", json.dumps(response_to_json(resp)).encode()
+
+    def _import_proto(self, index, field, q, body):
+        from . import proto
+
+        doc = proto.decode_import_request(body)
+        if doc["columnIDs"] or doc["columnKeys"]:
+            self.api.import_bits(
+                ImportRequest(
+                    index,
+                    field,
+                    shard=doc["shard"],
+                    row_ids=doc["rowIDs"],
+                    column_ids=doc["columnIDs"],
+                    row_keys=doc["rowKeys"],
+                    column_keys=doc["columnKeys"],
+                    timestamps=doc["timestamps"],
+                ),
+                remote=_qbool(q, "remote"),
+            )
+        return 200, proto.CONTENT_TYPE, b""
 
     # -- handlers ----------------------------------------------------------
 
@@ -346,7 +419,7 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         status, ctype, payload = self.handler.handle(
-            method, parsed.path, query, body
+            method, parsed.path, query, body, dict(self.headers)
         )
         self.send_response(status)
         self.send_header("Content-Type", ctype)
