@@ -1,0 +1,145 @@
+"""Pallas TPU kernels for the bitmap hot path.
+
+The XLA-level kernels in ops.bitops already fuse op+popcount+reduce; the
+Pallas versions here control the HBM->VMEM pipeline explicitly for the
+largest inputs — the fragment-matrix sweeps where a query touches every
+row of every resident shard (TopN scoring, multi-row scans).  Each has an
+XLA fallback (``*_xla``) used automatically off-TPU; correctness tests
+compare the two.
+
+Word layout: rows are uint32[..., WORDS] with WORDS = 32768 (one 2^20-bit
+shard row = 128 KiB), so a (256, 128)-word tile is exactly one VMEM-sized
+block and the lane dimension is already 128-aligned.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import bitops
+
+_BLOCK_ROWS = 8  # rows per grid step: 8 * 128 KiB = 1 MiB of VMEM traffic
+
+
+def _pc(x):
+    return jax.lax.population_count(x).astype(jnp.int32)
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# -- fused AND + popcount over a row matrix ---------------------------------
+
+def _and_popcount_kernel(mat_ref, row_ref, out_ref):
+    """counts[i] = popcount(mat[i] & row) for a block of rows."""
+    inter = jnp.bitwise_and(mat_ref[:, :], row_ref[:, :])
+    out_ref[:, :] = jnp.sum(
+        jax.lax.population_count(inter).astype(jnp.int32),
+        axis=-1,
+        keepdims=True,
+    )
+
+
+def matrix_and_popcount(matrix, row, interpret: bool = False):
+    """int32[n_rows] intersection counts of every matrix row with ``row``
+    (the TopN scoring sweep, fragment.go top :1089) as a Pallas grid over
+    row blocks; falls back to XLA off-TPU (interpret=True runs the Pallas
+    kernel in the interpreter for CPU tests)."""
+    if not (on_tpu() or interpret):
+        return matrix_and_popcount_xla(matrix, row)
+    n_rows, words = matrix.shape
+    # VMEM budget: block * 128 KiB * 2 (double buffering) must stay well
+    # under the ~16 MiB scoped limit.
+    block = min(_BLOCK_ROWS, n_rows)
+    if n_rows % block != 0:
+        return matrix_and_popcount_xla(matrix, row)
+    return _matrix_and_popcount_pallas(matrix, row, block, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _matrix_and_popcount_pallas(matrix, row, block: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    n_rows, words = matrix.shape
+    out = pl.pallas_call(
+        _and_popcount_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_rows, 1), jnp.int32),
+        grid=(n_rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, words), lambda i: (i, 0)),
+            pl.BlockSpec((1, words), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(matrix, row[None, :])
+    return out[:, 0]
+
+
+@jax.jit
+def matrix_and_popcount_xla(matrix, row):
+    return jnp.sum(_pc(jnp.bitwise_and(matrix, row[None, :])), axis=-1)
+
+
+# -- fused pairwise set-op + popcount ---------------------------------------
+
+def _count_op_kernel(op_kind, a_ref, b_ref, out_ref):
+    a = a_ref[:, :]
+    b = b_ref[:, :]
+    if op_kind == 0:
+        x = jnp.bitwise_and(a, b)
+    elif op_kind == 1:
+        x = jnp.bitwise_or(a, b)
+    elif op_kind == 2:
+        x = jnp.bitwise_and(a, jnp.bitwise_not(b))
+    else:
+        x = jnp.bitwise_xor(a, b)
+    out_ref[:, :] = jnp.sum(
+        jax.lax.population_count(x).astype(jnp.int32)
+    ).reshape(1, 1)
+
+
+def count_op(op_kind: int, a, b, interpret: bool = False):
+    """popcount(a OP b) for two word vectors; op_kind 0=and 1=or 2=andnot
+    3=xor (the per-container kernel matrix of roaring.go:2292-2800,
+    collapsed)."""
+    if not (on_tpu() or interpret):
+        return count_op_xla(op_kind, a, b)
+    return _count_op_pallas(op_kind, a, b, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _count_op_pallas(op_kind: int, a, b, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    words = a.shape[-1]
+    out = pl.pallas_call(
+        functools.partial(_count_op_kernel, op_kind),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        in_specs=[
+            pl.BlockSpec((1, words), lambda: (0, 0)),
+            pl.BlockSpec((1, words), lambda: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda: (0, 0)),
+        interpret=interpret,
+    )(a[None, :], b[None, :])
+    return out[0, 0]
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def count_op_xla(op_kind: int, a, b):
+    if op_kind == 0:
+        x = jnp.bitwise_and(a, b)
+    elif op_kind == 1:
+        x = jnp.bitwise_or(a, b)
+    elif op_kind == 2:
+        x = jnp.bitwise_and(a, jnp.bitwise_not(b))
+    else:
+        x = jnp.bitwise_xor(a, b)
+    return jnp.sum(_pc(x))
