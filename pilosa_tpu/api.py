@@ -107,7 +107,13 @@ class API:
         stats=None,
         tracer=None,
         mesh_engine=None,
+        long_query_time: float = 0.0,
+        logger=None,
     ):
+        from .util import NopLogger
+
+        self.long_query_time = long_query_time
+        self.logger = logger if logger is not None else NopLogger()
         self.holder = holder if holder is not None else Holder()
         if not self.holder.opened:
             self.holder.open()
@@ -159,7 +165,21 @@ class API:
             exclude_columns=req.exclude_columns,
             column_attrs=req.column_attrs,
         )
-        return self.executor.execute(req.index, req.query, req.shards, opt)
+        import time
+
+        start = time.monotonic()
+        resp = self.executor.execute(req.index, req.query, req.shards, opt)
+        # Long-query logging (api.go:1021, server LongQueryTime).
+        elapsed = time.monotonic() - start
+        if self.long_query_time and elapsed > self.long_query_time:
+            self.logger.printf(
+                "%.3fs > %.1fs: %s %s",
+                elapsed,
+                self.long_query_time,
+                req.index,
+                req.query[:200],
+            )
+        return resp
 
     # -- schema (api.go :129-386, 625-687) ---------------------------------
 

@@ -22,6 +22,7 @@ fragment versions, replacing the reference's mmap residency
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -74,11 +75,22 @@ class _Lowering:
         return len(self.operands) - 1
 
 
+DEFAULT_RESIDENCY_BYTES = 8 << 30  # HBM budget for resident field stacks
+
+
 class MeshEngine:
-    def __init__(self, holder, mesh: Mesh):
+    def __init__(self, holder, mesh: Mesh, max_resident_bytes: int = DEFAULT_RESIDENCY_BYTES):
         self.holder = holder
         self.mesh = mesh
-        self._stacks: Dict[Tuple[str, str, str, Tuple[int, ...]], _FieldStack] = {}
+        # LRU residency manager: hot field stacks stay dense in HBM up to
+        # the budget, cold ones are dropped back to host truth (the
+        # explicit replacement for the reference's mmap paging,
+        # fragment.go:190-247; SURVEY.md "dense-vs-sparse blowup").
+        self.max_resident_bytes = max_resident_bytes
+        self._stacks: "OrderedDict[Tuple[str, str, str, Tuple[int, ...]], _FieldStack]" = (
+            OrderedDict()
+        )
+        self._resident_bytes = 0
         self._zeros: Dict[int, object] = {}
         self._scalars: Dict[int, object] = {}
         self._bits: Dict[Tuple[int, int], object] = {}
@@ -111,7 +123,10 @@ class MeshEngine:
         versions = tuple(-1 if f is None else f._version for f in frags)
         cached = self._stacks.get(key)
         if cached is not None and cached.versions == versions:
+            self._stacks.move_to_end(key)
             return cached
+        if cached is not None:
+            self._evict(key)
 
         row_ids = sorted(
             {r for f in frags if f is not None for r in f.row_ids()}
@@ -126,6 +141,11 @@ class MeshEngine:
                 continue
             for r, words in f.rows.items():
                 mat[si, row_index[r]] = words.view("<u4")
+        while (
+            self._resident_bytes + mat.nbytes > self.max_resident_bytes
+            and self._stacks
+        ):
+            self._evict(next(iter(self._stacks)))
         stack = _FieldStack(
             jax.device_put(jnp.asarray(mat), shard_sharding(self.mesh)),
             row_index,
@@ -133,7 +153,14 @@ class MeshEngine:
             list(shards),
         )
         self._stacks[key] = stack
+        self._resident_bytes += mat.nbytes
         return stack
+
+    def _evict(self, key):
+        stack = self._stacks.pop(key, None)
+        if stack is not None:
+            self._resident_bytes -= stack.matrix.nbytes
+            stack.matrix.delete()
 
     def _zero_stack(self, shards):
         """Cached zeros uint32[S, 1, WORDS] used as the empty-leaf operand."""

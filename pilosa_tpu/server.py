@@ -111,6 +111,8 @@ class Server:
             stats=self.stats,
             tracer=self.tracer,
             mesh_engine=mesh_engine,
+            long_query_time=self.config.cluster_long_query_time,
+            logger=self.logger,
         )
         self._http, self._http_thread = serve(
             self.api, host if host not in ("", "0.0.0.0") else "0.0.0.0", port
@@ -207,6 +209,19 @@ class Server:
             self.diagnostics = Diagnostics(
                 api=self.api, logger=self.logger
             ).start()
+        # Translate-store replication from the primary (translate.go
+        # monitorReplication :358-432).
+        if self.config.translation_primary_url:
+            self.translate_store.read_only = True
+            self._spawn(self._replicate_translate, 1.0)
+
+    def _replicate_translate(self):
+        from .net import InternalClient
+
+        client = InternalClient(self.config.translation_primary_url)
+        data = client.translate_data(self.translate_store.size())
+        if data:
+            self.translate_store.apply_log(data)
 
     def start_anti_entropy(self, interval: Optional[float] = None):
         """Spawn the anti-entropy loop (server.go monitorAntiEntropy
@@ -214,8 +229,15 @@ class Server:
         from .cluster.syncer import HolderSyncer
 
         self.syncer = HolderSyncer(self.holder, self.cluster, self.logger)
+
+        def sync_and_clean():
+            self.syncer.sync_holder()
+            # Drop fragments this node no longer owns (holder.go
+            # holderCleaner :852-902).
+            self.cluster.clean_holder()
+
         self._spawn(
-            self.syncer.sync_holder,
+            sync_and_clean,
             interval
             if interval is not None
             else self.config.anti_entropy_interval,
@@ -241,11 +263,16 @@ class Server:
                         frag.flush_cache()
 
     def _monitor_runtime(self):
+        """Runtime metrics loop (server.go monitorRuntime :726-790:
+        goroutines/GC/open-FDs become threads/gc-collections/open-FDs)."""
+        import gc
         import resource
 
         usage = resource.getrusage(resource.RUSAGE_SELF)
         self.stats.gauge("maxrss_kb", usage.ru_maxrss)
         self.stats.gauge("threads", threading.active_count())
+        for gen, st in enumerate(gc.get_stats()):
+            self.stats.gauge(f"gc.gen{gen}.collections", st["collections"])
         try:
             self.stats.gauge("openFiles", len(os.listdir("/proc/self/fd")))
         except OSError:

@@ -135,3 +135,26 @@ def test_mesh_uneven_shards(holder, mesh):
     eng = MeshEngine(holder, mesh)
     call = pql.parse("Row(f=5)").calls[0]
     assert eng.count("i", call, [0, 1, 2]) == 3
+
+
+def test_residency_eviction(holder, mesh):
+    """The HBM residency manager evicts cold stacks under budget pressure."""
+    idx = holder.create_index("i")
+    for name in ("a", "b", "c"):
+        f = idx.create_field(name)
+        f.import_bulk([1], [0])
+    from pilosa_tpu.parallel.engine import MeshEngine
+
+    stack_bytes = 8 * 1 * 32768 * 4  # S=8(padded), R=1 rows, WORDS, u32
+    eng = MeshEngine(holder, mesh, max_resident_bytes=2 * stack_bytes)
+    eng.field_stack("i", "a", "standard", [0])
+    eng.field_stack("i", "b", "standard", [0])
+    assert len(eng._stacks) == 2
+    eng.field_stack("i", "c", "standard", [0])  # evicts "a" (LRU)
+    assert len(eng._stacks) == 2
+    keys = [k[1] for k in eng._stacks]
+    assert keys == ["b", "c"]
+    assert eng._resident_bytes <= 2 * stack_bytes
+    # Evicted stacks rebuild transparently.
+    call = pql.parse("Row(a=1)").calls[0]
+    assert eng.count("i", call, [0]) == 1
