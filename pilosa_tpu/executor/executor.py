@@ -808,6 +808,15 @@ class Executor:
     def _execute_sum(self, index, c: Call, shards, opt) -> ValCount:
         from ..ops import bsi as bsi_ops
 
+        fused = self._mesh_sum(index, c, shards, opt)
+        if fused is not None:
+            local_shards, fused_vc = fused
+            remote = [s for s in shards if s not in local_shards]
+            if remote:
+                rest = self._execute_sum(index, c, remote, opt)
+                fused_vc = fused_vc.add(rest)
+            return ValCount() if fused_vc.count == 0 else fused_vc
+
         def map_fn(shard):
             ctx = self._bsi_shard_ctx(index, c, shard)
             if ctx is None:
@@ -825,6 +834,31 @@ class Executor:
         )
         result = result or ValCount()
         return ValCount() if result.count == 0 else result
+
+    def _mesh_sum(self, index, c: Call, shards, opt):
+        """Fused BSI Sum over the local shard set; (local_shards, ValCount)
+        or None when unsupported."""
+        if self.mesh_engine is None:
+            return None
+        field_name = c.args.get("field")
+        if not field_name or len(c.children) > 1:
+            return None
+        if self.cluster is None:
+            local = list(shards)
+        else:
+            local = [
+                s
+                for s in shards
+                if self.cluster.owns_shard(self.cluster.node.id, index, s)
+            ]
+        if not local:
+            return None
+        filter_call = c.children[0] if c.children else None
+        try:
+            total, n = self.mesh_engine.sum(index, field_name, filter_call, local)
+        except ValueError:
+            return None
+        return set(local), ValCount(total, n)
 
     def _execute_min_max(self, index, c: Call, shards, opt, is_min: bool) -> ValCount:
         from ..ops import bsi as bsi_ops

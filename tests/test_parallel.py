@@ -158,3 +158,19 @@ def test_residency_eviction(holder, mesh):
     # Evicted stacks rebuild transparently.
     call = pql.parse("Row(a=1)").calls[0]
     assert eng.count("i", call, [0]) == 1
+
+
+def test_executor_with_mesh_engine(holder, mesh):
+    """Executor fast paths (Count/Sum) through the fused engine give the
+    same answers as the per-shard path."""
+    build_data(holder)
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    for q in [
+        "Count(Intersect(Row(f=10), Row(f=11)))",
+        "Count(Not(Row(f=10)))",
+        "Count(Range(v > 500))",
+        "Sum(field=v)",
+        "Sum(Row(f=10), field=v)",
+    ]:
+        assert fused.execute("i", q).results == plain.execute("i", q).results, q
