@@ -1,0 +1,61 @@
+"""Multi-host mesh initialization.
+
+Scales the shard mesh past one host the JAX-native way: every host in a
+pod slice runs the same program, ``jax.distributed`` wires the XLA
+coordination service, and the mesh spans ``jax.devices()`` globally —
+collectives then ride ICI within the slice (and DCN between slices)
+without any change to the kernels in this package
+(SURVEY.md §2.3 "TPU-native equivalent").
+
+The host-level cluster (pilosa_tpu.cluster) stays on as the ingest /
+schema / membership control plane: one pilosa node process per host, each
+owning the shards its devices hold.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .mesh import make_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+):
+    """Initialize the JAX distributed runtime (no-op when single-process
+    or already initialized).  On TPU pods the arguments are discovered
+    from the environment; set them explicitly for CPU/GPU multi-process
+    testing (jax.distributed.initialize semantics)."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # Already initialized (or single-process context).
+        pass
+
+
+def global_mesh(n_devices: Optional[int] = None):
+    """A shard mesh over every device in the (possibly multi-host)
+    runtime.  With jax.distributed initialized, jax.devices() spans all
+    hosts and the returned mesh shards the leading axis globally; each
+    host feeds its addressable slice of any sharded array."""
+    return make_mesh(n_devices)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
