@@ -24,6 +24,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -48,6 +49,18 @@ TRUE_ROW_ID = 1
 def _empty_row() -> np.ndarray:
     return np.zeros(WORDS64, dtype=np.uint64)
 
+
+
+def _locked(fn):
+    """Run under the fragment mutex (fragment.go:88 RWMutex discipline)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._mu:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 class Fragment:
     """One shard of one view of one field."""
@@ -84,6 +97,11 @@ class Fragment:
 
         self.op_n = 0
         self._op_file = None
+        # Coarse per-fragment lock: the stand-in for the reference's
+        # per-fragment RWMutex (fragment.go:88); serializes host-truth
+        # mutation, snapshot, and device-mirror sync under the threaded
+        # HTTP server.
+        self._mu = threading.RLock()
 
         # Device mirror state.
         self._version = 0
@@ -147,6 +165,7 @@ class Fragment:
             return np.empty(0, dtype=np.uint64)
         return np.concatenate(chunks)
 
+    @_locked
     def snapshot(self):
         """Compact: write a fresh roaring snapshot, truncate the op-log
         (atomic temp-file + rename, fragment.go:1737-1776)."""
@@ -216,6 +235,7 @@ class Fragment:
         self._version += 1
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
 
+    @_locked
     def set_bit(self, row_id: int, column_id: int) -> bool:
         if self.mutex:
             self._handle_mutex(row_id, column_id)
@@ -253,6 +273,7 @@ class Fragment:
         self.cache.add(row_id, self.row_counts[row_id])
         return True
 
+    @_locked
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         return self._clear_bit(row_id, column_id)
 
@@ -303,6 +324,7 @@ class Fragment:
 
     # -- device mirror -----------------------------------------------------
 
+    @_locked
     def _sync_device(self):
         import jax.numpy as jnp
 
@@ -356,6 +378,7 @@ class Fragment:
                 value |= 1 << i
         return value, True
 
+    @_locked
     def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
         """Write a BSI value + not-null bit (fragment.go:634-689)."""
         changed = False
@@ -367,6 +390,7 @@ class Fragment:
         changed |= self._set_bit(bit_depth, column_id)
         return changed
 
+    @_locked
     def clear_value(self, column_id: int, bit_depth: int, value: int) -> bool:
         changed = False
         for i in range(bit_depth):
@@ -379,6 +403,7 @@ class Fragment:
 
     # -- bulk import -------------------------------------------------------
 
+    @_locked
     def bulk_import(self, row_ids: Iterable[int], column_ids: Iterable[int]) -> int:
         """Set many bits at once, updating caches once per row and taking a
         single snapshot — bypassing the op-log (fragment.go:1445-1533).
@@ -427,6 +452,7 @@ class Fragment:
             self.set_value(c, bit_depth, v)
         self.snapshot()
 
+    @_locked
     def import_roaring(self, data: bytes, clear: bool = False) -> int:
         """Union (or with ``clear``, subtract) a serialized roaring bitmap
         straight into storage — the fast ingest path
@@ -482,6 +508,7 @@ class Fragment:
             self.cache.bulk_add(r, self.row_counts[r])
         self.cache.invalidate()
 
+    @_locked
     def clear_row(self, row_id: int) -> bool:
         """Remove every bit in a row, snapshot (fragment.go clearRow :551,
         unprotectedClearRow)."""
@@ -493,6 +520,7 @@ class Fragment:
         self.snapshot()
         return changed
 
+    @_locked
     def set_row(self, row, row_id: int) -> bool:
         """Overwrite a row with a Row's segment for this shard, snapshot
         (fragment.go setRow :501 — Store()/SetRow support)."""
@@ -638,6 +666,7 @@ class Fragment:
 
     # -- anti-entropy blocks (fragment.go Blocks :1226-1321) ---------------
 
+    @_locked
     def checksum_blocks(self) -> List[Tuple[int, bytes]]:
         """(block_idx, checksum) for each non-empty 100-row block."""
         blocks: Dict[int, List[int]] = {}

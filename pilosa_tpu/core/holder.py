@@ -8,6 +8,7 @@ the executor, and hosts the anti-entropy syncer (cluster stage).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional
 
 from .fragment import Fragment
@@ -29,6 +30,8 @@ class Holder:
         self.on_create_shard = on_create_shard
         self.attr_store_factory = attr_store_factory
         self.opened = False
+        # Guards concurrent index creation (holder.go mu).
+        self._mu = threading.RLock()
 
     def open(self):
         if self.path is not None:
@@ -79,17 +82,19 @@ class Holder:
     def create_index(
         self, name: str, keys: bool = False, track_existence: bool = True
     ) -> Index:
-        if name in self.indexes:
-            raise ValueError(f"index already exists: {name}")
-        return self._create(name, keys, track_existence)
+        with self._mu:
+            if name in self.indexes:
+                raise ValueError(f"index already exists: {name}")
+            return self._create(name, keys, track_existence)
 
     def create_index_if_not_exists(
         self, name: str, keys: bool = False, track_existence: bool = True
     ) -> Index:
-        idx = self.indexes.get(name)
-        if idx is not None:
-            return idx
-        return self._create(name, keys, track_existence)
+        with self._mu:
+            idx = self.indexes.get(name)
+            if idx is not None:
+                return idx
+            return self._create(name, keys, track_existence)
 
     def _create(self, name: str, keys: bool, track_existence: bool) -> Index:
         from .index import validate_name

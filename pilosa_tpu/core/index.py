@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Dict, List, Optional
 
 from ..roaring import Bitmap
@@ -35,6 +36,7 @@ class Index:
         self.keys = keys
         self.track_existence = track_existence
         self.fields: Dict[str, Field] = {}
+        self._mu = threading.RLock()
         self.cache_debounce = cache_debounce
         self.on_create_shard = on_create_shard
         self._attr_store_factory = attr_store_factory or AttrStore
@@ -115,17 +117,19 @@ class Index:
         return self.fields.get(name)
 
     def create_field(self, name: str, options: Optional[FieldOptions] = None) -> Field:
-        if name in self.fields:
-            raise ValueError(f"field already exists: {name}")
-        return self._create(name, options)
+        with self._mu:
+            if name in self.fields:
+                raise ValueError(f"field already exists: {name}")
+            return self._create(name, options)
 
     def create_field_if_not_exists(
         self, name: str, options: Optional[FieldOptions] = None
     ) -> Field:
-        f = self.fields.get(name)
-        if f is not None:
-            return f
-        return self._create(name, options)
+        with self._mu:
+            f = self.fields.get(name)
+            if f is not None:
+                return f
+            return self._create(name, options)
 
     def _create(self, name: str, options: Optional[FieldOptions]) -> Field:
         validate_name(name)
