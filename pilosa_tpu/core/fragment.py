@@ -578,6 +578,8 @@ class Fragment:
         filter_name: str = "",
         filter_values: Optional[list] = None,
         tanimoto_threshold: int = 0,
+        src_counts: Optional[Dict[int, int]] = None,
+        src_count_total: Optional[int] = None,
     ) -> List[Tuple[int, int]]:
         """fragment.go top :1018-1150, exactly — the candidate walk with its
         min-heap, threshold early-exits, attribute filter, and Tanimoto
@@ -595,29 +597,34 @@ class Fragment:
 
         filters = set(filter_values) if (filter_name and filter_values) else None
 
+        has_src = src is not None or src_counts is not None
         src_count = 0
         min_tan = max_tan = 0.0
-        if tanimoto_threshold > 0 and src is not None:
-            src_count = src.count()
+        if tanimoto_threshold > 0 and has_src:
+            src_count = (
+                src_count_total if src_count_total is not None else src.count()
+            )
             min_tan = src_count * tanimoto_threshold / 100.0
             max_tan = src_count * 100.0 / tanimoto_threshold
 
-        # Batched device scoring of every candidate against src.
-        src_counts: Dict[int, int] = {}
-        if src is not None:
-            seg = src.segment(self.shard)
-            _, idx = self.device_matrix()
-            present = [r for r, _ in pairs if r in idx]
-            if seg is not None and present:
-                import jax.numpy as jnp
+        # Batched device scoring of every candidate against src (callers
+        # that batch ACROSS shards pass src_counts precomputed).
+        if src_counts is None:
+            src_counts = {}
+            if src is not None:
+                seg = src.segment(self.shard)
+                _, idx = self.device_matrix()
+                present = [r for r, _ in pairs if r in idx]
+                if seg is not None and present:
+                    import jax.numpy as jnp
 
-                sel = self._dev_matrix[
-                    np.array([idx[r] for r in present], dtype=np.int32)
-                ]
-                counts = np.asarray(
-                    bitops.popcount_and_rows(sel, jnp.asarray(seg))
-                )
-                src_counts = dict(zip(present, counts.tolist()))
+                    sel = self._dev_matrix[
+                        np.array([idx[r] for r in present], dtype=np.int32)
+                    ]
+                    counts = np.asarray(
+                        bitops.popcount_and_rows(sel, jnp.asarray(seg))
+                    )
+                    src_counts = dict(zip(present, counts.tolist()))
 
         # heap of (count, id): smallest count on top (pairHeap is a min-heap).
         heap: List[Tuple[int, int]] = []
@@ -638,7 +645,7 @@ class Fragment:
                     continue
 
             if n == 0 or len(heap) < n:
-                count = src_counts.get(row_id, 0) if src is not None else cnt
+                count = src_counts.get(row_id, 0) if has_src else cnt
                 if count == 0:
                     continue
                 if tanimoto_threshold > 0:
@@ -648,7 +655,7 @@ class Fragment:
                 elif count < min_threshold:
                     continue
                 heapq.heappush(heap, (count, row_id))
-                if n > 0 and len(heap) == n and src is None:
+                if n > 0 and len(heap) == n and not has_src:
                     break
                 continue
 

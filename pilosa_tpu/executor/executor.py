@@ -916,6 +916,10 @@ class Executor:
         return trimmed
 
     def _execute_topn_shards(self, index, c, shards, opt):
+        fused = self._mesh_topn_shards(index, c, shards, opt)
+        if fused is not None:
+            return fused
+
         def map_fn(shard):
             return self._execute_topn_shard(index, c, shard)
 
@@ -923,6 +927,83 @@ class Executor:
             return cache_mod.merge_pairs([prev or [], v])
 
         pairs = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
+        pairs.sort(key=cache_mod.pair_sort_key)
+        return pairs
+
+    def _mesh_topn_shards(self, index, c: Call, shards, opt):
+        """Batched TopN phase 1: the per-candidate src intersection counts
+        for EVERY shard computed in one sharded dispatch pair, then the
+        reference's per-shard heap walk runs host-side on the precomputed
+        scores.  Applies only when all shards are local and a src row is
+        given (the scoring is the hot part; without src the walk is pure
+        cache reads)."""
+        if self.mesh_engine is None or len(c.children) != 1:
+            return None
+        if self.cluster is not None and any(
+            not self.cluster.owns_shard(self.cluster.node.id, index, s)
+            for s in shards
+        ):
+            return None
+        field_name = c.args.get("_field") or DEFAULT_FIELD
+        n, _ = c.uint_arg("n")
+        attr_name = c.args.get("attrName", "")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        attr_values = c.args.get("attrValues")
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise Error("Tanimoto Threshold is from 1 to 100 only")
+        if min_threshold <= 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+
+        frags = {}
+        cand_set = set()
+        for s in shards:
+            frag = self.holder.fragment(index, field_name, VIEW_STANDARD, s)
+            if frag is None:
+                continue
+            pairs = (
+                [(r, frag.row_count(r)) for r in row_ids]
+                if row_ids
+                else list(frag.cache.top())
+            )
+            frags[s] = frag
+            cand_set.update(r for r, _ in pairs)
+        if not frags:
+            return []
+        candidates = sorted(cand_set)
+        try:
+            scored = self.mesh_engine.topn_scores(
+                index, field_name, candidates, c.children[0], shards
+            )
+        except ValueError:
+            return None
+        if scored is None:
+            return []
+        scores, src_counts = scored
+        cand_pos = {r: i for i, r in enumerate(candidates)}
+
+        all_pairs = []
+        for si, s in enumerate(shards):
+            frag = frags.get(s)
+            if frag is None:
+                continue
+            per_shard = {
+                r: int(scores[si, cand_pos[r]]) for r in cand_set
+            }
+            all_pairs.append(
+                frag.top(
+                    n=int(n),
+                    row_ids=row_ids or None,
+                    min_threshold=min_threshold,
+                    filter_name=attr_name,
+                    filter_values=attr_values,
+                    tanimoto_threshold=tanimoto,
+                    src_counts=per_shard,
+                    src_count_total=int(src_counts[si]),
+                )
+            )
+        pairs = cache_mod.merge_pairs(all_pairs)
         pairs.sort(key=cache_mod.pair_sort_key)
         return pairs
 

@@ -350,19 +350,26 @@ class MeshEngine:
     def topn_scores(
         self, index: str, field: str, candidate_rows: List[int], src_call: Call, shards
     ):
-        """Batched TopN phase-1 scoring: intersection counts of every
-        candidate row x src tree, per shard."""
+        """Batched TopN phase-1 scoring across ALL shards in one
+        dispatch pair: (scores int32[S, K], src_counts int32[S]).
+        Candidates absent from the row table score 0."""
         from . import kernels
 
         stack = self.field_stack(index, field, VIEW_STANDARD, shards)
         if stack is None:
             return None
+        present = np.asarray(
+            [r in stack.row_index for r in candidate_rows], dtype=bool
+        )
         idxs = np.asarray(
             [stack.row_index.get(r, 0) for r in candidate_rows], dtype=np.int32
         )
         cands = stack.matrix[:, idxs, :]
         src = self.bitmap_stack(index, src_call, shards)
-        return np.asarray(kernels.topn_scores_sharded(self.mesh, cands, src))
+        scores = np.asarray(kernels.topn_scores_sharded(self.mesh, cands, src))
+        scores[:, ~present] = 0
+        src_counts = np.asarray(kernels.counts_per_shard(self.mesh, src))
+        return scores, src_counts
 
 
 def _gather_planes(mat, pspec):

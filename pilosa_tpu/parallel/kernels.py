@@ -82,6 +82,22 @@ def topn_scores_sharded(mesh, candidates, src):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
+def _counts_per_shard(mesh, stack):
+    """Per-shard popcount of uint32[S, W] -> int32[S] (kept sharded)."""
+
+    def body(block):
+        return jnp.sum(_pc(block), axis=-1)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P(SHARD_AXIS)
+    )(stack)
+
+
+def counts_per_shard(mesh, stack):
+    return _counts_per_shard(mesh, stack)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
 def _sum_planes_sharded(mesh, planes, filt):
     """BSI Sum over the mesh: planes uint32[S, D+1, W], filter uint32[S, W]
     -> (int32[D] per-plane counts, int32 considered-count), both replicated.

@@ -174,3 +174,18 @@ def test_executor_with_mesh_engine(holder, mesh):
         "Sum(Row(f=10), field=v)",
     ]:
         assert fused.execute("i", q).results == plain.execute("i", q).results, q
+
+
+def test_executor_mesh_topn(holder, mesh):
+    """Batched TopN phase-1 matches the per-shard path."""
+    build_data(holder)
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    for q in [
+        "TopN(f, Row(f=11), n=3)",
+        "TopN(f, Row(f=11))",
+        "TopN(f, Row(f=11), ids=[10, 11])",
+        "TopN(f, Row(f=11), threshold=100)",
+        "TopN(f, Row(f=11), tanimotoThreshold=30)",
+    ]:
+        assert fused.execute("i", q).results == plain.execute("i", q).results, q
