@@ -366,7 +366,8 @@ class MeshEngine:
         )
         cands = stack.matrix[:, idxs, :]
         src = self.bitmap_stack(index, src_call, shards)
-        scores = np.asarray(kernels.topn_scores_sharded(self.mesh, cands, src))
+        # np.array (copy): device-array views are read-only host buffers.
+        scores = np.array(kernels.topn_scores_sharded(self.mesh, cands, src))
         scores[:, ~present] = 0
         src_counts = np.asarray(kernels.counts_per_shard(self.mesh, src))
         return scores, src_counts

@@ -177,15 +177,23 @@ def test_executor_with_mesh_engine(holder, mesh):
 
 
 def test_executor_mesh_topn(holder, mesh):
-    """Batched TopN phase-1 matches the per-shard path."""
+    """Batched TopN phase-1 matches the per-shard path AND is actually
+    taken (no silent fallback)."""
     build_data(holder)
     plain = Executor(holder)
-    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    engine = MeshEngine(holder, mesh)
+    calls = []
+    orig = engine.topn_scores
+    engine.topn_scores = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    fused = Executor(holder, mesh_engine=engine)
+    # Candidate including a row id absent from the data (99).
     for q in [
         "TopN(f, Row(f=11), n=3)",
         "TopN(f, Row(f=11))",
-        "TopN(f, Row(f=11), ids=[10, 11])",
+        "TopN(f, Row(f=11), ids=[10, 11, 99])",
         "TopN(f, Row(f=11), threshold=100)",
         "TopN(f, Row(f=11), tanimotoThreshold=30)",
     ]:
+        calls.clear()
         assert fused.execute("i", q).results == plain.execute("i", q).results, q
+        assert calls, f"mesh path not used for {q}"
