@@ -1101,21 +1101,90 @@ class Executor:
                 if not child_rows[i]:
                     return []
 
-        def map_fn(shard):
-            return self._execute_group_by_shard(
-                index, c, filter_call, shard, child_rows
+        results = self._mesh_group_by(index, c, filter_call, shards, opt)
+        if results is None:
+
+            def map_fn(shard):
+                return self._execute_group_by_shard(
+                    index, c, filter_call, shard, child_rows
+                )
+
+            def reduce_fn(prev, v):
+                return _merge_group_counts(prev or [], v, limit)
+
+            results = (
+                self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
             )
-
-        def reduce_fn(prev, v):
-            return _merge_group_counts(prev or [], v, limit)
-
-        results = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
 
         offset, has_offset = c.uint_arg("offset")
         if has_offset and offset < len(results):
             results = results[offset:]
         if has_limit and limit < len(results):
             results = results[:limit]
+        return results
+
+    def _mesh_group_by(self, index, c: Call, filter_call, shards, opt):
+        """Fused GroupBy: all group-combination counts in one sharded
+        dispatch.  Applies to 1-2 plain ``Rows(field=f)`` children (no
+        column/limit/previous) with every shard local; the merged list is
+        then truncated to `limit` like the reference's progressive merge."""
+        if self.mesh_engine is None or not (1 <= len(c.children) <= 2):
+            return None
+        for child in c.children:
+            extra = set(child.args) - {"field"}
+            if child.name != "Rows" or extra:
+                return None
+        if self.cluster is not None and any(
+            not self.cluster.owns_shard(self.cluster.node.id, index, s)
+            for s in shards
+        ):
+            return None
+        fields = [child.args["field"] for child in c.children]
+        row_lists = []
+        for f in fields:
+            rows = set()
+            for s in shards:
+                frag = self.holder.fragment(index, f, VIEW_STANDARD, s)
+                if frag is not None:
+                    rows.update(frag.row_ids())
+            row_lists.append(sorted(rows))
+        if any(not rows for rows in row_lists):
+            return []
+        try:
+            counts = self.mesh_engine.group_counts(
+                index, fields, row_lists, filter_call, shards
+            )
+        except ValueError:
+            return None
+        if counts is None:
+            return None
+        limit_arg, has_limit = c.uint_arg("limit")
+        limit = limit_arg if has_limit else _MAXINT
+        results: List[GroupCount] = []
+        if len(fields) == 1:
+            for i, r in enumerate(row_lists[0]):
+                n = int(counts[i])
+                if n > 0:
+                    results.append(GroupCount([FieldRow(fields[0], r)], n))
+                if len(results) >= limit:
+                    break
+        else:
+            done = False
+            for i, ra in enumerate(row_lists[0]):
+                for j, rb in enumerate(row_lists[1]):
+                    n = int(counts[i, j])
+                    if n > 0:
+                        results.append(
+                            GroupCount(
+                                [FieldRow(fields[0], ra), FieldRow(fields[1], rb)],
+                                n,
+                            )
+                        )
+                    if len(results) >= limit:
+                        done = True
+                        break
+                if done:
+                    break
         return results
 
     def _execute_group_by_shard(

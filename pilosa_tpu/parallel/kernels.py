@@ -179,20 +179,42 @@ def import_step_sharded(mesh, fragment_stack, batch_stack):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _group_counts_sharded(mesh, rows_a, rows_b):
+def _group_counts_sharded(mesh, rows_a, rows_b, filt):
     """GroupBy pair-count kernel: int32[Ka, Kb] intersection counts of all
-    row pairs, psum'd over shards (executor.go executeGroupByShard :1056
-    without the host iterator when both Rows lists are materialized)."""
+    row pairs (first level pre-masked by the filter row), psum'd over
+    shards — executeGroupByShard (executor.go:1056) without the host
+    iterator when both Rows lists are materialized."""
 
-    def body(a, b):
+    def body(a, b, f):
+        a = jnp.bitwise_and(a, f[:, None, :])
         inter = jnp.bitwise_and(a[:, :, None, :], b[:, None, :, :])
         counts = jnp.sum(_pc(inter), axis=(0, 3))
         return jax.lax.psum(counts, SHARD_AXIS)
 
     return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=P(),
+    )(rows_a, rows_b, filt)
+
+
+def group_counts_sharded(mesh, rows_a, rows_b, filt):
+    return _group_counts_sharded(mesh, rows_a, rows_b, filt)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _row_counts_sharded(mesh, rows, filt):
+    """Single-field GroupBy: int32[K] filtered row counts, psum'd."""
+
+    def body(a, f):
+        counts = jnp.sum(_pc(jnp.bitwise_and(a, f[:, None, :])), axis=(0, 2))
+        return jax.lax.psum(counts, SHARD_AXIS)
+
+    return shard_map(
         body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P()
-    )(rows_a, rows_b)
+    )(rows, filt)
 
 
-def group_counts_sharded(mesh, rows_a, rows_b):
-    return _group_counts_sharded(mesh, rows_a, rows_b)
+def row_counts_sharded(mesh, rows, filt):
+    return _row_counts_sharded(mesh, rows, filt)

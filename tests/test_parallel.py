@@ -197,3 +197,42 @@ def test_executor_mesh_topn(holder, mesh):
         calls.clear()
         assert fused.execute("i", q).results == plain.execute("i", q).results, q
         assert calls, f"mesh path not used for {q}"
+
+
+def test_executor_mesh_group_by(holder, mesh):
+    """Fused GroupBy matches the iterator path (and is actually taken)."""
+    idx = holder.create_index("i")
+    a = idx.create_field("a")
+    b = idx.create_field("b")
+    rng = np.random.default_rng(5)
+    rows, cols = [], []
+    for s in range(4):
+        base = s * SHARD_WIDTH
+        for r in range(5):
+            for c in rng.choice(1000, size=60, replace=False):
+                rows.append(r)
+                cols.append(base + int(c))
+    a.import_bulk(rows, cols)
+    b.import_bulk([r % 3 for r in rows], cols)
+
+    engine = MeshEngine(holder, mesh)
+    calls = []
+    orig = engine.group_counts
+    engine.group_counts = lambda *x, **k: calls.append(1) or orig(*x, **k)
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=engine)
+    for q in [
+        "GroupBy(Rows(field=a))",
+        "GroupBy(Rows(field=a), Rows(field=b))",
+        "GroupBy(Rows(field=a), Rows(field=b), limit=4)",
+        "GroupBy(Rows(field=a), Rows(field=b), filter=Row(a=1))",
+        "GroupBy(Rows(field=a), limit=2, offset=1)",
+    ]:
+        calls.clear()
+        assert fused.execute("i", q).results == plain.execute("i", q).results, q
+        assert calls, f"mesh path not used for {q}"
+    # previous args fall back to the iterator path.
+    q = "GroupBy(Rows(field=a, previous=1), Rows(field=b, previous=0))"
+    calls.clear()
+    assert fused.execute("i", q).results == plain.execute("i", q).results
+    assert not calls
