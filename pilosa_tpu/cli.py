@@ -160,6 +160,70 @@ def cmd_check(args) -> int:
     return 1 if failed else 0
 
 
+def cmd_backup(args) -> int:
+    """Stream every fragment of an index to a tar archive (the
+    fragment-level backup path, fragment.go WriteTo/ReadFrom :1823-1998
+    + http/client.go RetrieveShardFromURI :708)."""
+    import io
+    import json as json_mod
+    import tarfile
+
+    from .net import InternalClient
+
+    client = InternalClient(args.host)
+    schema = client.schema()
+    idx_info = next((i for i in schema if i["name"] == args.index), None)
+    if idx_info is None:
+        print(f"index not found: {args.index}")
+        return 1
+    shards = client.max_shards().get(args.index, 0)
+    with tarfile.open(args.output, "w:gz") as tar:
+        meta = json_mod.dumps(idx_info).encode()
+        info = tarfile.TarInfo(name="schema.json")
+        info.size = len(meta)
+        tar.addfile(info, io.BytesIO(meta))
+        n = 0
+        for f in idx_info["fields"]:
+            for shard in range(shards + 1):
+                try:
+                    data = client.retrieve_shard(args.index, f["name"], shard)
+                except Exception:
+                    continue
+                name = f"fragments/{f['name']}/{shard}"
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+                n += 1
+    print(f"backed up {n} fragments of {args.index} to {args.output}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """Restore a backup archive into a (possibly fresh) index."""
+    import json as json_mod
+    import tarfile
+
+    from .net import InternalClient
+
+    client = InternalClient(args.host)
+    with tarfile.open(args.input, "r:gz") as tar:
+        idx_info = json_mod.loads(tar.extractfile("schema.json").read())
+        index = args.index or idx_info["name"]
+        client.ensure_index(index, idx_info.get("options", {}).get("keys", False))
+        for f in idx_info["fields"]:
+            client.ensure_field(index, f["name"], f["options"])
+        n = 0
+        for member in tar.getmembers():
+            if not member.name.startswith("fragments/"):
+                continue
+            _, field, shard = member.name.split("/")
+            data = tar.extractfile(member).read()
+            client.send_fragment(index, field, int(shard), data)
+            n += 1
+    print(f"restored {n} fragments into {index}")
+    return 0
+
+
 def cmd_config(args) -> int:
     """ctl/config.go: print the effective configuration."""
     cfg = _load_config(args)
@@ -210,6 +274,18 @@ def main(argv=None) -> int:
     cp = sub.add_parser("check", help="check fragment data files")
     cp.add_argument("paths", nargs="+")
     cp.set_defaults(fn=cmd_check)
+
+    bp = sub.add_parser("backup", help="backup an index to a tar.gz")
+    bp.add_argument("--host", default="http://localhost:10101")
+    bp.add_argument("-i", "--index", required=True)
+    bp.add_argument("-o", "--output", required=True)
+    bp.set_defaults(fn=cmd_backup)
+
+    rp = sub.add_parser("restore", help="restore an index from a tar.gz")
+    rp.add_argument("--host", default="http://localhost:10101")
+    rp.add_argument("-i", "--index", default="")
+    rp.add_argument("input")
+    rp.set_defaults(fn=cmd_restore)
 
     cf = sub.add_parser("config", help="print effective config")
     cf.add_argument("-c", "--config", help="TOML config path")

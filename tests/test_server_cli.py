@@ -115,3 +115,22 @@ def test_cli_generate_config(capsys):
     assert cli_main(["generate-config"]) == 0
     out = capsys.readouterr().out
     assert "data-dir" in out and "[cluster]" in out
+
+
+def test_cli_backup_restore(tmp_path, server):
+    host = f"http://localhost:{server.port}"
+    client = InternalClient(host)
+    client.create_index("bk")
+    client.create_field("bk", "f")
+    client.query("bk", "Set(1, f=10) Set(2, f=11)")
+    archive = tmp_path / "bk.tar.gz"
+    assert cli_main(["backup", "--host", host, "-i", "bk", "-o", str(archive)]) == 0
+    assert archive.exists()
+    # Restore into a fresh index name on the same server.
+    assert (
+        cli_main(["restore", "--host", host, "-i", "bk2", str(archive)]) == 0
+    )
+    out = client.query("bk2", "Row(f=10)")
+    assert out["results"][0]["columns"] == [1]
+    out = client.query("bk2", "Row(f=11)")
+    assert out["results"][0]["columns"] == [2]
