@@ -207,7 +207,51 @@ class MeshEngine:
             return ("andnot", exist, sub)
         if name == "Range" and c.has_condition_arg():
             return self._lower_range(index, c, shards, lw)
+        if name == "Range":
+            return self._lower_time_range(index, c, shards, lw)
         raise ValueError(f"unsupported call for mesh path: {name}")
+
+    def _lower_time_range(self, index: str, c: Call, shards, lw: _Lowering):
+        """Time-quantum Range: OR of the row across the minimal view cover
+        (executor.go executeRangeShard :1233-1307) — each view's stack
+        contributes one row leaf, fused into the same dispatch."""
+        import datetime as dt
+
+        from ..core import timequantum
+
+        field_name = c.field_arg()
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise ValueError("Range() requires a row id")
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx is not None else None
+        if f is None:
+            raise ValueError(f"field not found: {field_name}")
+        start_str, end_str = c.args.get("_start"), c.args.get("_end")
+        if not isinstance(start_str, str) or not isinstance(end_str, str):
+            raise ValueError("Range() time bounds required")
+        start = dt.datetime.strptime(start_str, "%Y-%m-%dT%H:%M")
+        end = dt.datetime.strptime(end_str, "%Y-%m-%dT%H:%M")
+        q = f.time_quantum()
+        if not q:
+            return self._lower_zero(shards, lw)
+        leaves = []
+        for view_name in timequantum.views_by_time_range(
+            VIEW_STANDARD, start, end, q
+        ):
+            if f.view(view_name) is None:
+                continue
+            stack = self.field_stack(index, field_name, view_name, shards)
+            if stack is None or row_id not in stack.row_index:
+                continue
+            i_mat = lw.add_matrix(stack.matrix)
+            i_idx = lw.add_replicated(self._scalar(stack.row_index[row_id]))
+            leaves.append(("row", i_mat, i_idx))
+        if not leaves:
+            return self._lower_zero(shards, lw)
+        if len(leaves) == 1:
+            return leaves[0]
+        return ("or",) + tuple(leaves)
 
     def _lower_zero(self, shards, lw: _Lowering):
         return ("zero", lw.add_matrix(self._zero_stack(shards)))

@@ -236,3 +236,27 @@ def test_executor_mesh_group_by(holder, mesh):
     calls.clear()
     assert fused.execute("i", q).results == plain.execute("i", q).results
     assert not calls
+
+
+def test_mesh_time_range(holder, mesh):
+    """Time-quantum Range fuses into the mesh dispatch."""
+    idx = holder.create_index("i")
+    f = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+    ex = Executor(holder)
+    ex.execute(
+        "i",
+        f"""
+        Set(1, t=10, 2018-01-05T00:00)
+        Set({SHARD_WIDTH+2}, t=10, 2018-02-10T00:00)
+        Set(3, t=10, 2019-06-01T00:00)
+        """,
+    )
+    eng = MeshEngine(holder, mesh)
+    fused = Executor(holder, mesh_engine=eng)
+    for q in [
+        "Count(Range(t=10, 2018-01-01T00:00, 2018-12-31T00:00))",
+        "Count(Range(t=10, 2017-01-01T00:00, 2020-01-01T00:00))",
+        "Count(Range(t=10, 2019-01-01T00:00, 2019-12-31T00:00))",
+        "Count(Union(Range(t=10, 2018-01-01T00:00, 2018-03-01T00:00), Row(t=10)))",
+    ]:
+        assert fused.execute("i", q).results == ex.execute("i", q).results, q
