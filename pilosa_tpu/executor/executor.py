@@ -863,6 +863,17 @@ class Executor:
     def _execute_min_max(self, index, c: Call, shards, opt, is_min: bool) -> ValCount:
         from ..ops import bsi as bsi_ops
 
+        fused = self._mesh_min_max(index, c, shards, opt, is_min)
+        if fused is not None:
+            local_shards, fused_vc = fused
+            remote = [s for s in shards if s not in local_shards]
+            if remote:
+                rest = self._execute_min_max(index, c, remote, opt, is_min)
+                fused_vc = (
+                    fused_vc.smaller(rest) if is_min else fused_vc.larger(rest)
+                )
+            return ValCount() if fused_vc.count == 0 else fused_vc
+
         def map_fn(shard):
             ctx = self._bsi_shard_ctx(index, c, shard)
             if ctx is None:
@@ -889,6 +900,31 @@ class Executor:
         result = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn)
         result = result or ValCount()
         return ValCount() if result.count == 0 else result
+
+    def _mesh_min_max(self, index, c: Call, shards, opt, is_min: bool):
+        if self.mesh_engine is None:
+            return None
+        field_name = c.args.get("field")
+        if not field_name or len(c.children) > 1:
+            return None
+        if self.cluster is None:
+            local = list(shards)
+        else:
+            local = [
+                s
+                for s in shards
+                if self.cluster.owns_shard(self.cluster.node.id, index, s)
+            ]
+        if not local:
+            return None
+        filter_call = c.children[0] if c.children else None
+        try:
+            val, n = self.mesh_engine.min_max(
+                index, field_name, filter_call, local, is_min
+            )
+        except ValueError:
+            return None
+        return set(local), ValCount(val, n)
 
     def _execute_min(self, index, c, shards, opt):
         return self._execute_min_max(index, c, shards, opt, True)

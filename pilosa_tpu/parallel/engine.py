@@ -391,6 +391,56 @@ class MeshEngine:
         n = int(n)
         return total + n * bsig.min, n
 
+    def min_max(
+        self,
+        index: str,
+        field_name: str,
+        filter_call: Optional[Call],
+        shards,
+        is_min: bool,
+    ):
+        """BSI Min/Max: per-shard plane walks in one dispatch, host reduce
+        (fragment.go min/max :745-806 + ValCount.smaller/larger).  Returns
+        (value, count) or (0, 0)."""
+        from . import kernels
+
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx is not None else None
+        bsig = f.bsi_group(field_name) if f is not None else None
+        if bsig is None:
+            return 0, 0
+        depth = bsig.bit_depth()
+        stack = self.field_stack(
+            index, field_name, view_bsi_name(field_name), shards
+        )
+        if stack is None:
+            return 0, 0
+        planes = _gather_planes(stack.matrix, self._plane_spec(stack, depth))
+        if filter_call is not None:
+            filt = self.bitmap_stack(index, filter_call, shards)
+        else:
+            S = pad_shards(len(shards), self.mesh)
+            filt = jax.device_put(
+                jnp.full((S, bitops.WORDS), 0xFFFFFFFF, dtype=jnp.uint32),
+                shard_sharding(self.mesh),
+            )
+        flags, counts = kernels.min_max_sharded(self.mesh, planes, filt, is_min)
+        flags = np.asarray(flags)
+        counts = np.asarray(counts)
+        # Reduce like ValCount.smaller/larger (executor.go:2652-2696):
+        # strictly-better value wins; ties keep the first shard's count.
+        best_val, best_n = 0, 0
+        for si in range(len(shards)):
+            n = int(counts[si])
+            if n == 0:
+                continue
+            val = sum(1 << i for i in range(depth) if flags[si, i])
+            if best_n == 0 or (val < best_val if is_min else val > best_val):
+                best_val, best_n = val, n
+        if best_n == 0:
+            return 0, 0
+        return best_val + bsig.min, best_n
+
     def topn_scores(
         self, index: str, field: str, candidate_rows: List[int], src_call: Call, shards
     ):

@@ -127,6 +127,30 @@ def sum_planes_sharded(mesh, planes, filt):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3))
+def _min_max_sharded(mesh, planes, filt, is_min: bool):
+    """Per-shard BSI min/max walks: planes uint32[S, D+1, W], filter
+    uint32[S, W] -> (flags int32[S, D], counts int32[S]) kept sharded; the
+    host reduces shard minima/maxima (ValCount.smaller/larger)."""
+    from ..ops import bsi as bsi_ops
+
+    def body(p, f):
+        fn = bsi_ops.min_flags if is_min else bsi_ops.max_flags
+        flags, counts = jax.vmap(fn)(p, f)
+        return flags.astype(jnp.int32), counts
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )(planes, filt)
+
+
+def min_max_sharded(mesh, planes, filt, is_min):
+    return _min_max_sharded(mesh, planes, filt, is_min)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
 def _range_count_sharded(mesh, planes, pred_bits, op_kind: int):
     """Fused BSI range + count over the mesh: one pass computes the
     predicate mask per shard (ops.bsi logic inlined over the local block)

@@ -260,3 +260,16 @@ def test_mesh_time_range(holder, mesh):
         "Count(Union(Range(t=10, 2018-01-01T00:00, 2018-03-01T00:00), Row(t=10)))",
     ]:
         assert fused.execute("i", q).results == ex.execute("i", q).results, q
+
+
+def test_executor_mesh_min_max(holder, mesh):
+    build_data(holder)
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    for q in [
+        "Min(field=v)",
+        "Max(field=v)",
+        "Min(Row(f=10), field=v)",
+        "Max(Row(f=10), field=v)",
+    ]:
+        assert fused.execute("i", q).results == plain.execute("i", q).results, q
