@@ -391,11 +391,7 @@ class API:
         writer = csv.writer(w)
         base = shard * SHARD_WIDTH
         for row_id in frag.row_ids():
-            import numpy as np
-
-            from .ops import bitops
-
-            for pos in bitops.words_to_positions(frag.rows[row_id].view("<u4")):
+            for pos in frag.row_positions(row_id):
                 col = base + int(pos)
                 if f.options.keys:
                     row_out = self.translate_store.translate_row_to_string(

@@ -2,9 +2,11 @@
 
 Re-design of the reference's fragment (fragment.go:87-2492) for TPU:
 
-- Host truth: a sparse dict of dense rows, ``row_id -> uint64[16384]``
-  (2^20 bits).  Mutations are numpy bit ops — the roaring container tree is
-  gone; roaring remains the file codec only.
+- Host truth: a hybrid sparse/dense RowStore — rows below a density
+  threshold are sorted position arrays (the economics of the reference's
+  array/run containers, roaring.go:926-946), denser rows are dense
+  ``uint64[16384]`` word vectors.  Mutations are numpy bit ops — the
+  roaring container tree is gone; roaring remains the file codec only.
 - Device mirror: a version-tracked ``uint32[n_rows, 32768]`` matrix uploaded
   lazily to HBM; every query kernel (set ops, popcount, BSI walks, TopN
   scoring) runs over it.  This replaces the reference's per-container Go
@@ -17,6 +19,9 @@ Re-design of the reference's fragment (fragment.go:87-2492) for TPU:
 - TopN support: ranked/LRU row-count cache (cache.go), persisted next to the
   fragment as a ``.cache`` file (fragment.go:250-291,1790-1821).
 - Anti-entropy: 100-row block checksums (fragment.go:76,1226-1321).
+- Mutex fields: an int32[SHARD_WIDTH] column→row occupancy vector gives the
+  O(1) owner lookup the reference gets from container probing
+  (fragment.go:398-427), instead of scanning every row.
 """
 
 from __future__ import annotations
@@ -34,6 +39,7 @@ from ..ops import bitops
 from ..roaring import codec
 from . import cache as cache_mod
 from .row import Row
+from .rowstore import RowStore
 
 SHARD_WIDTH = ops.SHARD_WIDTH
 WORDS64 = bitops.WORDS64
@@ -46,11 +52,6 @@ FALSE_ROW_ID = 0
 TRUE_ROW_ID = 1
 
 
-def _empty_row() -> np.ndarray:
-    return np.zeros(WORDS64, dtype=np.uint64)
-
-
-
 def _locked(fn):
     """Run under the fragment mutex (fragment.go:88 RWMutex discipline)."""
     import functools
@@ -61,6 +62,7 @@ def _locked(fn):
             return fn(self, *args, **kwargs)
 
     return wrapper
+
 
 class Fragment:
     """One shard of one view of one field."""
@@ -88,8 +90,8 @@ class Fragment:
         self.max_op_n = max_op_n
         self.row_attr_store = row_attr_store
 
-        self.rows: Dict[int, np.ndarray] = {}
-        self.row_counts: Dict[int, int] = {}
+        self._store = RowStore()
+        self.row_counts = self._store.counts
         self.cache = cache_mod.new_cache(
             cache_type, cache_size, debounce_seconds=cache_debounce
         )
@@ -108,6 +110,9 @@ class Fragment:
         self._dev_version = -1
         self._dev_matrix = None
         self._dev_index: Dict[int, int] = {}
+
+        # Lazily-built mutex occupancy vector: column -> owning row (-1 none).
+        self._mutex_owners: Optional[np.ndarray] = None
 
         self._checksums: Dict[int, bytes] = {}
 
@@ -133,34 +138,30 @@ class Fragment:
         self._op_file = open(self.path, "ab")
         self._load_cache_file()
 
-    def _load_positions(self, positions: np.ndarray):
-        """Storage positions (row*ShardWidth + in-shard col) -> dense rows."""
-        if positions.size == 0:
-            return
+    def _group_by_row(self, positions: np.ndarray):
+        """Storage positions -> iterator of (row_id, sorted in-row uint32)."""
         row_ids = (positions >> np.uint64(ops.SHARD_WIDTH_EXP)).astype(np.int64)
         in_row = positions & np.uint64(SHARD_WIDTH - 1)
-        order = np.argsort(row_ids, kind="stable")
-        row_ids, in_row = row_ids[order], in_row[order]
-        uniq, starts = np.unique(row_ids, return_index=True)
-        bounds = np.append(starts, row_ids.size)
-        for i, r in enumerate(uniq):
-            words = ops.positions_to_words(in_row[bounds[i] : bounds[i + 1]]).view(
-                "<u8"
-            )
-            self.rows[int(r)] = words.copy()
-            self.row_counts[int(r)] = int(bounds[i + 1] - bounds[i])
-        for r, n in self.row_counts.items():
+        yield from self._group_by_pairs(row_ids, in_row)
+
+    def _load_positions(self, positions: np.ndarray):
+        """Storage positions (row*ShardWidth + in-shard col) -> rows."""
+        if positions.size == 0:
+            return
+        for r, pos in self._group_by_row(positions):
+            n = self._store.union(r, pos)
             self.cache.bulk_add(r, n)
         self.cache.invalidate()
+        self._mutex_owners = None
         self._version += 1
 
     def positions(self) -> np.ndarray:
         """All storage positions, sorted (for snapshot serialization)."""
         chunks = []
-        for r in sorted(self.rows):
-            pos = bitops.words_to_positions(self.rows[r].view("<u4"))
+        for r in self._store.row_ids():
+            pos = self._store.positions(r)
             if pos.size:
-                chunks.append(pos + np.uint64(r * SHARD_WIDTH))
+                chunks.append(pos.astype(np.uint64) + np.uint64(r * SHARD_WIDTH))
         if not chunks:
             return np.empty(0, dtype=np.uint64)
         return np.concatenate(chunks)
@@ -169,6 +170,7 @@ class Fragment:
     def snapshot(self):
         """Compact: write a fresh roaring snapshot, truncate the op-log
         (atomic temp-file + rename, fragment.go:1737-1776)."""
+        self._store.compact()
         if self.path is None:
             self.op_n = 0
             return
@@ -247,30 +249,34 @@ class Fragment:
         if existing is not None and existing != row_id:
             self._clear_bit(existing, column_id)
 
+    def _owners(self) -> np.ndarray:
+        """column -> owning row occupancy vector (mutex fields), built
+        lazily and maintained by the single-bit and bulk mutex paths."""
+        if self._mutex_owners is None:
+            # int64: row ids are uint64-ish in the reference; int32 would
+            # overflow (and tear the occupancy) past 2^31 rows.
+            own = np.full(SHARD_WIDTH, -1, dtype=np.int64)
+            for r in self._store.row_ids():
+                own[self._store.positions(r).astype(np.int64)] = r
+            self._mutex_owners = own
+        return self._mutex_owners
+
     def row_containing(self, column_id: int) -> Optional[int]:
-        """The row with a bit set at column (mutex vector lookup)."""
-        in_row = column_id % SHARD_WIDTH
-        w, b = in_row >> 6, in_row & 63
-        for r, words in self.rows.items():
-            if (int(words[w]) >> b) & 1:
-                return r
-        return None
+        """The row with a bit set at column — O(1) occupancy lookup
+        (the reference's container probe, fragment.go:398-427)."""
+        r = int(self._owners()[column_id % SHARD_WIDTH])
+        return None if r < 0 else r
 
     def _set_bit(self, row_id: int, column_id: int) -> bool:
         p = self.pos(row_id, column_id)
         in_row = column_id % SHARD_WIDTH
-        words = self.rows.get(row_id)
-        if words is None:
-            words = _empty_row()
-            self.rows[row_id] = words
-        w, b = in_row >> 6, in_row & 63
-        if (int(words[w]) >> b) & 1:
+        if not self._store.set(row_id, in_row):
             return False
-        words[w] |= np.uint64(1 << b)
-        self.row_counts[row_id] = self.row_counts.get(row_id, 0) + 1
+        if self._mutex_owners is not None:
+            self._mutex_owners[in_row] = row_id
         self._append_op(codec.OP_TYPE_ADD, p)
         self._touch(row_id)
-        self.cache.add(row_id, self.row_counts[row_id])
+        self.cache.add(row_id, self._store.count(row_id))
         return True
 
     @_locked
@@ -280,43 +286,43 @@ class Fragment:
     def _clear_bit(self, row_id: int, column_id: int) -> bool:
         p = self.pos(row_id, column_id)
         in_row = column_id % SHARD_WIDTH
-        words = self.rows.get(row_id)
-        if words is None:
+        if not self._store.clear(row_id, in_row):
             return False
-        w, b = in_row >> 6, in_row & 63
-        if not (int(words[w]) >> b) & 1:
-            return False
-        words[w] &= np.uint64(~(1 << b) & 0xFFFFFFFFFFFFFFFF)
-        self.row_counts[row_id] = self.row_counts.get(row_id, 1) - 1
+        if (
+            self._mutex_owners is not None
+            and self._mutex_owners[in_row] == row_id
+        ):
+            self._mutex_owners[in_row] = -1
         self._append_op(codec.OP_TYPE_REMOVE, p)
         self._touch(row_id)
-        self.cache.add(row_id, self.row_counts[row_id])
+        self.cache.add(row_id, self._store.count(row_id))
         return True
 
     def bit(self, row_id: int, column_id: int) -> bool:
-        words = self.rows.get(row_id)
-        if words is None:
-            return False
-        in_row = column_id % SHARD_WIDTH
-        return bool((int(words[in_row >> 6]) >> (in_row & 63)) & 1)
+        return self._store.test(row_id, column_id % SHARD_WIDTH)
 
     # -- row access --------------------------------------------------------
 
     def row_words(self, row_id: int) -> np.ndarray:
         """Dense uint32[WORDS] words of a row (zeros if absent)."""
-        words = self.rows.get(row_id)
-        if words is None:
-            return np.zeros(bitops.WORDS, dtype=np.uint32)
-        return words.view("<u4")
+        return self._store.words_u32(row_id)
+
+    def row_positions(self, row_id: int) -> np.ndarray:
+        """Sorted uint32 in-row positions of a row."""
+        return self._store.positions(row_id)
+
+    def host_bytes(self) -> int:
+        """Host bytes held by row payloads (sparse-economics test hook)."""
+        return self._store.nbytes()
 
     def row(self, row_id: int) -> Row:
         return Row({self.shard: self.device_row(row_id)})
 
     def row_count(self, row_id: int) -> int:
-        return self.row_counts.get(row_id, 0)
+        return self._store.count(row_id)
 
     def row_ids(self) -> List[int]:
-        return sorted(r for r, n in self.row_counts.items() if n > 0)
+        return self._store.row_ids()
 
     def max_row_id(self) -> int:
         ids = self.row_ids()
@@ -330,12 +336,12 @@ class Fragment:
 
         if self._dev_version == self._version and self._dev_matrix is not None:
             return
-        ids = sorted(self.rows)
+        ids = self._store.row_ids()
         if not ids:
             mat = np.zeros((1, bitops.WORDS), dtype=np.uint32)
             self._dev_index = {}
         else:
-            mat = np.stack([self.rows[r].view("<u4") for r in ids])
+            mat = np.stack([self._store.words_u32(r) for r in ids])
             self._dev_index = {r: i for i, r in enumerate(ids)}
         self._dev_matrix = jnp.asarray(mat)
         self._dev_version = self._version
@@ -407,49 +413,107 @@ class Fragment:
     def bulk_import(self, row_ids: Iterable[int], column_ids: Iterable[int]) -> int:
         """Set many bits at once, updating caches once per row and taking a
         single snapshot — bypassing the op-log (fragment.go:1445-1533).
-        Mutex/bool fragments route through the slow path to preserve the
-        clear-previous-value semantics (bulkImportMutex :1538)."""
+        Mutex fragments go through a vectorized clear-previous-owner pass
+        (bulkImportMutex :1538) driven by the occupancy vector."""
         row_ids = np.asarray(list(row_ids), dtype=np.int64)
         column_ids = np.asarray(list(column_ids), dtype=np.int64)
+        if row_ids.size == 0:
+            return 0
         if self.mutex:
-            changed = 0
-            for r, c in zip(row_ids.tolist(), column_ids.tolist()):
-                if self.set_bit(r, c):
-                    changed += 1
+            changed = self._bulk_import_mutex(row_ids, column_ids)
             self.snapshot()
             return changed
         changed = 0
-        in_row = column_ids % SHARD_WIDTH
-        order = np.argsort(row_ids, kind="stable")
-        row_ids, in_row = row_ids[order], in_row[order]
-        uniq, starts = np.unique(row_ids, return_index=True)
-        bounds = np.append(starts, row_ids.size)
-        for i, r in enumerate(uniq):
-            r = int(r)
-            new = ops.positions_to_words(in_row[bounds[i] : bounds[i + 1]]).view("<u8")
-            words = self.rows.get(r)
-            if words is None:
-                self.rows[r] = new.copy()
-            else:
-                self.rows[r] = words | new
-            before = self.row_counts.get(r, 0)
-            after = int(
-                bitops.popcount_np(self.rows[r])
-            )
+        in_row = (column_ids % SHARD_WIDTH).astype(np.uint64)
+        packed = (row_ids.astype(np.uint64) << np.uint64(ops.SHARD_WIDTH_EXP)) | in_row
+        for r, pos in self._group_by_row(np.unique(packed)):
+            before = self._store.count(r)
+            after = self._store.union(r, pos)
             changed += after - before
-            self.row_counts[r] = after
             self._touch(r)
             self.cache.bulk_add(r, after)
         self.cache.invalidate()
         self.snapshot()
         return changed
 
+    def _bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray) -> int:
+        """Vectorized mutex bulk path: last write per column wins; previous
+        owners are looked up in the occupancy vector and cleared per-row
+        (fragment.go bulkImportMutex :1538-1607)."""
+        in_row = (column_ids % SHARD_WIDTH).astype(np.int64)
+        cols, rws = self._last_write_wins(in_row, row_ids)
+
+        own = self._owners()
+        prev = own[cols]
+        changed = 0
+
+        stale = (prev >= 0) & (prev != rws)
+        if stale.any():
+            for r, pos in self._group_by_pairs(prev[stale], cols[stale]):
+                self._store.difference(r, pos)
+                self._touch(r)
+                self.cache.bulk_add(r, self._store.count(r))
+        fresh = prev != rws
+        if fresh.any():
+            for r, pos in self._group_by_pairs(rws[fresh], cols[fresh]):
+                before = self._store.count(r)
+                after = self._store.union(r, pos)
+                changed += after - before
+                self._touch(r)
+                self.cache.bulk_add(r, after)
+        own[cols] = rws
+        self.cache.invalidate()
+        return changed
+
+    @staticmethod
+    def _group_by_pairs(rows: np.ndarray, cols: np.ndarray):
+        """(row, in-row col) vectors -> (row_id, sorted uint32 cols) groups."""
+        order = np.argsort(rows, kind="stable")
+        rows, cols = rows[order], cols[order]
+        uniq, starts = np.unique(rows, return_index=True)
+        bounds = np.append(starts, rows.size)
+        for i, r in enumerate(uniq):
+            yield int(r), np.sort(cols[bounds[i] : bounds[i + 1]]).astype(
+                np.uint32
+            )
+
+    @staticmethod
+    def _last_write_wins(cols: np.ndarray, *parallel: np.ndarray):
+        """Dedup columns keeping the LAST occurrence (later writes win)."""
+        _, first_in_rev = np.unique(cols[::-1], return_index=True)
+        keep = cols.size - 1 - first_in_rev
+        return (cols[keep],) + tuple(a[keep] for a in parallel)
+
+    @_locked
     def import_values(
         self, column_ids: Iterable[int], values: Iterable[int], bit_depth: int
     ):
-        """Bulk BSI write (fragment.go importValue :1609)."""
-        for c, v in zip(column_ids, values):
-            self.set_value(c, bit_depth, v)
+        """Bulk BSI write, vectorized by bit plane: each plane gets one
+        union of its set columns and one difference of its clear columns,
+        instead of bit_depth+1 op-logged writes per value
+        (fragment.go importValue :1609-1657).  One snapshot at the end."""
+        cols = np.asarray(list(column_ids), dtype=np.int64)
+        vals = np.asarray(list(values), dtype=np.int64)
+        if cols.size == 0:
+            return
+        in_row, vals = self._last_write_wins(cols % SHARD_WIDTH, vals)
+        order = np.argsort(in_row)
+        in_row, vals = in_row[order], vals[order]
+        pos32 = in_row.astype(np.uint32)
+
+        for i in range(bit_depth):
+            bit_set = ((vals >> i) & 1).astype(bool)
+            set_pos, clr_pos = pos32[bit_set], pos32[~bit_set]
+            if set_pos.size:
+                self._store.union(i, set_pos)
+            if clr_pos.size:
+                self._store.difference(i, clr_pos)
+            self._touch(i)
+            self.cache.bulk_add(i, self._store.count(i))
+        n = self._store.union(bit_depth, pos32)
+        self._touch(bit_depth)
+        self.cache.bulk_add(bit_depth, n)
+        self.cache.invalidate()
         self.snapshot()
 
     @_locked
@@ -458,63 +522,45 @@ class Fragment:
         straight into storage — the fast ingest path
         (fragment.go importRoaring :1659; ImportRoaringRequest.Clear)."""
         dec = codec.deserialize(data)
-        before = sum(self.row_counts.values())
+        before = sum(self._store.counts.values())
         if clear:
             self._difference_positions(dec.values)
         else:
             self._union_positions(dec.values)
         self.snapshot()
-        return abs(sum(self.row_counts.values()) - before)
+        return abs(sum(self._store.counts.values()) - before)
 
     def _difference_positions(self, positions: np.ndarray):
         if positions.size == 0:
             return
-        row_ids = (positions >> np.uint64(ops.SHARD_WIDTH_EXP)).astype(np.int64)
-        in_row = positions & np.uint64(SHARD_WIDTH - 1)
-        order = np.argsort(row_ids, kind="stable")
-        row_ids, in_row = row_ids[order], in_row[order]
-        uniq, starts = np.unique(row_ids, return_index=True)
-        bounds = np.append(starts, row_ids.size)
-        for i, r in enumerate(uniq):
-            r = int(r)
-            words = self.rows.get(r)
-            if words is None:
+        for r, pos in self._group_by_row(positions):
+            if r not in self._store:
                 continue
-            mask = ops.positions_to_words(in_row[bounds[i] : bounds[i + 1]]).view(
-                "<u8"
-            )
-            self.rows[r] = words & ~mask
-            self.row_counts[r] = int(bitops.popcount_np(self.rows[r]))
+            n = self._store.difference(r, pos)
             self._touch(r)
-            self.cache.bulk_add(r, self.row_counts[r])
+            self.cache.bulk_add(r, n)
+        self._mutex_owners = None
         self.cache.invalidate()
 
     def _union_positions(self, positions: np.ndarray):
         if positions.size == 0:
             return
-        row_ids = (positions >> np.uint64(ops.SHARD_WIDTH_EXP)).astype(np.int64)
-        in_row = positions & np.uint64(SHARD_WIDTH - 1)
-        order = np.argsort(row_ids, kind="stable")
-        row_ids, in_row = row_ids[order], in_row[order]
-        uniq, starts = np.unique(row_ids, return_index=True)
-        bounds = np.append(starts, row_ids.size)
-        for i, r in enumerate(uniq):
-            r = int(r)
-            new = ops.positions_to_words(in_row[bounds[i] : bounds[i + 1]]).view("<u8")
-            words = self.rows.get(r)
-            self.rows[r] = new.copy() if words is None else (words | new)
-            self.row_counts[r] = int(bitops.popcount_np(self.rows[r]))
+        for r, pos in self._group_by_row(positions):
+            n = self._store.union(r, pos)
             self._touch(r)
-            self.cache.bulk_add(r, self.row_counts[r])
+            self.cache.bulk_add(r, n)
+        self._mutex_owners = None
         self.cache.invalidate()
 
     @_locked
     def clear_row(self, row_id: int) -> bool:
         """Remove every bit in a row, snapshot (fragment.go clearRow :551,
         unprotectedClearRow)."""
-        words = self.rows.pop(row_id, None)
-        changed = words is not None and bool(np.any(words))
-        self.row_counts[row_id] = 0
+        if self._mutex_owners is not None:
+            self._mutex_owners[
+                self._store.positions(row_id).astype(np.int64)
+            ] = -1
+        changed = self._store.drop(row_id)
         self.cache.add(row_id, 0)
         self._touch(row_id)
         self.snapshot()
@@ -530,11 +576,11 @@ class Fragment:
             if seg is None
             else np.asarray(seg).view("<u8").copy()
         )
-        old = self.rows.get(row_id)
+        old = self._store.words_u64(row_id) if row_id in self._store else None
         changed = old is None or not np.array_equal(old, new)
-        self.rows[row_id] = new
-        self.row_counts[row_id] = int(bitops.popcount_np(new))
-        self.cache.bulk_add(row_id, self.row_counts[row_id])
+        n = self._store.set_dense(row_id, new)
+        self._mutex_owners = None
+        self.cache.bulk_add(row_id, n)
         self.cache.invalidate()
         self._touch(row_id)
         self.snapshot()
@@ -675,7 +721,9 @@ class Fragment:
 
     @_locked
     def checksum_blocks(self) -> List[Tuple[int, bytes]]:
-        """(block_idx, checksum) for each non-empty 100-row block."""
+        """(block_idx, checksum) for each non-empty 100-row block.  Hashes
+        the sorted position list so sparse- and dense-stored copies of the
+        same row always agree across replicas."""
         blocks: Dict[int, List[int]] = {}
         for r in self.row_ids():
             blocks.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
@@ -686,7 +734,11 @@ class Fragment:
                 h = hashlib.blake2b(digest_size=16)
                 for r in blocks[blk]:
                     h.update(r.to_bytes(8, "little"))
-                    h.update(self.rows[r].tobytes())
+                    h.update(
+                        np.ascontiguousarray(
+                            self._store.positions(r), dtype="<u4"
+                        ).tobytes()
+                    )
                 cached = h.digest()
                 self._checksums[blk] = cached
             out.append((blk, cached))
@@ -698,7 +750,7 @@ class Fragment:
         for r in self.row_ids():
             if r // HASH_BLOCK_SIZE != block:
                 continue
-            pos = bitops.words_to_positions(self.rows[r].view("<u4"))
+            pos = self._store.positions(r).astype(np.uint64)
             rows_out.append(np.full(pos.size, r, dtype=np.uint64))
             cols_out.append(pos)
         if not rows_out:
@@ -736,7 +788,7 @@ class Fragment:
     def __repr__(self) -> str:
         return (
             f"Fragment({self.index}/{self.field}/{self.view}/{self.shard}, "
-            f"rows={len(self.rows)})"
+            f"rows={len(self._store)})"
         )
 
 

@@ -1,0 +1,242 @@
+"""Hybrid sparse/dense host storage for shard rows.
+
+The host half of the residency story (the HBM half is the MeshEngine's
+field-stack LRU).  The reference pages sparse rows cheaply because roaring
+stores them as array/run containers in an mmap'd file
+(/root/reference/roaring/roaring.go:926-946,
+/root/reference/fragment.go:190-247).  Our device format is dense — but the
+host truth doesn't have to be: rows at or below ``SPARSE_MAX`` bits live as
+sorted ``uint32`` in-row position arrays (4 B/bit), denser rows as dense
+``uint64[16384]`` word vectors (128 KiB).  A 10-bit row costs ~40 bytes
+instead of 128 KiB; densification happens on promotion past the threshold
+and on device upload only.
+
+All positions are in-row (0 .. SHARD_WIDTH).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..ops import bitops
+
+WORDS64 = bitops.WORDS64
+
+# Rows with more set bits than this are stored dense.  At the threshold a
+# sparse row costs 16 KiB vs 128 KiB dense (8x); above it dense wins on
+# mutation cost and converges to the device layout.
+SPARSE_MAX = 4096
+# Dense rows whose count drops to this demote back to sparse on compact().
+DEMOTE_AT = SPARSE_MAX // 2
+
+_ONE = np.uint64(1)
+_M63 = np.uint64(63)
+
+
+def scatter_or(words: np.ndarray, positions: np.ndarray) -> None:
+    """Set bits at ``positions`` in a dense uint64 word vector, in place."""
+    idx = (positions >> np.uint64(6)).astype(np.int64)
+    np.bitwise_or.at(words, idx, _ONE << (positions.astype(np.uint64) & _M63))
+
+
+def scatter_andnot(words: np.ndarray, positions: np.ndarray) -> None:
+    """Clear bits at ``positions`` in a dense uint64 word vector, in place."""
+    idx = (positions >> np.uint64(6)).astype(np.int64)
+    mask = np.zeros(len(words), dtype=np.uint64)
+    np.bitwise_or.at(mask, idx, _ONE << (positions.astype(np.uint64) & _M63))
+    np.bitwise_and(words, ~mask, out=words)
+
+
+def densify(positions: np.ndarray) -> np.ndarray:
+    out = np.zeros(WORDS64, dtype=np.uint64)
+    scatter_or(out, positions)
+    return out
+
+
+class RowStore:
+    """Per-fragment hybrid row storage with maintained cardinalities."""
+
+    __slots__ = ("sparse", "dense", "counts")
+
+    def __init__(self):
+        self.sparse: Dict[int, np.ndarray] = {}
+        self.dense: Dict[int, np.ndarray] = {}
+        self.counts: Dict[int, int] = {}
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.sparse) + len(self.dense)
+
+    def __contains__(self, row_id: int) -> bool:
+        return row_id in self.sparse or row_id in self.dense
+
+    def row_ids(self) -> List[int]:
+        return sorted(
+            r for r in (self.sparse.keys() | self.dense.keys())
+            if self.counts.get(r, 0) > 0
+        )
+
+    def count(self, row_id: int) -> int:
+        return self.counts.get(row_id, 0)
+
+    def nbytes(self) -> int:
+        """Host bytes held by row payloads (memory-blowup test hook)."""
+        return sum(a.nbytes for a in self.sparse.values()) + sum(
+            a.nbytes for a in self.dense.values()
+        )
+
+    # -- single-bit ops ----------------------------------------------------
+
+    def test(self, row_id: int, pos: int) -> bool:
+        sp = self.sparse.get(row_id)
+        if sp is not None:
+            i = int(np.searchsorted(sp, np.uint32(pos)))
+            return i < len(sp) and int(sp[i]) == pos
+        d = self.dense.get(row_id)
+        if d is None:
+            return False
+        return bool((int(d[pos >> 6]) >> (pos & 63)) & 1)
+
+    def set(self, row_id: int, pos: int) -> bool:
+        sp = self.sparse.get(row_id)
+        if sp is not None:
+            p32 = np.uint32(pos)
+            i = int(np.searchsorted(sp, p32))
+            if i < len(sp) and int(sp[i]) == pos:
+                return False
+            if len(sp) + 1 > SPARSE_MAX:
+                d = densify(sp)
+                d[pos >> 6] |= _ONE << np.uint64(pos & 63)
+                # Publish dense before dropping sparse: lock-free readers
+                # must never find the row in neither dict.
+                self.dense[row_id] = d
+                del self.sparse[row_id]
+            else:
+                self.sparse[row_id] = np.insert(sp, i, p32)
+            self.counts[row_id] = self.counts.get(row_id, 0) + 1
+            return True
+        d = self.dense.get(row_id)
+        if d is None:
+            self.sparse[row_id] = np.array([pos], dtype=np.uint32)
+            self.counts[row_id] = 1
+            return True
+        w, b = pos >> 6, pos & 63
+        if (int(d[w]) >> b) & 1:
+            return False
+        d[w] |= _ONE << np.uint64(b)
+        self.counts[row_id] = self.counts.get(row_id, 0) + 1
+        return True
+
+    def clear(self, row_id: int, pos: int) -> bool:
+        sp = self.sparse.get(row_id)
+        if sp is not None:
+            i = int(np.searchsorted(sp, np.uint32(pos)))
+            if i >= len(sp) or int(sp[i]) != pos:
+                return False
+            self.sparse[row_id] = np.delete(sp, i)
+            self.counts[row_id] = self.counts.get(row_id, 1) - 1
+            return True
+        d = self.dense.get(row_id)
+        if d is None:
+            return False
+        w, b = pos >> 6, pos & 63
+        if not (int(d[w]) >> b) & 1:
+            return False
+        d[w] &= ~(_ONE << np.uint64(b))
+        self.counts[row_id] = self.counts.get(row_id, 1) - 1
+        return True
+
+    # -- bulk ops ----------------------------------------------------------
+
+    def union(self, row_id: int, positions: np.ndarray) -> int:
+        """OR sorted-unique in-row positions into a row; returns new count."""
+        positions = np.asarray(positions, dtype=np.uint32)
+        sp = self.sparse.get(row_id)
+        if sp is not None or row_id not in self.dense:
+            merged = (
+                positions if sp is None else np.union1d(sp, positions)
+            )
+            if len(merged) <= SPARSE_MAX:
+                self.sparse[row_id] = merged
+                self.counts[row_id] = len(merged)
+                return len(merged)
+            self.dense[row_id] = densify(merged)
+            self.sparse.pop(row_id, None)
+            self.counts[row_id] = len(merged)
+            return len(merged)
+        d = self.dense[row_id]
+        scatter_or(d, positions)
+        n = bitops.popcount_np(d)
+        self.counts[row_id] = n
+        return n
+
+    def difference(self, row_id: int, positions: np.ndarray) -> int:
+        """ANDNOT sorted-unique in-row positions out of a row; new count."""
+        positions = np.asarray(positions, dtype=np.uint32)
+        sp = self.sparse.get(row_id)
+        if sp is not None:
+            kept = np.setdiff1d(sp, positions, assume_unique=True)
+            self.sparse[row_id] = kept
+            self.counts[row_id] = len(kept)
+            return len(kept)
+        d = self.dense.get(row_id)
+        if d is None:
+            return 0
+        scatter_andnot(d, positions)
+        n = bitops.popcount_np(d)
+        self.counts[row_id] = n
+        return n
+
+    def set_dense(self, row_id: int, words: np.ndarray) -> int:
+        """Overwrite a row with a dense uint64 word vector (SetRow path)."""
+        self.sparse.pop(row_id, None)
+        self.dense[row_id] = words
+        n = bitops.popcount_np(words)
+        self.counts[row_id] = n
+        return n
+
+    def drop(self, row_id: int) -> bool:
+        """Remove a row; True only if it actually held bits."""
+        had = self.counts.get(row_id, 0) > 0
+        self.sparse.pop(row_id, None)
+        self.dense.pop(row_id, None)
+        self.counts[row_id] = 0
+        return had
+
+    # -- materialization ---------------------------------------------------
+
+    def positions(self, row_id: int) -> np.ndarray:
+        """Sorted uint32 in-row positions (empty array if absent)."""
+        sp = self.sparse.get(row_id)
+        if sp is not None:
+            return sp
+        d = self.dense.get(row_id)
+        if d is None:
+            return np.empty(0, dtype=np.uint32)
+        return bitops.words_to_positions(d.view("<u4")).astype(np.uint32)
+
+    def words_u64(self, row_id: int) -> np.ndarray:
+        """Dense uint64[WORDS64] materialization (zeros if absent).  Sparse
+        rows are densified into a fresh buffer — mutate only dense rows."""
+        d = self.dense.get(row_id)
+        if d is not None:
+            return d
+        sp = self.sparse.get(row_id)
+        if sp is None:
+            return np.zeros(WORDS64, dtype=np.uint64)
+        return densify(sp)
+
+    def words_u32(self, row_id: int) -> np.ndarray:
+        return self.words_u64(row_id).view("<u4")
+
+    def compact(self) -> None:
+        """Demote dense rows that shrank below the hysteresis threshold."""
+        for r in [r for r, d in self.dense.items() if self.counts.get(r, 0) <= DEMOTE_AT]:
+            pos = bitops.words_to_positions(self.dense[r].view("<u4")).astype(
+                np.uint32
+            )
+            self.sparse[r] = pos
+            del self.dense[r]

@@ -150,7 +150,12 @@ class Handler:
         if proto.CONTENT_TYPE in ctype:
             doc = proto.decode_query_request(body)
         else:
-            doc = json.loads(body) if body else {}
+            try:
+                doc = json.loads(body) if body else {}
+            except json.JSONDecodeError:
+                doc = {"query": body.decode() if isinstance(body, bytes) else body}
+            if isinstance(doc, str):
+                doc = {"query": doc}
         req = QueryRequest(
             index,
             doc.get("query", ""),
@@ -231,8 +236,14 @@ class Handler:
         return {}
 
     def _post_query(self, q, b, *, index, **kw):
-        doc = json.loads(b) if b else {}
-        if isinstance(doc, str):  # raw PQL body
+        # The reference reads the body as raw PQL unless it's protobuf
+        # (http/handler.go handlePostQuery); accept JSON {"query": ...}
+        # as well as a bare PQL string.
+        try:
+            doc = json.loads(b) if b else {}
+        except json.JSONDecodeError:
+            doc = {"query": b.decode() if isinstance(b, bytes) else b}
+        if isinstance(doc, str):  # JSON-quoted PQL body
             doc = {"query": doc}
         shards = doc.get("shards") or _parse_shards(q)
         req = QueryRequest(

@@ -139,8 +139,8 @@ class MeshEngine:
         for si, f in enumerate(frags):
             if f is None:
                 continue
-            for r, words in f.rows.items():
-                mat[si, row_index[r]] = words.view("<u4")
+            for r in f.row_ids():
+                mat[si, row_index[r]] = f.row_words(r)
         while (
             self._resident_bytes + mat.nbytes > self.max_resident_bytes
             and self._stacks

@@ -62,7 +62,7 @@ def test_concurrent_mixed_ops_single_row():
     # Internal consistency: tracked count equals actual popcount.
     from pilosa_tpu.ops import bitops
 
-    assert frag.row_count(1) == bitops.popcount_np(frag.rows[1])
+    assert frag.row_count(1) == bitops.popcount_np(frag.row_words(1))
 
 
 def test_concurrent_schema_creation():
