@@ -132,6 +132,22 @@ class Holder:
             return None
         return v.fragment(shard)
 
+    def local_shards(self, index: str) -> List[int]:
+        """Sorted union of shards with a local fragment in any field/view
+        of the index — the canonical shard axis for the MeshEngine's
+        field-stack residency (one stack per (index, field, view),
+        regardless of which shard subset a query names)."""
+        idx = self.indexes.get(index)
+        if idx is None:
+            return []
+        shards = set()
+        # list() snapshots are C-level-atomic under the GIL; concurrent
+        # field/view/fragment creation must not blow up this read path.
+        for f in list(idx.fields.values()):
+            for v in list(f.views.values()):
+                shards.update(list(v.fragments))
+        return sorted(shards)
+
     def view(self, index: str, field: str, view: str) -> Optional[View]:
         idx = self.indexes.get(index)
         if idx is None:

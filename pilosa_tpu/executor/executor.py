@@ -754,14 +754,7 @@ class Executor:
         returns (local_shards, count) or None when unsupported."""
         if self.mesh_engine is None:
             return None
-        if self.cluster is None:
-            local = list(shards)
-        else:
-            local = [
-                s
-                for s in shards
-                if self.cluster.owns_shard(self.cluster.node.id, index, s)
-            ]
+        local = self._local_shards(index, shards)
         if not local:
             return None
         try:
@@ -843,14 +836,7 @@ class Executor:
         field_name = c.args.get("field")
         if not field_name or len(c.children) > 1:
             return None
-        if self.cluster is None:
-            local = list(shards)
-        else:
-            local = [
-                s
-                for s in shards
-                if self.cluster.owns_shard(self.cluster.node.id, index, s)
-            ]
+        local = self._local_shards(index, shards)
         if not local:
             return None
         filter_call = c.children[0] if c.children else None
@@ -907,14 +893,7 @@ class Executor:
         field_name = c.args.get("field")
         if not field_name or len(c.children) > 1:
             return None
-        if self.cluster is None:
-            local = list(shards)
-        else:
-            local = [
-                s
-                for s in shards
-                if self.cluster.owns_shard(self.cluster.node.id, index, s)
-            ]
+        local = self._local_shards(index, shards)
         if not local:
             return None
         filter_call = c.children[0] if c.children else None
@@ -952,33 +931,51 @@ class Executor:
         return trimmed
 
     def _execute_topn_shards(self, index, c, shards, opt):
-        fused = self._mesh_topn_shards(index, c, shards, opt)
-        if fused is not None:
-            return fused
-
         def map_fn(shard):
             return self._execute_topn_shard(index, c, shard)
 
         def reduce_fn(prev, v):
             return cache_mod.merge_pairs([prev or [], v])
 
+        fused = self._mesh_topn_shards(index, c, shards, opt)
+        if fused is not None:
+            local_shards, pairs = fused
+            remote = [s for s in shards if s not in local_shards]
+            if remote:
+                rpairs = (
+                    self.map_reduce(index, remote, c, opt, map_fn, reduce_fn)
+                    or []
+                )
+                pairs = cache_mod.merge_pairs([pairs, rpairs])
+            pairs.sort(key=cache_mod.pair_sort_key)
+            return pairs
+
         pairs = self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
         pairs.sort(key=cache_mod.pair_sort_key)
         return pairs
 
+    def _local_shards(self, index, shards):
+        """The locally-owned subset of ``shards`` (all of them when there
+        is no cluster)."""
+        if self.cluster is None:
+            return list(shards)
+        return [
+            s
+            for s in shards
+            if self.cluster.owns_shard(self.cluster.node.id, index, s)
+        ]
+
     def _mesh_topn_shards(self, index, c: Call, shards, opt):
-        """Batched TopN phase 1: the per-candidate src intersection counts
-        for EVERY shard computed in one sharded dispatch pair, then the
-        reference's per-shard heap walk runs host-side on the precomputed
-        scores.  Applies only when all shards are local and a src row is
-        given (the scoring is the hot part; without src the walk is pure
-        cache reads)."""
+        """Batched TopN phase 1 over the LOCAL shard subset: the
+        per-candidate src intersection counts for every local shard in one
+        sharded dispatch pair, then the reference's per-shard heap walk
+        runs host-side on the precomputed scores.  Remote shards are
+        looped/RPC'd by the caller (the _mesh_count composition pattern).
+        Returns (local_shard_set, pairs) or None."""
         if self.mesh_engine is None or len(c.children) != 1:
             return None
-        if self.cluster is not None and any(
-            not self.cluster.owns_shard(self.cluster.node.id, index, s)
-            for s in shards
-        ):
+        shards = self._local_shards(index, shards)
+        if not shards:
             return None
         field_name = c.args.get("_field") or DEFAULT_FIELD
         n, _ = c.uint_arg("n")
@@ -1006,7 +1003,7 @@ class Executor:
             frags[s] = frag
             cand_set.update(r for r, _ in pairs)
         if not frags:
-            return []
+            return set(shards), []
         candidates = sorted(cand_set)
         try:
             scored = self.mesh_engine.topn_scores(
@@ -1015,14 +1012,15 @@ class Executor:
         except ValueError:
             return None
         if scored is None:
-            return []
-        scores, src_counts = scored
+            return set(shards), []
+        scores, src_counts, shard_pos = scored
         cand_pos = {r: i for i, r in enumerate(candidates)}
 
         all_pairs = []
-        for si, s in enumerate(shards):
+        for s in shards:
             frag = frags.get(s)
-            if frag is None:
+            si = shard_pos.get(s)
+            if frag is None or si is None:
                 continue
             per_shard = {
                 r: int(scores[si, cand_pos[r]]) for r in cand_set
@@ -1041,7 +1039,7 @@ class Executor:
             )
         pairs = cache_mod.merge_pairs(all_pairs)
         pairs.sort(key=cache_mod.pair_sort_key)
-        return pairs
+        return set(shards), pairs
 
     def _execute_topn_shard(self, index, c: Call, shard: int):
         field_name = c.args.get("_field") or DEFAULT_FIELD
@@ -1137,17 +1135,25 @@ class Executor:
                 if not child_rows[i]:
                     return []
 
-        results = self._mesh_group_by(index, c, filter_call, shards, opt)
-        if results is None:
+        def map_fn(shard):
+            return self._execute_group_by_shard(
+                index, c, filter_call, shard, child_rows
+            )
 
-            def map_fn(shard):
-                return self._execute_group_by_shard(
-                    index, c, filter_call, shard, child_rows
+        def reduce_fn(prev, v):
+            return _merge_group_counts(prev or [], v, limit)
+
+        fused = self._mesh_group_by(index, c, filter_call, shards, opt)
+        if fused is not None:
+            local_shards, results = fused
+            remote = [s for s in shards if s not in local_shards]
+            if remote:
+                rres = (
+                    self.map_reduce(index, remote, c, opt, map_fn, reduce_fn)
+                    or []
                 )
-
-            def reduce_fn(prev, v):
-                return _merge_group_counts(prev or [], v, limit)
-
+                results = _merge_group_counts(results, rres, limit)
+        else:
             results = (
                 self.map_reduce(index, shards, c, opt, map_fn, reduce_fn) or []
             )
@@ -1160,20 +1166,21 @@ class Executor:
         return results
 
     def _mesh_group_by(self, index, c: Call, filter_call, shards, opt):
-        """Fused GroupBy: all group-combination counts in one sharded
-        dispatch.  Applies to 1-2 plain ``Rows(field=f)`` children (no
-        column/limit/previous) with every shard local; the merged list is
-        then truncated to `limit` like the reference's progressive merge."""
+        """Fused GroupBy over the LOCAL shard subset: all group-combination
+        counts in one sharded dispatch; remote shards are looped/RPC'd by
+        the caller and merged (the _mesh_count composition pattern).
+        Applies to 1-2 plain ``Rows(field=f)`` children (no column/limit/
+        previous); the merged list is then truncated to `limit` like the
+        reference's progressive merge.  Returns (local_shard_set, results)
+        or None."""
         if self.mesh_engine is None or not (1 <= len(c.children) <= 2):
             return None
         for child in c.children:
             extra = set(child.args) - {"field"}
             if child.name != "Rows" or extra:
                 return None
-        if self.cluster is not None and any(
-            not self.cluster.owns_shard(self.cluster.node.id, index, s)
-            for s in shards
-        ):
+        shards = self._local_shards(index, shards)
+        if not shards:
             return None
         fields = [child.args["field"] for child in c.children]
         row_lists = []
@@ -1185,7 +1192,7 @@ class Executor:
                     rows.update(frag.row_ids())
             row_lists.append(sorted(rows))
         if any(not rows for rows in row_lists):
-            return []
+            return set(shards), []
         try:
             counts = self.mesh_engine.group_counts(
                 index, fields, row_lists, filter_call, shards
@@ -1221,7 +1228,7 @@ class Executor:
                         break
                 if done:
                     break
-        return results
+        return set(shards), results
 
     def _execute_group_by_shard(
         self, index, c: Call, filter_call, shard, child_rows

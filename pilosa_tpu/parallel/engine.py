@@ -6,17 +6,22 @@ dispatch:
 
 1. the call tree is lowered to a static program over a flat list of
    device operands — field stacks ``uint32[S, R, WORDS]`` (S = padded
-   shard axis over the mesh, R = union row table), plus *traced* row
-   indices and BSI predicate bits, so queries that differ only in row id
-   or predicate value reuse the same compiled program;
+   canonical shard axis over the mesh, R = union row table), plus
+   *traced* row indices and BSI predicate bits, so queries that differ
+   only in row id or predicate value reuse the same compiled program;
 2. the whole tree — row gathers, BSI plane walks, every AND/OR/ANDNOT/
    XOR/NOT, and the popcount — evaluates inside a single ``shard_map``
    body that XLA fuses into one pass over HBM;
 3. the reduce is a ``psum`` over ICI.
 
-Field stacks are cached per (index, field, view) and invalidated by
-fragment versions, replacing the reference's mmap residency
-(fragment.go:190-247) with an explicit HBM residency manager.
+Field stacks are cached per (index, field, view) over the index's
+CANONICAL local shard list — not the query's shard tuple — so queries
+over overlapping-but-unequal shard subsets (Options(shards=...), post-
+resize) share one HBM-resident stack; the requested subset is applied
+as a per-shard mask operand inside the dispatch.  Stacks are
+invalidated by fragment versions and evicted LRU under an HBM budget,
+replacing the reference's mmap residency (fragment.go:190-247) with an
+explicit HBM residency manager.
 """
 
 from __future__ import annotations
@@ -41,20 +46,22 @@ from .mesh import SHARD_AXIS, pad_shards, replicated_sharding, shard_sharding
 class _FieldStack:
     """Device-resident uint32[S, R, WORDS] for one (index, field, view)."""
 
-    __slots__ = ("matrix", "row_index", "versions", "shards")
+    __slots__ = ("matrix", "row_index", "versions", "shards", "pos")
 
     def __init__(self, matrix, row_index: Dict[int, int], versions, shards):
         self.matrix = matrix
         self.row_index = row_index
         self.versions = versions
         self.shards = shards
+        self.pos = {s: i for i, s in enumerate(shards)}
 
 
 class _Lowering:
     """Flat operand list + per-operand shardings for one query program."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, canonical: List[int]):
         self.engine = engine
+        self.canonical = canonical
         self.operands: list = []
         self.specs: list = []
         self._mat_ids: Dict[int, int] = {}
@@ -87,13 +94,17 @@ class MeshEngine:
         # explicit replacement for the reference's mmap paging,
         # fragment.go:190-247; SURVEY.md "dense-vs-sparse blowup").
         self.max_resident_bytes = max_resident_bytes
-        self._stacks: "OrderedDict[Tuple[str, str, str, Tuple[int, ...]], _FieldStack]" = (
+        self._stacks: "OrderedDict[Tuple[str, str, str], _FieldStack]" = (
             OrderedDict()
         )
         self._resident_bytes = 0
         self._zeros: Dict[int, object] = {}
         self._scalars: Dict[int, object] = {}
         self._bits: Dict[Tuple[int, int], object] = {}
+        self._masks: "OrderedDict[Tuple[int, bytes], object]" = OrderedDict()
+        # Count of fused device dispatches (one per kernel invocation;
+        # cluster tests assert it advances when the fused path runs).
+        self.fused_dispatches = 0
 
     def _scalar(self, v: int):
         """Cached device int32 scalar (fresh device_puts per query are the
@@ -112,21 +123,66 @@ class MeshEngine:
             self._bits[key] = b
         return b
 
+    # -- canonical shard axis ---------------------------------------------
+
+    def canonical_shards(self, index: str) -> List[int]:
+        """The index's local-fragment shard list: the one shard axis every
+        stack of this index is laid out over."""
+        return self.holder.local_shards(index)
+
+    def _mask_words(self, shards, canonical):
+        """uint32[S, 1] per-shard mask: all-ones for requested shards,
+        zero otherwise (broadcasts against uint32[S, ..., W] operands).
+        Cached per (S, bitset) — masks recur across a query stream."""
+        S = pad_shards(len(canonical), self.mesh)
+        req = set(shards)
+        bits = bytes(1 if s in req else 0 for s in canonical)
+        key = (S, bits)
+        m = self._masks.get(key)
+        if m is None:
+            host = np.zeros((S, 1), dtype=np.uint32)
+            for i, s in enumerate(canonical):
+                if s in req:
+                    host[i, 0] = 0xFFFFFFFF
+            m = jax.device_put(jnp.asarray(host), shard_sharding(self.mesh))
+            self._masks[key] = m
+            while len(self._masks) > 1024:  # tiny buffers, but bounded
+                self._masks.popitem(last=False)
+        else:
+            self._masks.move_to_end(key)
+        return m
+
     # -- residency ---------------------------------------------------------
 
     def field_stack(
-        self, index: str, field: str, view: str, shards: List[int]
+        self,
+        index: str,
+        field: str,
+        view: str,
+        canonical: Optional[List[int]] = None,
     ) -> Optional[_FieldStack]:
-        """Sharded stack of every row of a view across ``shards``."""
-        key = (index, field, view, tuple(shards))
-        frags = [self.holder.fragment(index, field, view, s) for s in shards]
+        """Sharded stack of every row of a view across the index's
+        canonical shard axis.  Callers combining several stacks (or a
+        stack plus a mask) in ONE dispatch pass the same ``canonical``
+        snapshot so every operand shares the shard-axis layout even if a
+        concurrent import grows the index mid-query."""
+        key = (index, field, view)
+        if canonical is None:
+            canonical = self.canonical_shards(index)
+        frags = [self.holder.fragment(index, field, view, s) for s in canonical]
         versions = tuple(-1 if f is None else f._version for f in frags)
         cached = self._stacks.get(key)
-        if cached is not None and cached.versions == versions:
+        if (
+            cached is not None
+            and cached.shards == canonical
+            and cached.versions == versions
+        ):
             self._stacks.move_to_end(key)
             return cached
         if cached is not None:
             self._evict(key)
+        if not canonical:
+            return None
 
         row_ids = sorted(
             {r for f in frags if f is not None for r in f.row_ids()}
@@ -134,7 +190,7 @@ class MeshEngine:
         if not row_ids:
             row_ids = [0]
         row_index = {r: i for i, r in enumerate(row_ids)}
-        S = pad_shards(len(shards), self.mesh)
+        S = pad_shards(len(canonical), self.mesh)
         mat = np.zeros((S, len(row_ids), bitops.WORDS), dtype=np.uint32)
         for si, f in enumerate(frags):
             if f is None:
@@ -150,7 +206,7 @@ class MeshEngine:
             jax.device_put(jnp.asarray(mat), shard_sharding(self.mesh)),
             row_index,
             versions,
-            list(shards),
+            list(canonical),
         )
         self._stacks[key] = stack
         self._resident_bytes += mat.nbytes
@@ -162,9 +218,9 @@ class MeshEngine:
             self._resident_bytes -= stack.matrix.nbytes
             stack.matrix.delete()
 
-    def _zero_stack(self, shards):
+    def _zero_stack(self, canonical):
         """Cached zeros uint32[S, 1, WORDS] used as the empty-leaf operand."""
-        S = pad_shards(len(shards), self.mesh)
+        S = pad_shards(len(canonical), self.mesh)
         z = self._zeros.get(S)
         if z is None:
             z = jax.device_put(
@@ -176,7 +232,7 @@ class MeshEngine:
 
     # -- call-tree lowering -------------------------------------------------
 
-    def _lower(self, index: str, c: Call, shards, lw: _Lowering):
+    def _lower(self, index: str, c: Call, lw: _Lowering):
         """Lower a bitmap call tree to a hashable static program over
         ``lw``'s operand list."""
         name = c.name
@@ -185,7 +241,7 @@ class MeshEngine:
             row_id, ok = c.uint_arg(field_name)
             if not ok:
                 raise ValueError("Row() requires a row id")
-            return self._lower_row(index, field_name, row_id, shards, lw)
+            return self._lower_row(index, field_name, row_id, lw)
         if name in ("Union", "Intersect", "Difference", "Xor"):
             op = {
                 "Union": "or",
@@ -193,25 +249,23 @@ class MeshEngine:
                 "Difference": "andnot",
                 "Xor": "xor",
             }[name]
-            subs = tuple(
-                self._lower(index, ch, shards, lw) for ch in c.children
-            )
+            subs = tuple(self._lower(index, ch, lw) for ch in c.children)
             if not subs:
-                return self._lower_zero(shards, lw)
+                return self._lower_zero(lw)
             return (op,) + subs
         if name == "Not":
             from ..core.index import EXISTENCE_FIELD_NAME
 
-            exist = self._lower_row(index, EXISTENCE_FIELD_NAME, 0, shards, lw)
-            sub = self._lower(index, c.children[0], shards, lw)
+            exist = self._lower_row(index, EXISTENCE_FIELD_NAME, 0, lw)
+            sub = self._lower(index, c.children[0], lw)
             return ("andnot", exist, sub)
         if name == "Range" and c.has_condition_arg():
-            return self._lower_range(index, c, shards, lw)
+            return self._lower_range(index, c, lw)
         if name == "Range":
-            return self._lower_time_range(index, c, shards, lw)
+            return self._lower_time_range(index, c, lw)
         raise ValueError(f"unsupported call for mesh path: {name}")
 
-    def _lower_time_range(self, index: str, c: Call, shards, lw: _Lowering):
+    def _lower_time_range(self, index: str, c: Call, lw: _Lowering):
         """Time-quantum Range: OR of the row across the minimal view cover
         (executor.go executeRangeShard :1233-1307) — each view's stack
         contributes one row leaf, fused into the same dispatch."""
@@ -234,32 +288,32 @@ class MeshEngine:
         end = dt.datetime.strptime(end_str, "%Y-%m-%dT%H:%M")
         q = f.time_quantum()
         if not q:
-            return self._lower_zero(shards, lw)
+            return self._lower_zero(lw)
         leaves = []
         for view_name in timequantum.views_by_time_range(
             VIEW_STANDARD, start, end, q
         ):
             if f.view(view_name) is None:
                 continue
-            stack = self.field_stack(index, field_name, view_name, shards)
+            stack = self.field_stack(index, field_name, view_name, lw.canonical)
             if stack is None or row_id not in stack.row_index:
                 continue
             i_mat = lw.add_matrix(stack.matrix)
             i_idx = lw.add_replicated(self._scalar(stack.row_index[row_id]))
             leaves.append(("row", i_mat, i_idx))
         if not leaves:
-            return self._lower_zero(shards, lw)
+            return self._lower_zero(lw)
         if len(leaves) == 1:
             return leaves[0]
         return ("or",) + tuple(leaves)
 
-    def _lower_zero(self, shards, lw: _Lowering):
-        return ("zero", lw.add_matrix(self._zero_stack(shards)))
+    def _lower_zero(self, lw: _Lowering):
+        return ("zero", lw.add_matrix(self._zero_stack(lw.canonical)))
 
-    def _lower_row(self, index, field, row_id, shards, lw: _Lowering):
-        stack = self.field_stack(index, field, VIEW_STANDARD, shards)
+    def _lower_row(self, index, field, row_id, lw: _Lowering):
+        stack = self.field_stack(index, field, VIEW_STANDARD, lw.canonical)
         if stack is None or row_id not in stack.row_index:
-            return self._lower_zero(shards, lw)
+            return self._lower_zero(lw)
         i_mat = lw.add_matrix(stack.matrix)
         i_idx = lw.add_replicated(self._scalar(stack.row_index[row_id]))
         return ("row", i_mat, i_idx)
@@ -275,7 +329,7 @@ class MeshEngine:
             return ("slice", idxs[0], depth + 1)
         return ("gather", tuple(-1 if i is None else i for i in idxs))
 
-    def _lower_range(self, index: str, c: Call, shards, lw: _Lowering):
+    def _lower_range(self, index: str, c: Call, lw: _Lowering):
         """BSI Range leaf with the same out-of-range/notNull special cases
         as executor._execute_bsi_range_shard (executor.go:1309-1440)."""
         (field_name, cond), = c.args.items()
@@ -286,17 +340,17 @@ class MeshEngine:
             raise ValueError(f"field not found: {field_name}")
         depth = bsig.bit_depth()
         stack = self.field_stack(
-            index, field_name, view_bsi_name(field_name), shards
+            index, field_name, view_bsi_name(field_name), lw.canonical
         )
         if stack is None:
-            return self._lower_zero(shards, lw)
+            return self._lower_zero(lw)
         i_mat = lw.add_matrix(stack.matrix)
         pspec = self._plane_spec(stack, depth)
 
         def not_null():
             nn_idx = stack.row_index.get(depth)
             if nn_idx is None:
-                return self._lower_zero(shards, lw)
+                return self._lower_zero(lw)
             i_idx = lw.add_replicated(self._scalar(nn_idx))
             return ("row", i_mat, i_idx)
 
@@ -306,7 +360,7 @@ class MeshEngine:
             lo_hi = cond.int_slice_value()
             lo, hi, out_of_range = bsig.base_value_between(*lo_hi)
             if out_of_range:
-                return self._lower_zero(shards, lw)
+                return self._lower_zero(lw)
             if lo_hi[0] <= bsig.min and lo_hi[1] >= bsig.max:
                 return not_null()
             i_lo = lw.add_replicated(self._bits_arr(lo, depth))
@@ -315,7 +369,7 @@ class MeshEngine:
         value = cond.value
         base, out_of_range = bsig.base_value(cond.op, value)
         if out_of_range and cond.op != NEQ:
-            return self._lower_zero(shards, lw)
+            return self._lower_zero(lw)
         if (
             (cond.op == LT and value > bsig.max)
             or (cond.op == LTE and value >= bsig.max)
@@ -340,26 +394,63 @@ class MeshEngine:
         """Count(tree) returning the device scalar without host sync —
         callers pipeline query streams and fetch results in one transfer
         (the async analogue of mapReduce's result channel)."""
-        lw = _Lowering(self)
-        prog = self._lower(index, c, shards, lw)
-        return _count_tree(self.mesh, prog, tuple(lw.specs), *lw.operands)
+        canonical = self.canonical_shards(index)
+        if not canonical:
+            return jnp.int32(0)
+        lw = _Lowering(self, canonical)
+        prog = self._lower(index, c, lw)
+        mask = self._mask_words(shards, canonical)
+        self.fused_dispatches += 1
+        return _count_tree(
+            self.mesh, prog, tuple(lw.specs), mask, *lw.operands
+        )
 
-    def bitmap_stack(self, index: str, c: Call, shards: List[int]):
-        """Evaluate a tree to its sharded uint32[S, WORDS] row stack."""
-        lw = _Lowering(self)
-        prog = self._lower(index, c, shards, lw)
-        return _eval_tree(self.mesh, prog, tuple(lw.specs), *lw.operands)
+    def bitmap_stack(
+        self,
+        index: str,
+        c: Call,
+        shards: List[int],
+        canonical: Optional[List[int]] = None,
+    ):
+        """Evaluate a tree to its masked uint32[S, WORDS] row stack laid
+        out over the canonical shard axis; returns (stack, canonical).
+        Pass ``canonical`` when the result joins other operands of one
+        dispatch (shared shard-axis snapshot)."""
+        if canonical is None:
+            canonical = self.canonical_shards(index)
+        if not canonical:
+            return None, []
+        lw = _Lowering(self, canonical)
+        prog = self._lower(index, c, lw)
+        mask = self._mask_words(shards, canonical)
+        self.fused_dispatches += 1
+        return (
+            _eval_tree(self.mesh, prog, tuple(lw.specs), mask, *lw.operands),
+            canonical,
+        )
 
     def bitmap_row(self, index: str, c: Call, shards: List[int]):
         """Evaluate a tree and materialize a core Row (host segments)."""
         from ..core.row import Row
 
-        stack = np.asarray(self.bitmap_stack(index, c, shards))
+        stack, canonical = self.bitmap_stack(index, c, shards)
+        if stack is None:
+            return Row({})
+        stack = np.asarray(stack)
+        req = set(shards)
         segs = {}
-        for i, s in enumerate(shards):
-            if stack[i].any():
+        for i, s in enumerate(canonical):
+            if s in req and stack[i].any():
                 segs[s] = stack[i]
         return Row(segs)
+
+    def _filter_stack(self, index, filter_call, shards, canonical):
+        """uint32[S, ...] filter operand: the evaluated (masked) filter
+        tree, or the bare [S, 1] mask when no filter is given."""
+        if filter_call is not None:
+            stack, _ = self.bitmap_stack(index, filter_call, shards, canonical)
+            return stack
+        return self._mask_words(shards, canonical)
 
     def sum(self, index: str, field_name: str, filter_call: Optional[Call], shards):
         """BSI Sum over the mesh (returns the ValCount parts: total, count)."""
@@ -371,20 +462,13 @@ class MeshEngine:
         if bsig is None:
             return 0, 0
         depth = bsig.bit_depth()
-        stack = self.field_stack(
-            index, field_name, view_bsi_name(field_name), shards
-        )
+        stack = self.field_stack(index, field_name, view_bsi_name(field_name))
         if stack is None:
             return 0, 0
+        canonical = stack.shards
         planes = _gather_planes(stack.matrix, self._plane_spec(stack, depth))
-        if filter_call is not None:
-            filt = self.bitmap_stack(index, filter_call, shards)
-        else:
-            S = pad_shards(len(shards), self.mesh)
-            filt = jax.device_put(
-                jnp.full((S, bitops.WORDS), 0xFFFFFFFF, dtype=jnp.uint32),
-                shard_sharding(self.mesh),
-            )
+        filt = self._filter_stack(index, filter_call, shards, canonical)
+        self.fused_dispatches += 1
         counts, n = kernels.sum_planes_sharded(self.mesh, planes, filt)
         counts = np.asarray(counts)
         total = sum(int(counts[i]) << i for i in range(depth))
@@ -410,27 +494,22 @@ class MeshEngine:
         if bsig is None:
             return 0, 0
         depth = bsig.bit_depth()
-        stack = self.field_stack(
-            index, field_name, view_bsi_name(field_name), shards
-        )
+        stack = self.field_stack(index, field_name, view_bsi_name(field_name))
         if stack is None:
             return 0, 0
+        canonical = stack.shards
         planes = _gather_planes(stack.matrix, self._plane_spec(stack, depth))
-        if filter_call is not None:
-            filt = self.bitmap_stack(index, filter_call, shards)
-        else:
-            S = pad_shards(len(shards), self.mesh)
-            filt = jax.device_put(
-                jnp.full((S, bitops.WORDS), 0xFFFFFFFF, dtype=jnp.uint32),
-                shard_sharding(self.mesh),
-            )
+        filt = self._filter_stack(index, filter_call, shards, canonical)
+        self.fused_dispatches += 1
         flags, counts = kernels.min_max_sharded(self.mesh, planes, filt, is_min)
         flags = np.asarray(flags)
         counts = np.asarray(counts)
         # Reduce like ValCount.smaller/larger (executor.go:2652-2696):
         # strictly-better value wins; ties keep the first shard's count.
+        # The mask zeroed non-requested shards' filters, so their counts
+        # are 0 and they drop out here.
         best_val, best_n = 0, 0
-        for si in range(len(shards)):
+        for si in range(len(canonical)):
             n = int(counts[si])
             if n == 0:
                 continue
@@ -444,12 +523,13 @@ class MeshEngine:
     def topn_scores(
         self, index: str, field: str, candidate_rows: List[int], src_call: Call, shards
     ):
-        """Batched TopN phase-1 scoring across ALL shards in one
-        dispatch pair: (scores int32[S, K], src_counts int32[S]).
-        Candidates absent from the row table score 0."""
+        """Batched TopN phase-1 scoring across ALL requested shards in one
+        dispatch pair: (scores int32[S, K], src_counts int32[S],
+        shard_pos).  ``shard_pos`` maps shard -> row of the canonical axis;
+        candidates absent from the row table score 0."""
         from . import kernels
 
-        stack = self.field_stack(index, field, VIEW_STANDARD, shards)
+        stack = self.field_stack(index, field, VIEW_STANDARD)
         if stack is None:
             return None
         present = np.asarray(
@@ -459,16 +539,19 @@ class MeshEngine:
             [stack.row_index.get(r, 0) for r in candidate_rows], dtype=np.int32
         )
         cands = stack.matrix[:, idxs, :]
-        src = self.bitmap_stack(index, src_call, shards)
+        src, _ = self.bitmap_stack(index, src_call, shards, stack.shards)
+        self.fused_dispatches += 2  # scoring kernel + per-shard counts
         # np.array (copy): device-array views are read-only host buffers.
         scores = np.array(kernels.topn_scores_sharded(self.mesh, cands, src))
         scores[:, ~present] = 0
         src_counts = np.asarray(kernels.counts_per_shard(self.mesh, src))
-        return scores, src_counts
+        return scores, src_counts, dict(stack.pos)
 
-    def _rows_stack(self, index: str, field: str, row_ids: List[int], shards):
+    def _rows_stack(
+        self, index: str, field: str, row_ids: List[int], canonical=None
+    ):
         """uint32[S, K, W] stack of the given rows of a field."""
-        stack = self.field_stack(index, field, VIEW_STANDARD, shards)
+        stack = self.field_stack(index, field, VIEW_STANDARD, canonical)
         if stack is None:
             return None
         idxs = np.asarray(
@@ -487,25 +570,22 @@ class MeshEngine:
         """Fused GroupBy over 1 or 2 Rows children: every group combination
         counted in ONE sharded dispatch (BASELINE config #5's 8-way
         GroupBy+Count shard reduce).  Returns int32[Ka(,Kb)] counts in
-        row-id order."""
+        row-id order, over the requested shard subset only."""
         from . import kernels
 
         if len(fields) not in (1, 2):
             raise ValueError("fused GroupBy supports 1 or 2 fields")
+        canonical = self.canonical_shards(index)
+        if not canonical:
+            return None
         stacks = [
-            self._rows_stack(index, f, rows, shards)
+            self._rows_stack(index, f, rows, canonical)
             for f, rows in zip(fields, row_lists)
         ]
         if any(s is None for s in stacks):
             return None
-        if filter_call is not None:
-            filt = self.bitmap_stack(index, filter_call, shards)
-        else:
-            S = pad_shards(len(shards), self.mesh)
-            filt = jax.device_put(
-                jnp.full((S, bitops.WORDS), 0xFFFFFFFF, dtype=jnp.uint32),
-                shard_sharding(self.mesh),
-            )
+        filt = self._filter_stack(index, filter_call, shards, canonical)
+        self.fused_dispatches += 1
         if len(fields) == 1:
             return np.asarray(
                 kernels.row_counts_sharded(self.mesh, stacks[0], filt)
@@ -569,21 +649,23 @@ def _apply_prog(prog, operands):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _count_tree(mesh, prog, specs, *operands):
-    def body(*ops):
-        row = _apply_prog(prog, ops)
+def _count_tree(mesh, prog, specs, mask, *operands):
+    def body(m, *ops):
+        row = jnp.bitwise_and(_apply_prog(prog, ops), m)
         return jax.lax.psum(
             jnp.sum(jax.lax.population_count(row).astype(jnp.int32)), SHARD_AXIS
         )
 
-    return shard_map(body, mesh=mesh, in_specs=specs, out_specs=P())(*operands)
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS),) + specs, out_specs=P()
+    )(mask, *operands)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _eval_tree(mesh, prog, specs, *operands):
-    def body(*ops):
-        return _apply_prog(prog, ops)
+def _eval_tree(mesh, prog, specs, mask, *operands):
+    def body(m, *ops):
+        return jnp.bitwise_and(_apply_prog(prog, ops), m)
 
     return shard_map(
-        body, mesh=mesh, in_specs=specs, out_specs=P(SHARD_AXIS)
-    )(*operands)
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS),) + specs, out_specs=P(SHARD_AXIS)
+    )(mask, *operands)

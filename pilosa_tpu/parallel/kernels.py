@@ -9,7 +9,14 @@ collective (``psum``) riding ICI.
 
 All kernels take stacked inputs ``uint32[S, ..., WORDS]`` with S sharded
 over the mesh; padding shards are zero so AND/popcount reduces ignore
-them.
+them.  Filter operands may be ``uint32[S, 1]`` masks (broadcast against
+the word axis) — the engine passes the bare requested-shard mask when a
+query has no filter tree.
+
+These are plain-XLA kernels by measurement, not by default: a Pallas
+VMEM-pipelined version of the fragment-matrix sweep benchmarked within
+noise of XLA's fusion on the real chip (scripts/pallas_vs_xla.json), so
+the hand-written layer was deleted.
 """
 
 from __future__ import annotations
@@ -21,7 +28,6 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..ops import bitops
 from .mesh import SHARD_AXIS
 
 
@@ -30,41 +36,7 @@ def _pc(x):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _count_sharded(mesh, stack):
-    """Total popcount of uint32[S, W] sharded on S -> int32 (replicated)."""
-
-    def body(block):
-        local = jnp.sum(_pc(block))
-        return jax.lax.psum(local, SHARD_AXIS)
-
-    return shard_map(
-        body, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P()
-    )(stack)
-
-
-def count_sharded(mesh, stack):
-    return _count_sharded(mesh, stack)
-
-
-@functools.partial(jax.jit, static_argnums=(0,))
-def _count_and_sharded(mesh, a, b):
-    """psum(popcount(a & b)) — the north-star Count(Intersect(...)) as one
-    fused pass + one ICI all-reduce."""
-
-    def body(x, y):
-        return jax.lax.psum(jnp.sum(_pc(jnp.bitwise_and(x, y))), SHARD_AXIS)
-
-    return shard_map(
-        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P()
-    )(a, b)
-
-
-def count_and_sharded(mesh, a, b):
-    return _count_and_sharded(mesh, a, b)
-
-
-@functools.partial(jax.jit, static_argnums=(0,))
-def _topn_scores_sharded(mesh, candidates, src):
+def topn_scores_sharded(mesh, candidates, src):
     """Per-shard TopN candidate scoring: uint32[S, K, W] x uint32[S, W]
     -> int32[S, K] (kept sharded; the host heap-merges per shard,
     fragment.go top :1018)."""
@@ -77,12 +49,8 @@ def _topn_scores_sharded(mesh, candidates, src):
     )(candidates, src)
 
 
-def topn_scores_sharded(mesh, candidates, src):
-    return _topn_scores_sharded(mesh, candidates, src)
-
-
 @functools.partial(jax.jit, static_argnums=(0,))
-def _counts_per_shard(mesh, stack):
+def counts_per_shard(mesh, stack):
     """Per-shard popcount of uint32[S, W] -> int32[S] (kept sharded)."""
 
     def body(block):
@@ -93,16 +61,12 @@ def _counts_per_shard(mesh, stack):
     )(stack)
 
 
-def counts_per_shard(mesh, stack):
-    return _counts_per_shard(mesh, stack)
-
-
 @functools.partial(jax.jit, static_argnums=(0,))
-def _sum_planes_sharded(mesh, planes, filt):
-    """BSI Sum over the mesh: planes uint32[S, D+1, W], filter uint32[S, W]
-    -> (int32[D] per-plane counts, int32 considered-count), both replicated.
-    The weighted Σ 2^i·counts[i] is assembled host-side in arbitrary
-    precision (fragment.go sum :716-742)."""
+def sum_planes_sharded(mesh, planes, filt):
+    """BSI Sum over the mesh: planes uint32[S, D+1, W], filter
+    uint32[S, W] or uint32[S, 1] -> (int32[D] per-plane counts, int32
+    considered-count), both replicated.  The weighted Σ 2^i·counts[i] is
+    assembled host-side in arbitrary precision (fragment.go sum :716-742)."""
 
     def body(p, f):
         consider = jnp.bitwise_and(p[:, -1, :], f)
@@ -122,20 +86,18 @@ def _sum_planes_sharded(mesh, planes, filt):
     )(planes, filt)
 
 
-def sum_planes_sharded(mesh, planes, filt):
-    return _sum_planes_sharded(mesh, planes, filt)
-
-
 @functools.partial(jax.jit, static_argnums=(0, 3))
-def _min_max_sharded(mesh, planes, filt, is_min: bool):
+def min_max_sharded(mesh, planes, filt, is_min: bool):
     """Per-shard BSI min/max walks: planes uint32[S, D+1, W], filter
-    uint32[S, W] -> (flags int32[S, D], counts int32[S]) kept sharded; the
-    host reduces shard minima/maxima (ValCount.smaller/larger)."""
+    uint32[S, W] or uint32[S, 1] -> (flags int32[S, D], counts int32[S])
+    kept sharded; the host reduces shard minima/maxima
+    (ValCount.smaller/larger)."""
     from ..ops import bsi as bsi_ops
 
     def body(p, f):
+        fb = jnp.broadcast_to(f, p.shape[:1] + p.shape[2:])
         fn = bsi_ops.min_flags if is_min else bsi_ops.max_flags
-        flags, counts = jax.vmap(fn)(p, f)
+        flags, counts = jax.vmap(fn)(p, fb)
         return flags.astype(jnp.int32), counts
 
     return shard_map(
@@ -146,64 +108,8 @@ def _min_max_sharded(mesh, planes, filt, is_min: bool):
     )(planes, filt)
 
 
-def min_max_sharded(mesh, planes, filt, is_min):
-    return _min_max_sharded(mesh, planes, filt, is_min)
-
-
-@functools.partial(jax.jit, static_argnums=(0, 3))
-def _range_count_sharded(mesh, planes, pred_bits, op_kind: int):
-    """Fused BSI range + count over the mesh: one pass computes the
-    predicate mask per shard (ops.bsi logic inlined over the local block)
-    and psums the popcount.  op_kind: 0=EQ 1=NEQ 2=LT 3=LTE 4=GT 5=GTE."""
-    from ..ops import bsi as bsi_ops
-
-    def body(p, bits):
-        depth = p.shape[1] - 1
-        if op_kind == 0:
-            mask = jax.vmap(lambda pl: bsi_ops.range_eq(pl, bits))(p)
-        elif op_kind == 1:
-            mask = jax.vmap(lambda pl: bsi_ops.range_neq(pl, bits))(p)
-        elif op_kind in (2, 3):
-            mask = jax.vmap(
-                lambda pl: bsi_ops.range_lt(pl, bits, op_kind == 3)
-            )(p)
-        else:
-            mask = jax.vmap(
-                lambda pl: bsi_ops.range_gt(pl, bits, op_kind == 5)
-            )(p)
-        return jax.lax.psum(jnp.sum(_pc(mask)), SHARD_AXIS)
-
-    return shard_map(
-        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P()), out_specs=P()
-    )(planes, pred_bits)
-
-
-def range_count_sharded(mesh, planes, pred_bits, op_kind):
-    return _range_count_sharded(mesh, planes, pred_bits, op_kind)
-
-
 @functools.partial(jax.jit, static_argnums=(0,))
-def _import_step_sharded(mesh, fragment_stack, batch_stack):
-    """Bulk-import step: OR a batch of new bits into the resident fragment
-    matrices, all sharded — the device half of fragment.bulkImport
-    (fragment.go:1445), with no cross-device traffic (bits are routed to
-    their owning shard host-side, as api.go:835-845 routes to shard owners).
-    """
-
-    def body(frag, batch):
-        return jnp.bitwise_or(frag, batch)
-
-    return shard_map(
-        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P(SHARD_AXIS)
-    )(fragment_stack, batch_stack)
-
-
-def import_step_sharded(mesh, fragment_stack, batch_stack):
-    return _import_step_sharded(mesh, fragment_stack, batch_stack)
-
-
-@functools.partial(jax.jit, static_argnums=(0,))
-def _group_counts_sharded(mesh, rows_a, rows_b, filt):
+def group_counts_sharded(mesh, rows_a, rows_b, filt):
     """GroupBy pair-count kernel: int32[Ka, Kb] intersection counts of all
     row pairs (first level pre-masked by the filter row), psum'd over
     shards — executeGroupByShard (executor.go:1056) without the host
@@ -223,12 +129,8 @@ def _group_counts_sharded(mesh, rows_a, rows_b, filt):
     )(rows_a, rows_b, filt)
 
 
-def group_counts_sharded(mesh, rows_a, rows_b, filt):
-    return _group_counts_sharded(mesh, rows_a, rows_b, filt)
-
-
 @functools.partial(jax.jit, static_argnums=(0,))
-def _row_counts_sharded(mesh, rows, filt):
+def row_counts_sharded(mesh, rows, filt):
     """Single-field GroupBy: int32[K] filtered row counts, psum'd."""
 
     def body(a, f):
@@ -238,7 +140,3 @@ def _row_counts_sharded(mesh, rows, filt):
     return shard_map(
         body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P()
     )(rows, filt)
-
-
-def row_counts_sharded(mesh, rows, filt):
-    return _row_counts_sharded(mesh, rows, filt)

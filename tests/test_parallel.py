@@ -147,10 +147,10 @@ def test_residency_eviction(holder, mesh):
 
     stack_bytes = 8 * 1 * 32768 * 4  # S=8(padded), R=1 rows, WORDS, u32
     eng = MeshEngine(holder, mesh, max_resident_bytes=2 * stack_bytes)
-    eng.field_stack("i", "a", "standard", [0])
-    eng.field_stack("i", "b", "standard", [0])
+    eng.field_stack("i", "a", "standard")
+    eng.field_stack("i", "b", "standard")
     assert len(eng._stacks) == 2
-    eng.field_stack("i", "c", "standard", [0])  # evicts "a" (LRU)
+    eng.field_stack("i", "c", "standard")  # evicts "a" (LRU)
     assert len(eng._stacks) == 2
     keys = [k[1] for k in eng._stacks]
     assert keys == ["b", "c"]
