@@ -1,15 +1,36 @@
-"""Round benchmark: the north-star `Count(Intersect(...))` over a
-1-BILLION-column set field (BASELINE.json: "Count(Intersect)/TopN p50 on
-a 1B-col index"), framework path vs CPU.
+"""Round benchmark: ALL FIVE BASELINE.md configs + an end-to-end HTTP
+latency, framework path vs CPU.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": p50_us, "unit": "us", "vs_baseline": speedup}
+Prints one JSON line per metric; the LAST line is the north-star
+`Count(Intersect(...))` p50 over a ~1-BILLION-column set field
+(BASELINE.json: "Count(Intersect)/TopN p50 on a 1B-col index").
 
-The reference publishes no numbers and no Go toolchain exists in this
-image (BASELINE.md), so the denominator is a host-CPU implementation of
-the same query over the same dense bitmaps (NumPy vectorized AND+popcount
-— strictly faster than Pilosa's per-container Go loops, i.e. a
-conservative stand-in for Pilosa-CPU)."""
+Configs (BASELINE.md "Targets"):
+  1. single-shard `Row()`+`Count()`                  -> row_count_single_shard_p50
+  2. N-row set-op tree over 10M columns              -> setops_tree_10M_cols_p50
+  3. `TopN()` + `Sum()`/`Min()` on a BSI int field   -> topn_1B_cols_p50, sum_bsi_1B_cols_p50, min_bsi_1B_cols_p50
+  4. time-quantum `Range()` (month-view cover)       -> timerange_1B_cols_p50
+  5. 8-way `GroupBy`+`Count` shard reduce            -> groupby_8way_1B_cols_p50
+  +  HTTP end-to-end `Count` (parse->dispatch->JSON) -> http_count_e2e_p50
+  +  north star                                      -> count_intersect_1B_cols_p50
+
+Methodology, stated plainly:
+- Device p50s are best-of-3 means over pipelined batches with results
+  left on device (the async serving pattern); through the axon tunnel a
+  per-query sync readback measures the ~100ms relay RTT, not the engine.
+- Metrics whose host reduce forces a device->host read every query
+  (TopN scores, Sum plane counts, Min flags, GroupBy counts) are timed
+  per-call synchronously and so include that transfer; they run after
+  the pure-device timings because the first host read permanently
+  degrades tunnel dispatch latency.
+- The HTTP number is a sequential per-request wall-clock p50 through a
+  real localhost server (raw-PQL body in, JSON out), one sync readback
+  per request.
+- The reference publishes no numbers and no Go toolchain exists in this
+  image (BASELINE.md), so vs_baseline is a host-CPU NumPy implementation
+  of the same query over the same dense bitmaps — strictly faster than
+  Pilosa's per-container Go loops, i.e. a conservative denominator.
+"""
 
 import json
 import statistics
@@ -17,96 +38,349 @@ import time
 
 import numpy as np
 
-
 N_SHARDS = 960  # 960 * 2^20 = ~1.007B columns
-DENSITY_BITS = 50  # % of bits set in each row's words
+N_SHARDS_10M = 10  # config 2: 10 * 2^20 = ~10.5M columns
+TOPN_ROWS = 16
+BSI_DEPTH = 8
+GROUPS_A = 4
+GROUPS_B = 2
 REPS = 20
+HTTP_REPS = 30
 
 
-def main():
-    import jax
+def _rand_words(rng, words64):
+    return rng.integers(0, 1 << 63, size=words64, dtype=np.uint64) | (
+        rng.integers(0, 1 << 63, size=words64, dtype=np.uint64) << np.uint64(1)
+    )
 
-    from pilosa_tpu import pql
-    from pilosa_tpu.core.holder import Holder
-    from pilosa_tpu.ops import bitops
-    from pilosa_tpu.parallel import MeshEngine, make_mesh
 
-    rng = np.random.default_rng(42)
-    holder = Holder()
-    holder.open()
-    idx = holder.create_index("bench")
-    f = idx.create_field("f")
-    view = f.view_if_not_exists("standard")
-
-    # Build two ~50%-dense rows per shard directly as words: the benchmark
-    # measures the query engine, not the CSV ingest path (which bench'd
-    # separately lands on the native C++ codec).
-    for s in range(N_SHARDS):
-        frag = view.fragment_if_not_exists(s)
-        for row_id in (10, 11):
-            words = rng.integers(
-                0, 1 << 64, size=bitops.WORDS64, dtype=np.uint64
-            )
-            frag.rows[row_id] = words
-            frag.row_counts[row_id] = int(bitops.popcount_np(words))
-        frag._version += 1
-
-    shards = list(range(N_SHARDS))
-    mesh = make_mesh(len(jax.devices()))
-    eng = MeshEngine(holder, mesh)
-    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
-
-    # Warm-up: build device stacks + compile.  NOTE: no device->host
-    # readback before or during timing — the tunnel in this image
-    # permanently degrades dispatch latency (~0.02ms -> ~2ms) after the
-    # first host read, so correctness checks happen after the clock stops.
-    t0 = time.perf_counter()
-    warm = eng.count_async("bench", call, shards)
-    warm.block_until_ready()
-    build_s = time.perf_counter() - t0
-
-    # Pipelined query stream: results stay on device; one readback at the
-    # end (the async serving pattern; per-query sync readback would
-    # measure the tunnel's ~100ms RTT, not the engine).
-    t_dev = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        results = [eng.count_async("bench", call, shards) for _ in range(REPS)]
-        jax.block_until_ready(results)
-        t_dev.append((time.perf_counter() - t0) / REPS)
-    got = int(results[-1])
-
-    # CPU baseline: same query over the same host bitmaps.
-    host_rows = []
-    for s in shards:
-        frag = holder.fragment("bench", "f", "standard", s)
-        host_rows.append((frag.rows[10], frag.rows[11]))
-
-    def cpu_count():
-        total = 0
-        for a, b in host_rows:
-            total += int(np.sum(np.bitwise_count(np.bitwise_and(a, b))))
-        return total
-
-    assert cpu_count() == got
-    t_cpu = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        cpu_count()
-        t_cpu.append(time.perf_counter() - t0)
-
-    p50_dev = min(t_dev) * 1e6  # best-of-3 pipelined batches, per query
-    p50_cpu = statistics.median(t_cpu) * 1e6
+def emit(metric, seconds, cpu_seconds):
     print(
         json.dumps(
             {
-                "metric": "count_intersect_1B_cols_p50",
-                "value": round(p50_dev, 1),
+                "metric": metric,
+                "value": round(seconds * 1e6, 1),
                 "unit": "us",
-                "vs_baseline": round(p50_cpu / p50_dev, 2),
+                "vs_baseline": round(cpu_seconds / seconds, 2),
             }
-        )
+        ),
+        flush=True,
     )
+
+
+def pipelined_p50(fn, reps=REPS, rounds=3):
+    """Best-of-rounds mean of a pipelined batch of reps async dispatches."""
+    import jax
+
+    times = []
+    result = None
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        results = [fn() for _ in range(reps)]
+        jax.block_until_ready(results)
+        times.append((time.perf_counter() - t0) / reps)
+        result = results[-1]
+    return min(times), result
+
+
+def sync_p50(fn, reps=8):
+    """Median wall-clock of per-call host-synchronous executions."""
+    times = []
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), out
+
+
+def cpu_time(fn, reps=3):
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def progress(msg, _t0=[None]):
+    import sys
+    if _t0[0] is None:
+        _t0[0] = time.perf_counter()
+    print(f"[{time.perf_counter() - _t0[0]:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    progress("importing jax")
+    import jax
+
+    from pilosa_tpu import pql
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops import bitops
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+    progress(f"devices: {jax.devices()}")
+    W64 = bitops.WORDS64
+    rng = np.random.default_rng(42)
+    holder = Holder()
+    holder.open()
+
+    # ---- build: one 1B-col index + one 10M-col index ---------------------
+    idx = holder.create_index("bench")
+    f = idx.create_field("f")  # config 1 + north star: 2 rows/shard
+    topf = idx.create_field("top")  # config 3: TopN candidate field
+    bsi = idx.create_field(
+        "v", FieldOptions(type="int", min=0, max=(1 << BSI_DEPTH) - 1)
+    )
+    tf = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+    ga = idx.create_field("ga")  # config 5
+    gb = idx.create_field("gb")
+
+    host = {}  # (index, field, view) -> {shard: {row: words}}
+
+    def build(index_name, field, view_name, shard, row_id, words):
+        frag = field.view_if_not_exists(view_name).fragment_if_not_exists(shard)
+        frag.load_row_words(row_id, words)
+        host.setdefault((index_name, field.name, view_name), {}).setdefault(
+            shard, {}
+        )[row_id] = words
+
+    t_build0 = time.perf_counter()
+    full = np.full(W64, 0xFFFFFFFFFFFFFFFF, dtype=np.uint64)
+    for s in range(N_SHARDS):
+        for r in (10, 11):
+            build("bench", f, "standard", s, r, _rand_words(rng, W64))
+        for r in range(TOPN_ROWS):
+            build(
+                "bench", topf, "standard", s, r,
+                _rand_words(rng, W64) & _rand_words(rng, W64),
+            )
+        for p in range(BSI_DEPTH):
+            build("bench", bsi, "bsig_v", s, p, _rand_words(rng, W64))
+        build("bench", bsi, "bsig_v", s, BSI_DEPTH, full.copy())
+        row_t = _rand_words(rng, W64)
+        build("bench", tf, "standard", s, 7, row_t)
+        for mv in ("standard_2018", "standard_201801", "standard_201802",
+                   "standard_201803"):
+            build("bench", tf, mv, s, 7, row_t)
+        for g in range(GROUPS_A):
+            build("bench", ga, "standard", s, g,
+                  _rand_words(rng, W64) & _rand_words(rng, W64))
+        for g in range(GROUPS_B):
+            build("bench", gb, "standard", s, g,
+                  _rand_words(rng, W64) & _rand_words(rng, W64))
+    idx10 = holder.create_index("b10m")
+    f10 = idx10.create_field("f")
+    for s in range(N_SHARDS_10M):
+        for r in range(4):
+            build("b10m", f10, "standard", s, 100 + r, _rand_words(rng, W64))
+    for field in (f, topf, bsi, tf, ga, gb, f10):
+        for v in field.views.values():
+            for frag in v.fragments.values():
+                frag.cache.invalidate()
+    build_s = time.perf_counter() - t_build0
+    progress(f"build done in {build_s:.1f}s")
+
+    shards = list(range(N_SHARDS))
+    shards10 = list(range(N_SHARDS_10M))
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh, max_resident_bytes=12 << 30)
+    ex = Executor(holder, mesh_engine=eng)
+
+    # ---- pure-device configs first (no host readbacks while timing) ------
+    call_ns = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count_async("bench", call_ns, shards).block_until_ready()
+    progress("north-star warm done")
+    t_ns, r_ns = pipelined_p50(lambda: eng.count_async("bench", call_ns, shards))
+    progress("north-star timed")
+
+    call_c1 = pql.parse("Row(f=10)").calls[0]
+    eng.count_async("bench", call_c1, [0]).block_until_ready()
+    t_c1, r_c1 = pipelined_p50(lambda: eng.count_async("bench", call_c1, [0]))
+    progress("config1 timed")
+
+    q2 = "Xor(Difference(Union(Row(f=100), Row(f=101)), Row(f=102)), Row(f=103))"
+    call_c2 = pql.parse(q2).calls[0]
+    eng.count_async("b10m", call_c2, shards10).block_until_ready()
+    t_c2, r_c2 = pipelined_p50(lambda: eng.count_async("b10m", call_c2, shards10))
+    progress("config2 timed")
+
+    q4 = "Range(t=7, 2018-01-01T00:00, 2018-04-01T00:00)"
+    call_c4 = pql.parse(q4).calls[0]
+    eng.count_async("bench", call_c4, shards).block_until_ready()
+    t_c4, r_c4 = pipelined_p50(lambda: eng.count_async("bench", call_c4, shards))
+    progress("config4 timed")
+
+    # ---- host-reducing configs (each query includes a small readback) ----
+    q_top = "TopN(top, Row(f=10), n=5)"
+    ex.execute("bench", q_top)
+    progress("topn warm done")
+    t_top, top_pairs = sync_p50(lambda: ex.execute("bench", q_top).results[0])
+    progress("topn timed")
+
+    ex.execute("bench", "Sum(field=v)")
+    t_sum, sum_vc = sync_p50(lambda: ex.execute("bench", "Sum(field=v)").results[0])
+    ex.execute("bench", "Min(field=v)")
+    t_min, min_vc = sync_p50(lambda: ex.execute("bench", "Min(field=v)").results[0])
+
+    q5 = "GroupBy(Rows(field=ga), Rows(field=gb))"
+    ex.execute("bench", q5)
+    t_gb, gb_res = sync_p50(lambda: ex.execute("bench", q5).results[0], reps=4)
+    progress("sum/min/groupby timed")
+
+    # ---- HTTP end-to-end --------------------------------------------------
+    import urllib.request
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.net.server import serve
+
+    api = API(holder=holder, mesh_engine=eng)
+    httpd, _ = serve(api, "localhost", 0)
+    port = httpd.server_address[1]
+    body = f"Count({q2})".encode()
+
+    def http_once():
+        req = urllib.request.Request(
+            f"http://localhost:{port}/index/b10m/query", data=body, method="POST"
+        )
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())["results"][0]
+
+    http_once()
+    t_http_all = []
+    for _ in range(HTTP_REPS):
+        t0 = time.perf_counter()
+        r_http = http_once()
+        t_http_all.append(time.perf_counter() - t0)
+    t_http = statistics.median(t_http_all)
+    httpd.shutdown()
+    progress("http timed")
+
+    # ---- correctness + CPU baselines -------------------------------------
+    F = host[("bench", "f", "standard")]
+    F10 = host[("b10m", "f", "standard")]
+    TOP = host[("bench", "top", "standard")]
+    V = host[("bench", "v", "bsig_v")]
+    T = {mv: host[("bench", "t", mv)] for mv in
+         ("standard_201801", "standard_201802", "standard_201803")}
+    GA = host[("bench", "ga", "standard")]
+    GB = host[("bench", "gb", "standard")]
+
+    def pc(x):
+        return int(np.sum(np.bitwise_count(x)))
+
+    def cpu_ns():
+        return sum(pc(rows[10] & rows[11]) for rows in F.values())
+
+    assert cpu_ns() == int(r_ns)
+    c_ns = cpu_time(cpu_ns)
+
+    def cpu_c1():
+        return pc(F[0][10])
+
+    assert cpu_c1() == int(r_c1)
+    c_c1 = cpu_time(cpu_c1, reps=9)
+
+    def cpu_c2():
+        return sum(
+            pc(((rows[100] | rows[101]) & ~rows[102]) ^ rows[103])
+            for rows in F10.values()
+        )
+
+    assert cpu_c2() == int(r_c2) == r_http
+    c_c2 = cpu_time(cpu_c2, reps=9)
+
+    def cpu_c4():
+        total = 0
+        for s in range(N_SHARDS):
+            acc = T["standard_201801"][s][7].copy()
+            for mv in ("standard_201802", "standard_201803"):
+                acc |= T[mv][s][7]
+            total += pc(acc)
+        return total
+
+    assert cpu_c4() == int(r_c4)
+    c_c4 = cpu_time(cpu_c4)
+
+    def cpu_top():
+        counts = {r: 0 for r in range(TOPN_ROWS)}
+        for s, rows in TOP.items():
+            src = F[s][10]
+            for r in range(TOPN_ROWS):
+                counts[r] += pc(rows[r] & src)
+        return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+
+    want_top = cpu_top()
+    got_top = [(p[0], p[1]) for p in top_pairs]
+    assert got_top == want_top, (got_top, want_top)
+    c_top = cpu_time(cpu_top, reps=1)
+
+    def cpu_sum():
+        total = n = 0
+        for s, rows in V.items():
+            nn = rows[BSI_DEPTH]
+            n += pc(nn)
+            for p in range(BSI_DEPTH):
+                total += pc(rows[p] & nn) << p
+        return total, n
+
+    want_sum = cpu_sum()
+    assert (sum_vc.val, sum_vc.count) == want_sum
+    c_sum = cpu_time(cpu_sum, reps=1)
+
+    def cpu_min():
+        # BSI min via plane walk per shard, then global min.
+        best = None
+        for s, rows in V.items():
+            keep = rows[BSI_DEPTH].copy()
+            val = 0
+            for p in range(BSI_DEPTH - 1, -1, -1):
+                zeros = keep & ~rows[p]
+                if zeros.any():
+                    keep = zeros
+                else:
+                    val |= 1 << p
+            n = pc(keep)
+            if best is None or val < best[0]:
+                best = (val, n)
+        return best
+
+    want_min = cpu_min()
+    assert min_vc.val == want_min[0], (min_vc.val, want_min)
+    c_min = cpu_time(cpu_min, reps=1)
+
+    def cpu_gb():
+        counts = np.zeros((GROUPS_A, GROUPS_B), dtype=np.int64)
+        for s in GA:
+            for i in range(GROUPS_A):
+                a = GA[s][i]
+                for j in range(GROUPS_B):
+                    counts[i, j] += pc(a & GB[s][j])
+        return counts
+
+    want_gb = cpu_gb()
+    got_gb = {
+        (g.group[0].row_id, g.group[1].row_id): g.count for g in gb_res
+    }
+    for i in range(GROUPS_A):
+        for j in range(GROUPS_B):
+            assert got_gb.get((i, j), 0) == int(want_gb[i, j]), (i, j)
+    c_gb = cpu_time(cpu_gb, reps=1)
+
+    # ---- emit (north star LAST: the driver parses the final line) --------
+    progress("baselines done")
+    emit("row_count_single_shard_p50", t_c1, c_c1)
+    emit("setops_tree_10M_cols_p50", t_c2, c_c2)
+    emit("timerange_1B_cols_p50", t_c4, c_c4)
+    emit("topn_1B_cols_p50", t_top, c_top)
+    emit("sum_bsi_1B_cols_p50", t_sum, c_sum)
+    emit("min_bsi_1B_cols_p50", t_min, c_min)
+    emit("groupby_8way_1B_cols_p50", t_gb, c_gb)
+    emit("http_count_e2e_p50", t_http, c_c2)
+    emit("count_intersect_1B_cols_p50", t_ns, c_ns)
 
 
 if __name__ == "__main__":

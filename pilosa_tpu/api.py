@@ -145,7 +145,10 @@ class API:
             cluster.holder = self.holder
 
         def on_create_shard(index, field, shard):
-            cluster.send_sync(
+            # The reference gossips CreateShardMessage asynchronously
+            # (view.go:226 SendAsync); falls back to the HTTP fan-out
+            # when no gossip transport is attached.
+            cluster.send_async(
                 {
                     "type": "create-shard",
                     "index": index,
@@ -225,6 +228,7 @@ class API:
 
     def delete_field(self, index_name: str, field_name: str):
         self.index(index_name).delete_field(field_name)
+        self.holder.bump_shard_epoch(index_name)
         self._broadcast(
             {"type": "delete-field", "index": index_name, "field": field_name}
         )
@@ -241,6 +245,7 @@ class API:
         if v is None:
             raise NotFoundError(f"view not found: {view_name}")
         v.close()
+        self.holder.bump_shard_epoch(index_name)
         import os
         import shutil
 
@@ -518,6 +523,7 @@ class API:
             idx = self.holder.index(msg["index"])
             if idx is not None and idx.field(msg["field"]) is not None:
                 idx.delete_field(msg["field"])
+                self.holder.bump_shard_epoch(msg["index"])
         elif typ == "create-shard":
             idx = self.holder.index(msg["index"])
             f = idx.field(msg["field"]) if idx else None

@@ -138,22 +138,47 @@ def cmd_inspect(args) -> int:
 
 
 def cmd_check(args) -> int:
-    """ctl/check.go: consistency check over fragment files."""
+    """ctl/check.go: consistency check over fragment data files —
+    structural container/offset/op-log validation (codec.check_bytes)
+    plus a decode pass, and .cache JSON validation."""
+    import json as json_mod
+
     from .roaring import codec
 
     failed = 0
     for path in args.paths:
-        if path.endswith(".cache") or path.endswith(".snapshotting"):
+        if path.endswith(".snapshotting"):
+            continue
+        if path.endswith(".cache"):
+            try:
+                with open(path) as f:
+                    doc = json_mod.load(f)
+                pairs = doc.get("pairs", [])
+                if not all(
+                    isinstance(p, list) and len(p) == 2 for p in pairs
+                ):
+                    raise ValueError("malformed pairs")
+                print(f"{path}: ok ({len(pairs)} cached rows)")
+            except Exception as e:
+                print(f"{path}: FAILED: {e}")
+                failed += 1
             continue
         try:
             with open(path, "rb") as f:
-                dec = codec.deserialize(f.read())
+                data = f.read()
+            problems = codec.check_bytes(data)
+            for p in problems:
+                print(f"{path}: PROBLEM: {p}")
+            dec = codec.deserialize(data)
             import numpy as np
 
             vals = dec.values
             if vals.size and not np.all(vals[:-1] <= vals[1:]):
                 raise ValueError("positions out of order")
-            print(f"{path}: ok ({vals.size} bits)")
+            if problems:
+                failed += 1
+            else:
+                print(f"{path}: ok ({vals.size} bits, {dec.op_n} ops)")
         except Exception as e:
             print(f"{path}: FAILED: {e}")
             failed += 1

@@ -124,6 +124,8 @@ class Cluster:
         self._lock = threading.RLock()
         self.logger = logger
         self.holder = None  # attached by the server/harness
+        # Gossip-piggyback hook for SendAsync (set by server._setup_gossip).
+        self.gossip_send_async = None
         if client_factory is None:
             from ..net import InternalClient
 
@@ -315,6 +317,15 @@ class Cluster:
     def send_to(self, node: Node, msg: dict):
         self.client(node).send_message(msg)
 
+    def send_async(self, msg: dict):
+        """Gossip-piggybacked broadcast (broadcast.go SendAsync): rides
+        the SWIM traffic when a gossip transport is attached, falling
+        back to the synchronous HTTP fan-out otherwise."""
+        if self.gossip_send_async is not None:
+            self.gossip_send_async(msg)
+        else:
+            self.send_sync(msg)
+
     # -- resize (cluster.go :741-826, 1150-1497) ---------------------------
 
     def frag_sources(
@@ -452,12 +463,16 @@ class Cluster:
         if self.holder is None:
             return
         for index_name, idx in self.holder.indexes.items():
+            removed = False
             for f in idx.fields.values():
                 for view in f.views.values():
                     for shard in list(view.fragments):
                         if not self.owns_shard(self.node.id, index_name, shard):
                             frag = view.fragments.pop(shard)
                             frag.close()
+                            removed = True
+            if removed:
+                self.holder.bump_shard_epoch(index_name)
 
     # -- topology persistence (cluster.go :1593-1628) ----------------------
 

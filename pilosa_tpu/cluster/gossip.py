@@ -1,15 +1,25 @@
-"""SWIM gossip membership: UDP probing + piggybacked dissemination.
+"""SWIM gossip membership: UDP probing + TCP push/pull + piggybacked
+dissemination.
 
 The stand-in for the reference's memberlist transport (gossip/gossip.go
-:170-541): each node runs a UDP listener and a probe loop.  Protocol
-(JSON datagrams):
+:170-541), with the same three channels memberlist uses:
 
-- ``ping`` / ``ack``     direct failure-detection probe
-- ``ping-req``           indirect probe through k proxies on timeout
-- ``join``               push/pull: joiner gets the full member list
-- every message piggybacks recent membership updates
-  (alive/suspect/dead + incarnation numbers, memberlist's
-  broadcast queue)
+- **UDP datagrams** (JSON) for the failure-detector probes and routine
+  gossip: ``ping`` / ``ack`` / ``ping-req`` — each piggybacking recent
+  membership updates and user broadcasts (the broadcast queue).
+- **TCP push/pull full-state sync** (4-byte length + JSON stream) on
+  join and on a periodic timer (memberlist LocalState/MergeRemoteState,
+  gossip/gossip.go:248-315): both sides exchange their complete member
+  list + pending broadcasts, so state larger than one datagram — or
+  missed by dropped packets — still converges.
+- **TCP fallback for oversized sends**: any message whose encoding
+  exceeds the UDP MTU budget is streamed over TCP instead of being
+  silently truncated (memberlist's reliable channel; the shared
+  TCP/UDP transport of gossip/gossip.go:398-476).
+
+User broadcasts (``send_async``, broadcast.go SendAsync) ride the same
+piggyback queue with a retransmit budget and id-dedup; delivery is
+exactly-once per node via ``on_message``.
 
 State machine per member: ALIVE -> SUSPECT (probe failed) -> DEAD
 (suspicion timeout = suspicion_mult * probe_interval), with refutation:
@@ -24,6 +34,7 @@ from __future__ import annotations
 import json
 import random
 import socket
+import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -33,6 +44,7 @@ SUSPECT = "suspect"
 DEAD = "dead"
 
 _MAX_PIGGYBACK = 8
+_MAX_BCAST_PIGGYBACK = 4
 
 
 class Member:
@@ -67,8 +79,12 @@ class GossipNode:
         probe_timeout: float = 0.2,
         suspicion_mult: int = 4,
         indirect_checks: int = 2,
+        push_pull_interval: float = 2.0,
+        mtu: int = 1400,
+        broadcast_retransmits: int = 4,
         on_join: Optional[Callable] = None,
         on_leave: Optional[Callable] = None,
+        on_message: Optional[Callable] = None,
         logger=None,
     ):
         self.node_id = node_id
@@ -77,14 +93,24 @@ class GossipNode:
         self.probe_timeout = probe_timeout
         self.suspicion_timeout = suspicion_mult * probe_interval
         self.indirect_checks = indirect_checks
+        self.push_pull_interval = push_pull_interval
+        self.mtu = mtu
+        self.broadcast_retransmits = broadcast_retransmits
         self.on_join = on_join
         self.on_leave = on_leave
+        self.on_message = on_message
         self.logger = logger
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((bind, port))
         self._sock.settimeout(0.1)
         self.addr = self._sock.getsockname()
+        # Shared-port TCP listener (memberlist's shared transport).
+        self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._tcp.bind(self.addr)
+        self._tcp.listen(16)
+        self._tcp.settimeout(0.1)
 
         self._lock = threading.RLock()
         self.members: Dict[str, Member] = {
@@ -93,39 +119,121 @@ class GossipNode:
         self.incarnation = 0
         self._acks: Dict[str, threading.Event] = {}
         self._updates: List[dict] = []  # piggyback broadcast queue
+        # User broadcasts: id -> [payload, remaining_retransmits]
+        self._bcasts: Dict[str, list] = {}
+        self._seen_bcasts: Dict[str, float] = {}
+        self._bcast_seq = 0
         self._closing = threading.Event()
         self._threads = []
+        # Fault-injection hook (the clustertests' pumba stand-in): drop
+        # this fraction of outgoing UDP datagrams.  TCP is unaffected.
+        self.udp_drop_prob = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self):
-        for fn in (self._listen_loop, self._probe_loop, self._reap_loop):
+        for fn in (
+            self._listen_loop,
+            self._tcp_listen_loop,
+            self._probe_loop,
+            self._reap_loop,
+            self._push_pull_loop,
+        ):
             t = threading.Thread(target=fn, daemon=True)
             t.start()
             self._threads.append(t)
         return self
 
     def join(self, seed_addr):
-        """Push/pull state with a seed (memberlist Join)."""
-        self._send(tuple(seed_addr), {"type": "join"})
+        """Push/pull full state with a seed over TCP (memberlist Join);
+        falls back to a UDP join datagram if the stream fails."""
+        if not self._push_pull(tuple(seed_addr)):
+            self._send(tuple(seed_addr), {"type": "join"})
 
     def close(self):
         self._closing.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for s in (self._sock, self._tcp):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- user broadcasts (SendAsync) ---------------------------------------
+
+    def send_async(self, payload: dict):
+        """Queue an arbitrary message to gossip to every member
+        (broadcast.go SendAsync): piggybacks on probe traffic with a
+        retransmit budget, id-deduped at receivers, also exchanged in
+        push/pull syncs."""
+        with self._lock:
+            self._bcast_seq += 1
+            bid = f"{self.node_id}-{self._bcast_seq}"
+            self._bcasts[bid] = [payload, self.broadcast_retransmits]
+            self._seen_bcasts[bid] = time.monotonic()
+
+    def _take_bcasts(self) -> List[dict]:
+        out = []
+        with self._lock:
+            done = []
+            for bid, entry in list(self._bcasts.items())[:_MAX_BCAST_PIGGYBACK]:
+                payload, left = entry
+                out.append({"id": bid, "payload": payload})
+                entry[1] = left - 1
+                if entry[1] <= 0:
+                    done.append(bid)
+            for bid in done:
+                del self._bcasts[bid]
+        return out
+
+    def _handle_bcasts(self, bcasts: List[dict]):
+        for b in bcasts or []:
+            bid = b.get("id")
+            if not bid:
+                continue
+            with self._lock:
+                if bid in self._seen_bcasts:
+                    continue
+                self._seen_bcasts[bid] = time.monotonic()
+                # Re-gossip what we just learned (memberlist broadcast
+                # queue semantics).
+                self._bcasts[bid] = [b.get("payload"), self.broadcast_retransmits]
+            if self.on_message is not None:
+                try:
+                    self.on_message(b.get("payload"))
+                except Exception:
+                    pass
 
     # -- wire --------------------------------------------------------------
 
-    def _send(self, addr, msg: dict):
+    def _encode(self, msg: dict) -> bytes:
         msg["from"] = self.node_id
         with self._lock:
             msg["updates"] = self._updates[-_MAX_PIGGYBACK:] + [
                 self.members[self.node_id].to_update()
             ]
+        bcasts = self._take_bcasts()
+        if bcasts:
+            msg["bcasts"] = bcasts
+        return json.dumps(msg).encode()
+
+    def _send(self, addr, msg: dict):
+        data = self._encode(msg)
+        if len(data) > self.mtu:
+            # Oversized for a datagram: stream it (memberlist's TCP
+            # fallback) instead of truncating or dropping.
+            self._send_tcp(tuple(addr), data)
+            return
+        if self.udp_drop_prob and random.random() < self.udp_drop_prob:
+            return  # injected packet loss
         try:
-            self._sock.sendto(json.dumps(msg).encode(), tuple(addr))
+            self._sock.sendto(data, tuple(addr))
+        except OSError:
+            pass
+
+    def _send_tcp(self, addr, data: bytes):
+        try:
+            with socket.create_connection(addr, timeout=self.probe_timeout * 4) as c:
+                c.sendall(struct.pack("<I", len(data)) + data)
         except OSError:
             pass
 
@@ -135,7 +243,86 @@ class GossipNode:
             if len(self._updates) > 64:
                 self._updates = self._updates[-64:]
 
-    # -- loops -------------------------------------------------------------
+    # -- TCP push/pull (memberlist LocalState/MergeRemoteState) ------------
+
+    def _local_state(self) -> dict:
+        with self._lock:
+            return {
+                "type": "push-pull",
+                "from": self.node_id,
+                "members": [m.to_update() for m in self.members.values()],
+                "bcasts": [
+                    {"id": bid, "payload": e[0]}
+                    for bid, e in list(self._bcasts.items())
+                ],
+            }
+
+    def _merge_state(self, state: dict):
+        for update in state.get("members", []):
+            self._apply_update(update)
+        self._handle_bcasts(state.get("bcasts"))
+
+    def _push_pull(self, addr) -> bool:
+        """Full bidirectional state exchange over one TCP stream."""
+        try:
+            with socket.create_connection(
+                addr, timeout=self.probe_timeout * 8
+            ) as c:
+                data = json.dumps(self._local_state()).encode()
+                c.sendall(struct.pack("<I", len(data)) + data)
+                remote = _read_frame(c)
+        except (OSError, ValueError):
+            return False
+        if remote is None:
+            return False
+        self._merge_state(remote)
+        return True
+
+    def _push_pull_loop(self):
+        while not self._closing.wait(self.push_pull_interval):
+            with self._lock:
+                peers = [
+                    m
+                    for m in self.members.values()
+                    if m.id != self.node_id and m.state == ALIVE
+                ]
+            if peers:
+                self._push_pull(random.choice(peers).addr)
+
+    def _tcp_listen_loop(self):
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._tcp.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._tcp_handle, args=(conn,), daemon=True
+            ).start()
+
+    def _tcp_handle(self, conn):
+        with conn:
+            conn.settimeout(self.probe_timeout * 8)
+            try:
+                msg = _read_frame(conn)
+            except (OSError, ValueError):
+                return
+            if msg is None:
+                return
+            if msg.get("type") == "push-pull":
+                # Respond with our state, then merge theirs.
+                try:
+                    data = json.dumps(self._local_state()).encode()
+                    conn.sendall(struct.pack("<I", len(data)) + data)
+                except OSError:
+                    pass
+                self._merge_state(msg)
+            else:
+                # An oversized regular message delivered via stream.
+                self._handle(msg, None)
+
+    # -- UDP loops ---------------------------------------------------------
 
     def _listen_loop(self):
         while not self._closing.is_set():
@@ -151,12 +338,24 @@ class GossipNode:
                 continue
             self._handle(msg, addr)
 
+    def _sender_addr(self, msg: dict, addr):
+        """Reply address: the socket source, else the member table (TCP
+        deliveries have no datagram source)."""
+        if addr is not None:
+            return addr
+        with self._lock:
+            m = self.members.get(msg.get("from", ""))
+        return m.addr if m is not None else None
+
     def _handle(self, msg: dict, addr):
         for update in msg.get("updates", []):
             self._apply_update(update)
+        self._handle_bcasts(msg.get("bcasts"))
         typ = msg.get("type")
+        reply_to = self._sender_addr(msg, addr)
         if typ == "ping":
-            self._send(addr, {"type": "ack", "seq": msg.get("seq")})
+            if reply_to is not None:
+                self._send(reply_to, {"type": "ack", "seq": msg.get("seq")})
         elif typ == "ack":
             ev = self._acks.get(msg.get("seq"))
             if ev is not None:
@@ -166,12 +365,13 @@ class GossipNode:
             target = msg.get("target")
             with self._lock:
                 m = self.members.get(target)
-            if m is not None and self._probe_once(m):
-                self._send(addr, {"type": "ack", "seq": msg.get("seq")})
+            if m is not None and self._probe_once(m) and reply_to is not None:
+                self._send(reply_to, {"type": "ack", "seq": msg.get("seq")})
         elif typ == "join":
-            with self._lock:
-                full = [m.to_update() for m in self.members.values()]
-            self._send(addr, {"type": "state", "members": full})
+            if reply_to is not None:
+                with self._lock:
+                    full = [m.to_update() for m in self.members.values()]
+                self._send(reply_to, {"type": "state", "members": full})
         elif typ == "state":
             for update in msg.get("members", []):
                 self._apply_update(update)
@@ -279,9 +479,17 @@ class GossipNode:
             self.on_leave(m)
 
     def _reap_loop(self):
-        """Promote timed-out suspects to dead (suspicion timeout)."""
+        """Promote timed-out suspects to dead (suspicion timeout) and
+        expire old broadcast-dedup ids (bounded memory)."""
         while not self._closing.wait(self.probe_interval):
             now = time.monotonic()
+            with self._lock:
+                horizon = now - max(300.0, self.push_pull_interval * 20)
+                for bid in [
+                    b for b, t in self._seen_bcasts.items()
+                    if t < horizon and b not in self._bcasts
+                ]:
+                    del self._seen_bcasts[bid]
             dead = []
             with self._lock:
                 for m in self.members.values():
@@ -298,3 +506,27 @@ class GossipNode:
     def alive_members(self) -> List[Member]:
         with self._lock:
             return [m for m in self.members.values() if m.state == ALIVE]
+
+
+def _read_frame(conn) -> Optional[dict]:
+    """Read one [u32 length][json] frame from a stream socket."""
+    head = _read_exact(conn, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack("<I", head)
+    if n > 64 << 20:
+        raise ValueError(f"gossip frame too large: {n}")
+    body = _read_exact(conn, n)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _read_exact(conn, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf

@@ -80,6 +80,7 @@ class Fragment:
         mutex: bool = False,
         cache_debounce: float = 0.0,
         row_attr_store=None,
+        on_touch=None,
     ):
         self.index = index
         self.field = field
@@ -89,6 +90,8 @@ class Fragment:
         self.mutex = mutex
         self.max_op_n = max_op_n
         self.row_attr_store = row_attr_store
+        # Owning view's version bump (engine stack invalidation).
+        self._on_touch = on_touch
 
         self._store = RowStore()
         self.row_counts = self._store.counts
@@ -127,7 +130,16 @@ class Fragment:
             with open(self.path, "rb") as f:
                 data = f.read()
         if data:
-            dec = codec.deserialize(data)
+            try:
+                dec = codec.deserialize(data)
+            except ValueError:
+                # Torn op-log tail (crash mid-append): keep the intact
+                # prefix and truncate the file there, like the
+                # reference's replay.  A corrupt snapshot section still
+                # raises — nothing is safe to keep.
+                dec, valid_len = codec.deserialize_recover(data)
+                with open(self.path, "r+b") as tf:
+                    tf.truncate(valid_len)
             self._load_positions(dec.values)
             self.op_n = dec.op_n
         else:
@@ -236,6 +248,8 @@ class Fragment:
     def _touch(self, row_id: int):
         self._version += 1
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        if self._on_touch is not None:
+            self._on_touch()
 
     @_locked
     def set_bit(self, row_id: int, column_id: int) -> bool:
@@ -515,6 +529,18 @@ class Fragment:
         self.cache.bulk_add(bit_depth, n)
         self.cache.invalidate()
         self.snapshot()
+
+    @_locked
+    def load_row_words(self, row_id: int, words_u64: np.ndarray):
+        """Install a dense row wholesale — the zero-copy load path for
+        benchmarks/restore (no op-log, no snapshot; caller invalidates the
+        rank cache once after the batch)."""
+        n = self._store.set_dense(
+            row_id, np.ascontiguousarray(words_u64, dtype=np.uint64)
+        )
+        self._mutex_owners = None
+        self.cache.bulk_add(row_id, n)
+        self._touch(row_id)
 
     @_locked
     def import_roaring(self, data: bytes, clear: bool = False) -> int:

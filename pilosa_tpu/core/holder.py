@@ -27,11 +27,16 @@ class Holder:
         self.path = path
         self.indexes: Dict[str, Index] = {}
         self.cache_debounce = cache_debounce
-        self.on_create_shard = on_create_shard
+        self._user_on_create_shard = on_create_shard
         self.attr_store_factory = attr_store_factory
         self.opened = False
         # Guards concurrent index creation (holder.go mu).
         self._mu = threading.RLock()
+        # Per-index counters bumped whenever that index's fragment
+        # population changes; cheap invalidation tokens for cached shard
+        # lists and device stacks (MeshEngine).  Per-index so ingest into
+        # one index cannot evict another index's resident stacks.
+        self._shard_epochs: Dict[str, int] = {}
 
     def open(self):
         if self.path is not None:
@@ -61,20 +66,32 @@ class Holder:
             keys=keys,
             track_existence=track_existence,
             cache_debounce=self.cache_debounce,
-            on_create_shard=self.on_create_shard,
+            on_create_shard=self._on_create_shard,
             attr_store_factory=self.attr_store_factory,
         )
+
+    def _on_create_shard(self, index, field, shard):
+        self.bump_shard_epoch(index)
+        if self._user_on_create_shard is not None:
+            self._user_on_create_shard(index, field, shard)
+
+    def shard_epoch(self, index: str) -> int:
+        return self._shard_epochs.get(index, 0)
+
+    def bump_shard_epoch(self, index: str):
+        """Call after adding/removing fragments of an index."""
+        self._shard_epochs[index] = self._shard_epochs.get(index, 0) + 1
 
     def set_on_create_shard(self, fn):
         """Install the create-shard broadcast hook (view.go:226) on this
         holder and every already-created index/field/view."""
-        self.on_create_shard = fn
+        self._user_on_create_shard = fn
         for idx in self.indexes.values():
-            idx.on_create_shard = fn
+            idx.on_create_shard = self._on_create_shard
             for f in idx.fields.values():
-                f.on_create_shard = fn
+                f.on_create_shard = self._on_create_shard
                 for v in f.views.values():
-                    v.on_create_shard = fn
+                    v.on_create_shard = self._on_create_shard
 
     def index(self, name: str) -> Optional[Index]:
         return self.indexes.get(name)
@@ -111,6 +128,7 @@ class Holder:
         if idx is None:
             raise ValueError(f"index not found: {name}")
         idx.close()
+        self.bump_shard_epoch(name)
         if idx.path and os.path.isdir(idx.path):
             import shutil
 

@@ -47,6 +47,13 @@ class View:
         # Callback fired when a shard's fragment first appears — the field
         # broadcasts CreateShardMessage here (view.go:226).
         self.on_create_shard = on_create_shard
+        # Bumped on every mutation of any fragment of this view — the
+        # MeshEngine invalidates its HBM field stacks against this token
+        # instead of walking per-fragment versions each query.
+        self.version = 0
+
+    def _bump_version(self):
+        self.version += 1
 
     def open(self):
         """Load existing fragments from disk."""
@@ -88,6 +95,7 @@ class View:
                 mutex=self.mutex,
                 cache_debounce=self.cache_debounce,
                 row_attr_store=self.row_attr_store,
+                on_touch=self._bump_version,
             )
             self.fragments[shard] = frag
             if self.on_create_shard is not None:

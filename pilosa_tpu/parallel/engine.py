@@ -38,8 +38,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
-from ..ops import bsi as bsi_ops
 from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
+from . import kernels
 from .mesh import SHARD_AXIS, pad_shards, replicated_sharding, shard_sharding
 
 
@@ -102,6 +102,7 @@ class MeshEngine:
         self._scalars: Dict[int, object] = {}
         self._bits: Dict[Tuple[int, int], object] = {}
         self._masks: "OrderedDict[Tuple[int, bytes], object]" = OrderedDict()
+        self._canonical: Dict[str, Tuple[int, List[int]]] = {}
         # Count of fused device dispatches (one per kernel invocation;
         # cluster tests assert it advances when the fused path runs).
         self.fused_dispatches = 0
@@ -119,6 +120,8 @@ class MeshEngine:
         key = (value, depth)
         b = self._bits.get(key)
         if b is None:
+            from ..ops import bsi as bsi_ops
+
             b = jnp.asarray(bsi_ops.to_bits(value, depth))
             self._bits[key] = b
         return b
@@ -127,8 +130,16 @@ class MeshEngine:
 
     def canonical_shards(self, index: str) -> List[int]:
         """The index's local-fragment shard list: the one shard axis every
-        stack of this index is laid out over."""
-        return self.holder.local_shards(index)
+        stack of this index is laid out over.  Cached behind the holder's
+        shard epoch — walking every fragment per query costs ~1 ms at
+        1000 shards, which dominated the north-star dispatch."""
+        epoch = self.holder.shard_epoch(index)
+        cached = self._canonical.get(index)
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        shards = self.holder.local_shards(index)
+        self._canonical[index] = (epoch, shards)
+        return shards
 
     def _mask_words(self, shards, canonical):
         """uint32[S, 1] per-shard mask: all-ones for requested shards,
@@ -169,13 +180,17 @@ class MeshEngine:
         key = (index, field, view)
         if canonical is None:
             canonical = self.canonical_shards(index)
-        frags = [self.holder.fragment(index, field, view, s) for s in canonical]
-        versions = tuple(-1 if f is None else f._version for f in frags)
+        view_obj = self.holder.view(index, field, view)
+        token = (
+            self.holder.shard_epoch(index),
+            id(view_obj),
+            -1 if view_obj is None else view_obj.version,
+        )
         cached = self._stacks.get(key)
         if (
             cached is not None
+            and cached.versions == token
             and cached.shards == canonical
-            and cached.versions == versions
         ):
             self._stacks.move_to_end(key)
             return cached
@@ -184,6 +199,7 @@ class MeshEngine:
         if not canonical:
             return None
 
+        frags = [self.holder.fragment(index, field, view, s) for s in canonical]
         row_ids = sorted(
             {r for f in frags if f is not None for r in f.row_ids()}
         )
@@ -205,7 +221,7 @@ class MeshEngine:
         stack = _FieldStack(
             jax.device_put(jnp.asarray(mat), shard_sharding(self.mesh)),
             row_index,
-            versions,
+            token,
             list(canonical),
         )
         self._stacks[key] = stack
@@ -401,7 +417,7 @@ class MeshEngine:
         prog = self._lower(index, c, lw)
         mask = self._mask_words(shards, canonical)
         self.fused_dispatches += 1
-        return _count_tree(
+        return kernels.count_tree(
             self.mesh, prog, tuple(lw.specs), mask, *lw.operands
         )
 
@@ -425,7 +441,9 @@ class MeshEngine:
         mask = self._mask_words(shards, canonical)
         self.fused_dispatches += 1
         return (
-            _eval_tree(self.mesh, prog, tuple(lw.specs), mask, *lw.operands),
+            kernels.eval_tree(
+                self.mesh, prog, tuple(lw.specs), mask, *lw.operands
+            ),
             canonical,
         )
 
@@ -444,18 +462,16 @@ class MeshEngine:
                 segs[s] = stack[i]
         return Row(segs)
 
-    def _filter_stack(self, index, filter_call, shards, canonical):
-        """uint32[S, ...] filter operand: the evaluated (masked) filter
-        tree, or the bare [S, 1] mask when no filter is given."""
-        if filter_call is not None:
-            stack, _ = self.bitmap_stack(index, filter_call, shards, canonical)
-            return stack
-        return self._mask_words(shards, canonical)
+    def _lower_filter(self, index, filter_call, lw: "_Lowering"):
+        """Lower an optional filter tree; ("ones",) means mask-only."""
+        if filter_call is None:
+            return ("ones",)
+        return self._lower(index, filter_call, lw)
 
     def sum(self, index: str, field_name: str, filter_call: Optional[Call], shards):
-        """BSI Sum over the mesh (returns the ValCount parts: total, count)."""
-        from . import kernels
-
+        """BSI Sum over the mesh (returns the ValCount parts: total,
+        count) — ONE fused dispatch incl. the plane slice and the filter
+        tree."""
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx is not None else None
         bsig = f.bsi_group(field_name) if f is not None else None
@@ -466,11 +482,21 @@ class MeshEngine:
         if stack is None:
             return 0, 0
         canonical = stack.shards
-        planes = _gather_planes(stack.matrix, self._plane_spec(stack, depth))
-        filt = self._filter_stack(index, filter_call, shards, canonical)
+        lw = _Lowering(self, canonical)
+        prog = self._lower_filter(index, filter_call, lw)
+        mask = self._mask_words(shards, canonical)
         self.fused_dispatches += 1
-        counts, n = kernels.sum_planes_sharded(self.mesh, planes, filt)
-        counts = np.asarray(counts)
+        counts, n = jax.device_get(
+            kernels.sum_tree(
+                self.mesh,
+                prog,
+                tuple(lw.specs),
+                self._plane_spec(stack, depth),
+                mask,
+                stack.matrix,
+                *lw.operands,
+            )
+        )
         total = sum(int(counts[i]) << i for i in range(depth))
         n = int(n)
         return total + n * bsig.min, n
@@ -498,12 +524,22 @@ class MeshEngine:
         if stack is None:
             return 0, 0
         canonical = stack.shards
-        planes = _gather_planes(stack.matrix, self._plane_spec(stack, depth))
-        filt = self._filter_stack(index, filter_call, shards, canonical)
+        lw = _Lowering(self, canonical)
+        prog = self._lower_filter(index, filter_call, lw)
+        mask = self._mask_words(shards, canonical)
         self.fused_dispatches += 1
-        flags, counts = kernels.min_max_sharded(self.mesh, planes, filt, is_min)
-        flags = np.asarray(flags)
-        counts = np.asarray(counts)
+        flags, counts = jax.device_get(
+            kernels.minmax_tree(
+                self.mesh,
+                prog,
+                tuple(lw.specs),
+                self._plane_spec(stack, depth),
+                is_min,
+                mask,
+                stack.matrix,
+                *lw.operands,
+            )
+        )
         # Reduce like ValCount.smaller/larger (executor.go:2652-2696):
         # strictly-better value wins; ties keep the first shard's count.
         # The mask zeroed non-requested shards' filters, so their counts
@@ -535,29 +571,30 @@ class MeshEngine:
         present = np.asarray(
             [r in stack.row_index for r in candidate_rows], dtype=bool
         )
-        idxs = np.asarray(
-            [stack.row_index.get(r, 0) for r in candidate_rows], dtype=np.int32
+        idxs = jnp.asarray(
+            [stack.row_index.get(r, 0) for r in candidate_rows],
+            dtype=jnp.int32,
         )
-        cands = stack.matrix[:, idxs, :]
-        src, _ = self.bitmap_stack(index, src_call, shards, stack.shards)
-        self.fused_dispatches += 2  # scoring kernel + per-shard counts
-        # np.array (copy): device-array views are read-only host buffers.
-        scores = np.array(kernels.topn_scores_sharded(self.mesh, cands, src))
+        lw = _Lowering(self, stack.shards)
+        prog = self._lower(index, src_call, lw)
+        mask = self._mask_words(shards, stack.shards)
+        self.fused_dispatches += 1
+        dev_scores, dev_counts = kernels.topn_tree(
+            self.mesh,
+            prog,
+            tuple(lw.specs),
+            mask,
+            stack.matrix,
+            idxs,
+            *lw.operands,
+        )
+        # ONE host transfer for both results (each sync readback pays a
+        # full relay RTT through the tunnel); np.array copy because
+        # device-array views are read-only host buffers.
+        scores, src_counts = jax.device_get((dev_scores, dev_counts))
+        scores = np.array(scores)
         scores[:, ~present] = 0
-        src_counts = np.asarray(kernels.counts_per_shard(self.mesh, src))
         return scores, src_counts, dict(stack.pos)
-
-    def _rows_stack(
-        self, index: str, field: str, row_ids: List[int], canonical=None
-    ):
-        """uint32[S, K, W] stack of the given rows of a field."""
-        stack = self.field_stack(index, field, VIEW_STANDARD, canonical)
-        if stack is None:
-            return None
-        idxs = np.asarray(
-            [stack.row_index.get(r, 0) for r in row_ids], dtype=np.int32
-        )
-        return stack.matrix[:, idxs, :]
 
     def group_counts(
         self,
@@ -568,104 +605,60 @@ class MeshEngine:
         shards: List[int],
     ):
         """Fused GroupBy over 1 or 2 Rows children: every group combination
-        counted in ONE sharded dispatch (BASELINE config #5's 8-way
-        GroupBy+Count shard reduce).  Returns int32[Ka(,Kb)] counts in
-        row-id order, over the requested shard subset only."""
-        from . import kernels
-
+        counted in ONE sharded dispatch — row gathers and the filter tree
+        evaluate in-body (BASELINE config #5's 8-way GroupBy+Count shard
+        reduce).  Returns int32[Ka(,Kb)] counts in row-id order, over the
+        requested shard subset only."""
         if len(fields) not in (1, 2):
             raise ValueError("fused GroupBy supports 1 or 2 fields")
         canonical = self.canonical_shards(index)
         if not canonical:
             return None
-        stacks = [
-            self._rows_stack(index, f, rows, canonical)
-            for f, rows in zip(fields, row_lists)
-        ]
-        if any(s is None for s in stacks):
-            return None
-        filt = self._filter_stack(index, filter_call, shards, canonical)
+        stacks = []
+        idx_arrays = []
+        for fname, rows in zip(fields, row_lists):
+            stack = self.field_stack(index, fname, VIEW_STANDARD, canonical)
+            if stack is None:
+                return None
+            stacks.append(stack)
+            idx_arrays.append(
+                jnp.asarray(
+                    [stack.row_index.get(r, 0) for r in rows], dtype=jnp.int32
+                )
+            )
+        lw = _Lowering(self, canonical)
+        prog = self._lower_filter(index, filter_call, lw)
+        mask = self._mask_words(shards, canonical)
         self.fused_dispatches += 1
         if len(fields) == 1:
             return np.asarray(
-                kernels.row_counts_sharded(self.mesh, stacks[0], filt)
+                kernels.group1_tree(
+                    self.mesh,
+                    prog,
+                    tuple(lw.specs),
+                    mask,
+                    stacks[0].matrix,
+                    idx_arrays[0],
+                    *lw.operands,
+                )
             )
         return np.asarray(
-            kernels.group_counts_sharded(self.mesh, stacks[0], stacks[1], filt)
+            kernels.group2_tree(
+                self.mesh,
+                prog,
+                tuple(lw.specs),
+                mask,
+                stacks[0].matrix,
+                idx_arrays[0],
+                stacks[1].matrix,
+                idx_arrays[1],
+                *lw.operands,
+            )
         )
 
 
-def _gather_planes(mat, pspec):
-    """uint32[S, R, W] -> uint32[S, depth+1, W] per the static layout."""
-    if pspec[0] == "slice":
-        _, start, n = pspec
-        return jax.lax.slice_in_dim(mat, start, start + n, axis=1)
-    idxs = pspec[1]
-    planes = [
-        mat[:, i, :] if i >= 0 else jnp.zeros_like(mat[:, 0, :]) for i in idxs
-    ]
-    return jnp.stack(planes, axis=1)
-
-
-def _apply_prog(prog, operands):
-    kind = prog[0]
-    if kind == "zero":
-        return operands[prog[1]][:, 0, :]
-    if kind == "row":
-        mat, idx = operands[prog[1]], operands[prog[2]]
-        return jax.lax.dynamic_index_in_dim(mat, idx, axis=1, keepdims=False)
-    if kind == "range":
-        _, rk, i_mat, pspec, i_bits = prog
-        planes = _gather_planes(operands[i_mat], pspec)
-        bits = operands[i_bits]
-        fns = {
-            "eq": lambda p: bsi_ops.range_eq(p, bits),
-            "neq": lambda p: bsi_ops.range_neq(p, bits),
-            "lt": lambda p: bsi_ops.range_lt(p, bits, False),
-            "lte": lambda p: bsi_ops.range_lt(p, bits, True),
-            "gt": lambda p: bsi_ops.range_gt(p, bits, False),
-            "gte": lambda p: bsi_ops.range_gt(p, bits, True),
-        }
-        return jax.vmap(fns[rk])(planes)
-    if kind == "between":
-        _, i_mat, pspec, i_lo, i_hi = prog
-        planes = _gather_planes(operands[i_mat], pspec)
-        lo, hi = operands[i_lo], operands[i_hi]
-        return jax.vmap(lambda p: bsi_ops.range_between(p, lo, hi))(planes)
-    subs = [_apply_prog(p, operands) for p in prog[1:]]
-    out = subs[0]
-    for s in subs[1:]:
-        if kind == "or":
-            out = jnp.bitwise_or(out, s)
-        elif kind == "and":
-            out = jnp.bitwise_and(out, s)
-        elif kind == "andnot":
-            out = jnp.bitwise_and(out, jnp.bitwise_not(s))
-        elif kind == "xor":
-            out = jnp.bitwise_xor(out, s)
-        else:
-            raise ValueError(f"bad op {kind}")
-    return out
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _count_tree(mesh, prog, specs, mask, *operands):
-    def body(m, *ops):
-        row = jnp.bitwise_and(_apply_prog(prog, ops), m)
-        return jax.lax.psum(
-            jnp.sum(jax.lax.population_count(row).astype(jnp.int32)), SHARD_AXIS
-        )
-
-    return shard_map(
-        body, mesh=mesh, in_specs=(P(SHARD_AXIS),) + specs, out_specs=P()
-    )(mask, *operands)
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _eval_tree(mesh, prog, specs, mask, *operands):
-    def body(m, *ops):
-        return jnp.bitwise_and(_apply_prog(prog, ops), m)
-
-    return shard_map(
-        body, mesh=mesh, in_specs=(P(SHARD_AXIS),) + specs, out_specs=P(SHARD_AXIS)
-    )(mask, *operands)
+# Back-compat aliases: the production programs live in kernels.py (one
+# jitted shard_map dispatch per query); tests and the multi-host worker
+# address the count program through the engine module.
+_count_tree = kernels.count_tree
+_eval_tree = kernels.eval_tree

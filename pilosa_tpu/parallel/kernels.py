@@ -1,22 +1,29 @@
-"""Sharded query kernels: per-shard device work + ICI collectives.
+"""Sharded query programs: per-shard device work + ICI collectives.
 
 The reference executes a query per shard in a goroutine and reduces
 results over channels/HTTP (executor.go mapReduce :2183-2321).  Here the
-shard axis lives on the device mesh: each kernel is a ``shard_map`` whose
-body does the per-shard bitmap math (one device handles its contiguous
-shard block as a batched leading axis) and whose reduce is an XLA
-collective (``psum``) riding ICI.
+shard axis lives on the device mesh and EVERY query is ONE jitted
+``shard_map`` dispatch: the engine lowers a PQL call tree to a static
+``prog`` (a nested tuple over a flat operand list, see engine._Lowering)
+and these programs evaluate it — row gathers, BSI plane walks, candidate
+gathers, set algebra, popcounts — fused in the body, with an XLA
+collective (``psum``) riding ICI for the reduce.
 
-All kernels take stacked inputs ``uint32[S, ..., WORDS]`` with S sharded
-over the mesh; padding shards are zero so AND/popcount reduces ignore
-them.  Filter operands may be ``uint32[S, 1]`` masks (broadcast against
-the word axis) — the engine passes the bare requested-shard mask when a
-query has no filter tree.
+Nothing here materializes intermediates eagerly: TopN candidate
+gathers, BSI plane slices, and filter trees all happen INSIDE the
+compiled body (an eager ``stack[:, idxs, :]`` on a 960-shard stack
+copies gigabytes per query through the dispatch queue — measured 650 ms
+per TopN before this moved in-body).
 
-These are plain-XLA kernels by measurement, not by default: a Pallas
+All stacked operands are ``uint32[S, ..., WORDS]`` with S sharded over
+the mesh; padding shards are zero.  ``mask`` is the requested-shard
+``uint32[S, 1]`` (broadcasts against the word axis); a filter prog of
+``("ones",)`` means mask-only.
+
+These are plain-XLA programs by measurement, not by default: a Pallas
 VMEM-pipelined version of the fragment-matrix sweep benchmarked within
 noise of XLA's fusion on the real chip (scripts/pallas_vs_xla.json), so
-the hand-written layer was deleted.
+the hand-written kernel layer was deleted.
 """
 
 from __future__ import annotations
@@ -28,6 +35,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..ops import bsi as bsi_ops
 from .mesh import SHARD_AXIS
 
 
@@ -35,66 +43,150 @@ def _pc(x):
     return jax.lax.population_count(x).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def topn_scores_sharded(mesh, candidates, src):
-    """Per-shard TopN candidate scoring: uint32[S, K, W] x uint32[S, W]
-    -> int32[S, K] (kept sharded; the host heap-merges per shard,
-    fragment.go top :1018)."""
+def gather_planes(mat, pspec):
+    """uint32[S, R, W] -> uint32[S, depth+1, W] per the static layout:
+    a contiguous slice when possible, else a gather with -1 => zeros."""
+    if pspec[0] == "slice":
+        _, start, n = pspec
+        return jax.lax.slice_in_dim(mat, start, start + n, axis=1)
+    idxs = pspec[1]
+    planes = [
+        mat[:, i, :] if i >= 0 else jnp.zeros_like(mat[:, 0, :]) for i in idxs
+    ]
+    return jnp.stack(planes, axis=1)
 
-    def body(cands, s):
-        return jnp.sum(_pc(jnp.bitwise_and(cands, s[:, None, :])), axis=-1)
+
+def apply_prog(prog, operands):
+    """Evaluate a lowered bitmap tree over the local shard block."""
+    kind = prog[0]
+    if kind == "zero":
+        return operands[prog[1]][:, 0, :]
+    if kind == "row":
+        mat, idx = operands[prog[1]], operands[prog[2]]
+        return jax.lax.dynamic_index_in_dim(mat, idx, axis=1, keepdims=False)
+    if kind == "range":
+        _, rk, i_mat, pspec, i_bits = prog
+        planes = gather_planes(operands[i_mat], pspec)
+        bits = operands[i_bits]
+        fns = {
+            "eq": lambda p: bsi_ops.range_eq(p, bits),
+            "neq": lambda p: bsi_ops.range_neq(p, bits),
+            "lt": lambda p: bsi_ops.range_lt(p, bits, False),
+            "lte": lambda p: bsi_ops.range_lt(p, bits, True),
+            "gt": lambda p: bsi_ops.range_gt(p, bits, False),
+            "gte": lambda p: bsi_ops.range_gt(p, bits, True),
+        }
+        return jax.vmap(fns[rk])(planes)
+    if kind == "between":
+        _, i_mat, pspec, i_lo, i_hi = prog
+        planes = gather_planes(operands[i_mat], pspec)
+        lo, hi = operands[i_lo], operands[i_hi]
+        return jax.vmap(lambda p: bsi_ops.range_between(p, lo, hi))(planes)
+    subs = [apply_prog(p, operands) for p in prog[1:]]
+    out = subs[0]
+    for s in subs[1:]:
+        if kind == "or":
+            out = jnp.bitwise_or(out, s)
+        elif kind == "and":
+            out = jnp.bitwise_and(out, s)
+        elif kind == "andnot":
+            out = jnp.bitwise_and(out, jnp.bitwise_not(s))
+        elif kind == "xor":
+            out = jnp.bitwise_xor(out, s)
+        else:
+            raise ValueError(f"bad op {kind}")
+    return out
+
+
+def _filter(prog, mask, ops):
+    """Masked filter row: the evaluated tree & mask, or the bare mask
+    (uint32[S, 1], broadcasting) for prog ("ones",)."""
+    if prog == ("ones",):
+        return mask
+    return jnp.bitwise_and(apply_prog(prog, ops), mask)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def count_tree(mesh, prog, specs, mask, *operands):
+    """Count(tree): fused eval + popcount + psum -> replicated int32."""
+
+    def body(m, *ops):
+        row = jnp.bitwise_and(apply_prog(prog, ops), m)
+        return jax.lax.psum(jnp.sum(_pc(row)), SHARD_AXIS)
 
     return shard_map(
-        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P(SHARD_AXIS)
-    )(candidates, src)
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS),) + specs, out_specs=P()
+    )(mask, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def counts_per_shard(mesh, stack):
-    """Per-shard popcount of uint32[S, W] -> int32[S] (kept sharded)."""
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def eval_tree(mesh, prog, specs, mask, *operands):
+    """Evaluate a tree to its masked uint32[S, WORDS] row stack."""
 
-    def body(block):
-        return jnp.sum(_pc(block), axis=-1)
+    def body(m, *ops):
+        return jnp.bitwise_and(apply_prog(prog, ops), m)
 
     return shard_map(
-        body, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P(SHARD_AXIS)
-    )(stack)
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS),) + specs,
+        out_specs=P(SHARD_AXIS),
+    )(mask, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def sum_planes_sharded(mesh, planes, filt):
-    """BSI Sum over the mesh: planes uint32[S, D+1, W], filter
-    uint32[S, W] or uint32[S, 1] -> (int32[D] per-plane counts, int32
-    considered-count), both replicated.  The weighted Σ 2^i·counts[i] is
-    assembled host-side in arbitrary precision (fragment.go sum :716-742)."""
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def topn_tree(mesh, prog, specs, mask, cand_mat, idxs, *operands):
+    """TopN phase-1 in ONE dispatch: evaluate the src tree, gather the
+    candidate rows in-body, score every candidate per shard
+    (fragment.go top :1018/:1089) -> (scores int32[S, K],
+    src_counts int32[S]), kept sharded."""
 
-    def body(p, f):
+    def body(m, cmat, ix, *ops):
+        src = _filter(prog, m, ops)
+        cands = jnp.take(cmat, ix, axis=1)
+        scores = jnp.sum(_pc(jnp.bitwise_and(cands, src[:, None, :])), axis=-1)
+        return scores, jnp.sum(_pc(jnp.broadcast_to(src, cmat.shape[:1] + cmat.shape[2:])), axis=-1)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()) + specs,
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )(mask, cand_mat, idxs, *operands)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def sum_tree(mesh, prog, specs, pspec, mask, plane_mat, *operands):
+    """BSI Sum in ONE dispatch: plane slice + filter tree + weighted
+    popcounts (fragment.go sum :716-742) -> (int32[D] plane counts,
+    int32 considered), replicated.  The Σ 2^i·counts[i] assembly stays
+    host-side in arbitrary precision."""
+
+    def body(m, pm, *ops):
+        f = _filter(prog, m, ops)
+        p = gather_planes(pm, pspec)
         consider = jnp.bitwise_and(p[:, -1, :], f)
         masked = jnp.bitwise_and(p[:, :-1, :], consider[:, None, :])
-        plane_counts = jnp.sum(_pc(masked), axis=(0, 2))
-        n = jnp.sum(_pc(consider))
         return (
-            jax.lax.psum(plane_counts, SHARD_AXIS),
-            jax.lax.psum(n, SHARD_AXIS),
+            jax.lax.psum(jnp.sum(_pc(masked), axis=(0, 2)), SHARD_AXIS),
+            jax.lax.psum(jnp.sum(_pc(consider)), SHARD_AXIS),
         )
 
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)) + specs,
         out_specs=(P(), P()),
-    )(planes, filt)
+    )(mask, plane_mat, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
-def min_max_sharded(mesh, planes, filt, is_min: bool):
-    """Per-shard BSI min/max walks: planes uint32[S, D+1, W], filter
-    uint32[S, W] or uint32[S, 1] -> (flags int32[S, D], counts int32[S])
-    kept sharded; the host reduces shard minima/maxima
-    (ValCount.smaller/larger)."""
-    from ..ops import bsi as bsi_ops
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
+    """BSI Min/Max in ONE dispatch: per-shard plane walks
+    (fragment.go min/max :745-806) -> (flags int32[S, D],
+    counts int32[S]), kept sharded for the host ValCount reduce."""
 
-    def body(p, f):
+    def body(m, pm, *ops):
+        f = _filter(prog, m, ops)
+        p = gather_planes(pm, pspec)
         fb = jnp.broadcast_to(f, p.shape[:1] + p.shape[2:])
         fn = bsi_ops.min_flags if is_min else bsi_ops.max_flags
         flags, counts = jax.vmap(fn)(p, fb)
@@ -103,40 +195,44 @@ def min_max_sharded(mesh, planes, filt, is_min: bool):
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)) + specs,
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-    )(planes, filt)
+    )(mask, plane_mat, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def group_counts_sharded(mesh, rows_a, rows_b, filt):
-    """GroupBy pair-count kernel: int32[Ka, Kb] intersection counts of all
-    row pairs (first level pre-masked by the filter row), psum'd over
-    shards — executeGroupByShard (executor.go:1056) without the host
-    iterator when both Rows lists are materialized."""
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def group1_tree(mesh, prog, specs, mask, mat_a, idxs_a, *operands):
+    """Single-field GroupBy in ONE dispatch -> int32[Ka], replicated."""
 
-    def body(a, b, f):
-        a = jnp.bitwise_and(a, f[:, None, :])
-        inter = jnp.bitwise_and(a[:, :, None, :], b[:, None, :, :])
-        counts = jnp.sum(_pc(inter), axis=(0, 3))
-        return jax.lax.psum(counts, SHARD_AXIS)
+    def body(m, ma, ia, *ops):
+        f = _filter(prog, m, ops)
+        a = jnp.bitwise_and(jnp.take(ma, ia, axis=1), f[:, None, :])
+        return jax.lax.psum(jnp.sum(_pc(a), axis=(0, 2)), SHARD_AXIS)
 
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()) + specs,
         out_specs=P(),
-    )(rows_a, rows_b, filt)
+    )(mask, mat_a, idxs_a, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def row_counts_sharded(mesh, rows, filt):
-    """Single-field GroupBy: int32[K] filtered row counts, psum'd."""
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def group2_tree(mesh, prog, specs, mask, mat_a, idxs_a, mat_b, idxs_b, *operands):
+    """Two-field GroupBy in ONE dispatch: all (Ka, Kb) pair intersection
+    counts (executeGroupByShard, executor.go:1056, without the host
+    iterator) -> int32[Ka, Kb], replicated."""
 
-    def body(a, f):
-        counts = jnp.sum(_pc(jnp.bitwise_and(a, f[:, None, :])), axis=(0, 2))
-        return jax.lax.psum(counts, SHARD_AXIS)
+    def body(m, ma, ia, mb, ib, *ops):
+        f = _filter(prog, m, ops)
+        a = jnp.bitwise_and(jnp.take(ma, ia, axis=1), f[:, None, :])
+        b = jnp.take(mb, ib, axis=1)
+        inter = jnp.bitwise_and(a[:, :, None, :], b[:, None, :, :])
+        return jax.lax.psum(jnp.sum(_pc(inter), axis=(0, 3)), SHARD_AXIS)
 
     return shard_map(
-        body, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), out_specs=P()
-    )(rows, filt)
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(SHARD_AXIS), P()) + specs,
+        out_specs=P(),
+    )(mask, mat_a, idxs_a, mat_b, idxs_b, *operands)

@@ -49,6 +49,32 @@ def global_mesh(n_devices: Optional[int] = None):
     return make_mesh(n_devices)
 
 
+def global_stack(mesh, host_array):
+    """Assemble a shard-axis-sharded GLOBAL array in a multi-process
+    runtime: every process holds the full host truth (each pilosa node
+    replays the same holder files) and contributes only the blocks its
+    addressable devices own.  Single-process this degrades to a plain
+    sharded device_put."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .mesh import SHARD_AXIS
+
+    sh = NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+    return jax.make_array_from_callback(
+        host_array.shape, sh, lambda idx: host_array[idx]
+    )
+
+
+def replicated(mesh, host_array):
+    """A fully-replicated global array (per-process identical copies)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec())
+    return jax.make_array_from_callback(
+        host_array.shape, sh, lambda idx: host_array[idx]
+    )
+
+
 def local_device_count() -> int:
     return jax.local_device_count()
 

@@ -184,6 +184,21 @@ class Bitmap:
         keep = self.values <= np.uint64(2**64 - 1 - n)
         return Bitmap.from_sorted(self.values[keep] + np.uint64(n))
 
+    # -- self-check --------------------------------------------------------
+
+    def check(self) -> list:
+        """Invariant validation (roaring.go Bitmap.Check :1015): sorted,
+        unique, u64 dtype.  Returns a list of problems; empty = sound."""
+        problems = []
+        if self.values.dtype != np.uint64:
+            problems.append(f"dtype {self.values.dtype} != uint64")
+        if self.values.size > 1:
+            if not np.all(self.values[:-1] <= self.values[1:]):
+                problems.append("values not sorted")
+            elif not np.all(self.values[:-1] < self.values[1:]):
+                problems.append("duplicate values")
+        return problems
+
     # -- serialization -----------------------------------------------------
 
     def to_bytes(self) -> bytes:

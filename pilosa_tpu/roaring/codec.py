@@ -203,13 +203,24 @@ def deserialize(data: bytes) -> _Decoded:
     return _deserialize_py(data)
 
 
-def _deserialize_py(data: bytes) -> _Decoded:
+def _deserialize_py(data: bytes, recover: bool = False):
+    """Decode; with ``recover`` returns (decoded, valid_len) and stops the
+    op-log replay at the first corrupt/partial op instead of raising.
+    All corruption surfaces as ValueError (struct bounds errors included)."""
+    try:
+        return _deserialize_py_inner(data, recover)
+    except struct.error as e:
+        raise ValueError(f"roaring: truncated data: {e}") from e
+
+
+def _deserialize_py_inner(data: bytes, recover: bool = False):
     if len(data) < HEADER_BASE_SIZE:
         raise ValueError("roaring: data too small")
     magic = struct.unpack_from("<H", data, 0)[0]
     version = struct.unpack_from("<H", data, 2)[0]
     if magic != MAGIC:
-        return _deserialize_official(data)
+        dec = _deserialize_official(data)
+        return (dec, len(data)) if recover else dec
     if version != VERSION:
         raise ValueError(f"roaring: wrong version {version}")
     key_n = struct.unpack_from("<I", data, 4)[0]
@@ -264,12 +275,20 @@ def _deserialize_py(data: bytes) -> _Decoded:
     view = memoryview(data)
     pos = ops_offset
     while pos < len(data):
-        typ, value = parse_op(view[pos : pos + OP_SIZE])
+        try:
+            typ, value = parse_op(view[pos : pos + OP_SIZE])
+        except ValueError:
+            if recover:
+                break  # torn tail: keep the intact prefix
+            raise
         ops.append((typ, value))
         pos += OP_SIZE
     if ops:
         values = apply_ops(values, ops)
-    return _Decoded(values, len(ops), ops)
+    dec = _Decoded(values, len(ops), ops)
+    if recover:
+        return dec, pos
+    return dec
 
 
 def _deserialize_official(data: bytes) -> _Decoded:
@@ -346,6 +365,130 @@ def _deserialize_official(data: bytes) -> _Decoded:
 
     values = np.concatenate(chunks) if chunks else np.empty(0, dtype=np.uint64)
     return _Decoded(values, 0, [])
+
+
+def deserialize_recover(data: bytes):
+    """Decode with torn-write recovery: op-log replay stops at the first
+    corrupt or partial op (checksum mismatch, bad type, short tail) and
+    returns ``(decoded, valid_len)`` where ``valid_len`` is the byte
+    length of the intact prefix — the caller truncates the file there,
+    like the reference's replay behavior for a torn tail.  Errors in the
+    snapshot section itself still raise (there is nothing safe to keep)."""
+    return _deserialize_py(data, recover=True)
+
+
+def check_bytes(data: bytes) -> list:
+    """Structural validation of a serialized bitmap — the ctl-check /
+    Bitmap.Check equivalent (roaring.go Check :1015, ctl/check.go :47).
+    Returns a list of problem strings; empty means the file is sound.
+    Validates: header magic/version, container types, offset bounds,
+    per-container invariants (array sorted-unique, runs ordered and
+    non-overlapping, bitmap popcount == header count), key ordering, and
+    op-log checksums/types incl. a torn trailing op."""
+    problems = []
+    if len(data) < HEADER_BASE_SIZE:
+        return [f"data too small: {len(data)} bytes"]
+    magic = struct.unpack_from("<H", data, 0)[0]
+    version = struct.unpack_from("<H", data, 2)[0]
+    if magic != MAGIC:
+        try:
+            _deserialize_official(data)
+            return []
+        except Exception as e:
+            return [f"bad magic {magic} and not official roaring: {e}"]
+    if version != VERSION:
+        return [f"wrong version {version}"]
+    key_n = struct.unpack_from("<I", data, 4)[0]
+    headers_end = HEADER_BASE_SIZE + 12 * key_n + 4 * key_n
+    if headers_end > len(data):
+        return [f"header table truncated: need {headers_end}, have {len(data)}"]
+
+    prev_key = -1
+    ops_offset = headers_end
+    for i in range(key_n):
+        hpos = HEADER_BASE_SIZE + 12 * i
+        key, ctype, n_minus_1 = struct.unpack_from("<QHH", data, hpos)
+        n = n_minus_1 + 1
+        if key <= prev_key:
+            problems.append(f"container {i}: key {key} out of order")
+        prev_key = key
+        offset = struct.unpack_from(
+            "<I", data, HEADER_BASE_SIZE + 12 * key_n + 4 * i
+        )[0]
+        if offset > len(data):
+            problems.append(f"container {i}: offset {offset} out of bounds")
+            continue
+        if ctype == CONTAINER_ARRAY:
+            end = offset + n * 2
+            if end > len(data):
+                problems.append(f"container {i}: array data truncated")
+                continue
+            lows = np.frombuffer(data, dtype="<u2", count=n, offset=offset)
+            if n > 1 and not np.all(lows[:-1] < lows[1:]):
+                problems.append(f"container {i}: array not sorted-unique")
+        elif ctype == CONTAINER_RUN:
+            if offset + 2 > len(data):
+                problems.append(f"container {i}: run header truncated")
+                continue
+            run_count = struct.unpack_from("<H", data, offset)[0]
+            end = offset + 2 + run_count * 4
+            if end > len(data):
+                problems.append(f"container {i}: run data truncated")
+                continue
+            runs = np.frombuffer(
+                data, dtype="<u2", count=run_count * 2, offset=offset + 2
+            ).reshape(run_count, 2)
+            total = 0
+            last_end = -1
+            for s, e in runs.astype(np.int64):
+                if e < s:
+                    problems.append(f"container {i}: run [{s},{e}] inverted")
+                elif s <= last_end:
+                    problems.append(
+                        f"container {i}: run [{s},{e}] overlaps/unsorted"
+                    )
+                last_end = max(last_end, int(e))
+                total += int(e) - int(s) + 1
+            if total != n:
+                problems.append(
+                    f"container {i}: run cardinality {total} != header {n}"
+                )
+        elif ctype == CONTAINER_BITMAP:
+            end = offset + 1024 * 8
+            if end > len(data):
+                problems.append(f"container {i}: bitmap data truncated")
+                continue
+            words = np.frombuffer(data, dtype="<u8", count=1024, offset=offset)
+            got = (
+                int(np.sum(np.bitwise_count(words)))
+                if hasattr(np, "bitwise_count")
+                else int(np.sum(np.unpackbits(words.view(np.uint8))))
+            )
+            if got != n:
+                problems.append(
+                    f"container {i}: bitmap popcount {got} != header {n}"
+                )
+        else:
+            problems.append(f"container {i}: unknown type {ctype}")
+            continue
+        ops_offset = max(ops_offset, end)
+
+    pos = ops_offset
+    view = memoryview(data)
+    while pos < len(data):
+        if pos + OP_SIZE > len(data):
+            problems.append(
+                f"op-log: torn trailing op at byte {pos} "
+                f"({len(data) - pos} of {OP_SIZE} bytes)"
+            )
+            break
+        try:
+            parse_op(view[pos : pos + OP_SIZE])
+        except ValueError as e:
+            problems.append(f"op-log: {e} at byte {pos}")
+            break
+        pos += OP_SIZE
+    return problems
 
 
 def parse_op(buf) -> tuple:

@@ -174,6 +174,15 @@ class Server:
         def on_leave(member):
             cluster.node_failed(member.id)
 
+        def on_message(payload):
+            # Gossip-delivered cluster messages (SendAsync receive path)
+            # dispatch like HTTP /internal/cluster/message bodies.
+            if isinstance(payload, dict) and self.api is not None:
+                try:
+                    self.api.cluster_message(payload)
+                except Exception as e:
+                    self.logger.printf("gossip message failed: %s", e)
+
         self.gossip = GossipNode(
             self.node_id,
             meta={"uri": uri, "coordinator": self.config.cluster_coordinator},
@@ -183,8 +192,10 @@ class Server:
             suspicion_mult=self.config.gossip_suspicion_mult,
             on_join=on_join,
             on_leave=on_leave,
+            on_message=on_message,
             logger=self.logger,
         ).start()
+        cluster.gossip_send_async = self.gossip.send_async
         for seed in self.config.gossip_seeds:
             h, _, p = seed.rpartition(":")
             self.gossip.join((h or "127.0.0.1", int(p)))

@@ -75,3 +75,81 @@ def test_join_callback(nodes):
         assert g[0].members["late"].meta == {}
     finally:
         g2.close()
+
+
+def test_large_meta_over_mtu(nodes):
+    """Member metadata bigger than one datagram still propagates: the
+    join push/pull and oversized sends ride TCP (memberlist's stream
+    channel), so nothing silently truncates at the MTU."""
+    big = {"uri": "http://h0", "blob": "x" * 8000}
+    g0 = GossipNode("big0", meta=big, probe_interval=0.1, mtu=1400).start()
+    g1 = GossipNode("big1", probe_interval=0.1, mtu=1400).start()
+    try:
+        g1.join(g0.addr)
+        assert wait_until(
+            lambda: "big0" in g1.members
+            and g1.members["big0"].meta.get("blob") == big["blob"]
+        )
+    finally:
+        g0.close()
+        g1.close()
+
+
+def test_send_async_broadcast(nodes):
+    """send_async payloads reach every member exactly once via gossip
+    piggyback / push-pull (broadcast.go SendAsync semantics)."""
+    g = nodes(3, push_pull_interval=0.3)
+    received = {i: [] for i in range(3)}
+    for i, node in enumerate(g):
+        node.on_message = lambda p, i=i: received[i].append(p)
+    g[1].join(g[0].addr)
+    g[2].join(g[0].addr)
+    assert wait_until(lambda: all(len(x.alive_members()) == 3 for x in g))
+    g[0].send_async({"type": "custom", "n": 42})
+    assert wait_until(
+        lambda: received[1] == [{"type": "custom", "n": 42}]
+        and received[2] == [{"type": "custom", "n": 42}]
+    ), received
+    # Exactly once despite retransmits.
+    time.sleep(0.5)
+    assert len(received[1]) == 1 and len(received[2]) == 1
+
+
+def test_five_node_convergence_with_drops_and_large_state(nodes):
+    """5-node chaos: every node carries >MTU metadata and 30%% of UDP
+    datagrams are dropped — TCP push/pull still converges the full
+    member list and a broadcast."""
+    import random as _random
+
+    g = []
+    for i in range(5):
+        n = GossipNode(
+            f"c{i}",
+            meta={"uri": f"http://h{i}", "pad": "y" * 600},
+            probe_interval=0.1,
+            probe_timeout=0.15,
+            suspicion_mult=6,
+            push_pull_interval=0.3,
+            mtu=1400,
+        ).start()
+        n.udp_drop_prob = 0.3  # lossy UDP; TCP unaffected
+        g.append(n)
+    received = {i: [] for i in range(5)}
+    for i, node in enumerate(g):
+        node.on_message = lambda p, i=i: received[i].append(p)
+    try:
+        for i in range(1, 5):
+            g[i].join(g[0].addr)
+        assert wait_until(
+            lambda: all(len(x.alive_members()) == 5 for x in g), timeout=15
+        ), [len(x.alive_members()) for x in g]
+        g[2].send_async({"hello": "world"})
+        assert wait_until(
+            lambda: all(
+                received[i] == [{"hello": "world"}] for i in range(5) if i != 2
+            ),
+            timeout=15,
+        ), received
+    finally:
+        for n in g:
+            n.close()
