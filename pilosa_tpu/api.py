@@ -11,6 +11,7 @@ from __future__ import annotations
 import csv
 import datetime as dt
 import io
+import time
 from typing import Dict, List, Optional
 
 from . import __version__, pql
@@ -168,8 +169,6 @@ class API:
             exclude_columns=req.exclude_columns,
             column_attrs=req.column_attrs,
         )
-        import time
-
         start = time.monotonic()
         resp = self.executor.execute(req.index, req.query, req.shards, opt)
         # Long-query logging (api.go:1021, server LongQueryTime).
@@ -192,7 +191,14 @@ class API:
         idx = self.holder.create_index(
             name, keys=keys, track_existence=track_existence
         )
-        self._broadcast({"type": "create-index", "index": name, "meta": {"keys": keys}})
+        self._broadcast(
+            {
+                "type": "create-index",
+                "index": name,
+                "cid": idx.creation_id,
+                "meta": {"keys": keys},
+            }
+        )
         return idx
 
     def index(self, name: str):
@@ -202,8 +208,27 @@ class API:
         return idx
 
     def delete_index(self, name: str):
+        idx = self.holder.index(name)
+        cid = idx.creation_id if idx is not None else ""
+        # Tombstone contained fields too: a delayed create-field broadcast
+        # for the dead incarnation must not attach to a recreated index.
+        field_cids = (
+            [f.creation_id for f in idx.fields.values()]
+            if idx is not None
+            else []
+        )
         self.holder.delete_index(name)
-        self._broadcast({"type": "delete-index", "index": name})
+        self.holder.tombstone(cid)
+        for fcid in field_cids:
+            self.holder.tombstone(fcid)
+        self._broadcast(
+            {
+                "type": "delete-index",
+                "index": name,
+                "cid": cid,
+                "fieldCids": field_cids,
+            }
+        )
 
     def create_field(self, index_name: str, field_name: str, options=None):
         idx = self.index(index_name)
@@ -215,6 +240,7 @@ class API:
                 "type": "create-field",
                 "index": index_name,
                 "field": field_name,
+                "cid": f.creation_id,
                 "meta": f.options.to_dict(),
             }
         )
@@ -227,10 +253,19 @@ class API:
         return f
 
     def delete_field(self, index_name: str, field_name: str):
-        self.index(index_name).delete_field(field_name)
+        idx = self.index(index_name)
+        f = idx.field(field_name)
+        cid = f.creation_id if f is not None else ""
+        idx.delete_field(field_name)
         self.holder.bump_shard_epoch(index_name)
+        self.holder.tombstone(cid)
         self._broadcast(
-            {"type": "delete-field", "index": index_name, "field": field_name}
+            {
+                "type": "delete-field",
+                "index": index_name,
+                "field": field_name,
+                "cid": cid,
+            }
         )
 
     def schema(self) -> List[dict]:
@@ -506,22 +541,34 @@ class API:
     def cluster_message(self, msg: dict):
         """Receive a broadcast control-plane message (server.go:485-580)."""
         typ = msg.get("type")
+        # Gossip delivery is AT-LEAST-ONCE and unordered (dedup ids
+        # eventually expire while peers may still retransmit), so every
+        # handler here must be idempotent.  Schema messages carry the
+        # object's creation_id ("cid"): creates skip tombstoned ids and
+        # adopt the originator's id; deletes tombstone the id and only
+        # remove a local object of that same incarnation — a redelivered
+        # or reordered delete can't destroy a recreated object, and
+        # clock skew is irrelevant (no wall-clock comparison).
         if typ == "create-index":
-            self.holder.create_index_if_not_exists(
-                msg["index"], keys=msg.get("meta", {}).get("keys", False)
-            )
+            self._apply_create_index(msg)
         elif typ == "delete-index":
-            if self.holder.index(msg["index"]) is not None:
+            cid = msg.get("cid", "")
+            self.holder.tombstone(cid)
+            for fcid in msg.get("fieldCids", []):
+                self.holder.tombstone(fcid)
+            idx = self.holder.index(msg["index"])
+            if idx is not None and (not cid or idx.creation_id == cid):
+                for f in idx.fields.values():
+                    self.holder.tombstone(f.creation_id)
                 self.holder.delete_index(msg["index"])
         elif typ == "create-field":
-            idx = self.holder.index(msg["index"])
-            if idx is not None:
-                idx.create_field_if_not_exists(
-                    msg["field"], FieldOptions.from_dict(msg.get("meta", {}))
-                )
+            self._apply_create_field(msg["index"], msg)
         elif typ == "delete-field":
+            cid = msg.get("cid", "")
+            self.holder.tombstone(cid)
             idx = self.holder.index(msg["index"])
-            if idx is not None and idx.field(msg["field"]) is not None:
+            f = idx.field(msg["field"]) if idx is not None else None
+            if f is not None and (not cid or f.creation_id == cid):
                 idx.delete_field(msg["field"])
                 self.holder.bump_shard_epoch(msg["index"])
         elif typ == "create-shard":
@@ -534,18 +581,49 @@ class API:
         elif typ == "node-status":
             from .roaring import Bitmap
 
+            # Anti-entropy schema reconciliation: adopt the sender's
+            # tombstones FIRST (so a delete this node missed applies here
+            # instead of this node's stale schema resurrecting it
+            # elsewhere), then merge creations, skipping anything
+            # tombstoned on either side.
+            for cid in msg.get("tombstones", []):
+                if self.holder.is_tombstoned(cid):
+                    continue
+                self.holder.tombstone(cid)
+                for iname, idx in list(self.holder.indexes.items()):
+                    if idx.creation_id == cid:
+                        for f in idx.fields.values():
+                            self.holder.tombstone(f.creation_id)
+                        self.holder.delete_index(iname)
+                        break
+                    for fname, f in list(idx.fields.items()):
+                        if f.creation_id == cid:
+                            idx.delete_field(fname)
+                            self.holder.bump_shard_epoch(iname)
+                            break
             for index_name, info in msg.get("indexes", {}).items():
-                idx = self.holder.create_index_if_not_exists(
-                    index_name, keys=info.get("keys", False)
+                idx = self._apply_create_index(
+                    {
+                        "index": index_name,
+                        "cid": info.get("cid", ""),
+                        "meta": {"keys": info.get("keys", False)},
+                    }
                 )
+                if idx is None:
+                    continue
                 for field_name, finfo in info.get("fields", {}).items():
-                    f = idx.create_field_if_not_exists(
-                        field_name,
-                        FieldOptions.from_dict(finfo.get("options", {})),
+                    f = self._apply_create_field(
+                        index_name,
+                        {
+                            "field": field_name,
+                            "cid": finfo.get("cid", ""),
+                            "meta": finfo.get("options", {}),
+                        },
                     )
-                    f.add_remote_available_shards(
-                        Bitmap(finfo.get("availableShards", []))
-                    )
+                    if f is not None:
+                        f.add_remote_available_shards(
+                            Bitmap(finfo.get("availableShards", []))
+                        )
         elif typ == "recalculate-caches":
             for idx in self.holder.indexes.values():
                 for f in idx.fields.values():
@@ -554,6 +632,42 @@ class API:
                             frag.cache.recalculate()
         elif self.cluster is not None:
             self.cluster.receive_message(msg)
+
+    def _apply_create_index(self, msg: dict):
+        """Idempotent remote create-index: skip tombstoned incarnations,
+        adopt the originator's creation_id on fresh creates, and converge
+        to min(local, remote) cid when both sides created the same name
+        concurrently (otherwise ids diverge forever and later deletes are
+        silently ignored on half the cluster).  Returns the index or None
+        (tombstoned)."""
+        cid = msg.get("cid", "")
+        if self.holder.is_tombstoned(cid):
+            return None
+        existing = self.holder.index(msg["index"])
+        idx = self.holder.create_index_if_not_exists(
+            msg["index"], keys=msg.get("meta", {}).get("keys", False)
+        )
+        if cid and (existing is None or cid < idx.creation_id):
+            idx.creation_id = cid
+            idx.save_meta()
+        return idx
+
+    def _apply_create_field(self, index_name: str, msg: dict):
+        """Idempotent remote create-field (see _apply_create_index)."""
+        cid = msg.get("cid", "")
+        if self.holder.is_tombstoned(cid):
+            return None
+        idx = self.holder.index(index_name)
+        if idx is None:
+            return None
+        existing = idx.field(msg["field"])
+        f = idx.create_field_if_not_exists(
+            msg["field"], FieldOptions.from_dict(msg.get("meta", {}))
+        )
+        if cid and (existing is None or cid < f.creation_id):
+            f.creation_id = cid
+            f.save_meta()
+        return f
 
     def set_coordinator(self, node_id: str):
         if self.cluster is None:

@@ -412,17 +412,26 @@ class Cluster:
         """Schema + per-field available shards (server.go NodeStatus
         :626-674) — exchanged on join and periodically so every node can
         route queries to shards it doesn't hold."""
-        status = {"type": "node-status", "indexes": {}}
+        status = {"type": "node-status", "indexes": {}, "tombstones": []}
         if self.holder is None:
             return status
+        # Deleted-schema tombstones travel with the status so a peer that
+        # missed a delete broadcast applies it here instead of this
+        # exchange resurrecting the object from the peer's stale schema.
+        status["tombstones"] = sorted(self.holder.schema_tombstones)
         for name, idx in self.holder.indexes.items():
             fields = {}
             for fname, f in idx.fields.items():
                 fields[fname] = {
                     "options": f.options.to_dict(),
+                    "cid": f.creation_id,
                     "availableShards": [int(s) for s in f.available_shards()],
                 }
-            status["indexes"][name] = {"keys": idx.keys, "fields": fields}
+            status["indexes"][name] = {
+                "keys": idx.keys,
+                "cid": idx.creation_id,
+                "fields": fields,
+            }
         return status
 
     def follow_resize_instruction(self, instruction: dict):

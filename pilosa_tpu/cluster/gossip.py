@@ -18,8 +18,12 @@ The stand-in for the reference's memberlist transport (gossip/gossip.go
   TCP/UDP transport of gossip/gossip.go:398-476).
 
 User broadcasts (``send_async``, broadcast.go SendAsync) ride the same
-piggyback queue with a retransmit budget and id-dedup; delivery is
-exactly-once per node via ``on_message``.
+piggyback queue with a retransmit budget (scaled with cluster size, as
+memberlist's RetransmitMult) and id-dedup.  Delivery to ``on_message``
+is AT-LEAST-ONCE: dedup ids expire (bounded memory) while a peer may
+still retransmit or push/pull the broadcast, so a late redelivery can
+fire the handler again — cluster message handlers must be idempotent
+(api.cluster_message documents how each one is).
 
 State machine per member: ALIVE -> SUSPECT (probe failed) -> DEAD
 (suspicion timeout = suspicion_mult * probe_interval), with refutation:
@@ -32,6 +36,7 @@ cluster.ReceiveEvent (cluster.go:1658).
 from __future__ import annotations
 
 import json
+import math
 import random
 import socket
 import struct
@@ -101,14 +106,26 @@ class GossipNode:
         self.on_message = on_message
         self.logger = logger
 
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._sock.bind((bind, port))
-        self._sock.settimeout(0.1)
-        self.addr = self._sock.getsockname()
-        # Shared-port TCP listener (memberlist's shared transport).
-        self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._tcp.bind(self.addr)
+        # Shared-port UDP+TCP transport (memberlist's shared transport).
+        # With port=0 the kernel picks the UDP port; the matching TCP port
+        # may be taken by an unrelated socket, so retry on a fresh
+        # ephemeral pair rather than failing.
+        for attempt in range(32):
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.bind((bind, port))
+            self._sock.settimeout(0.1)
+            self.addr = self._sock.getsockname()
+            self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                self._tcp.bind(self.addr)
+            except OSError:
+                self._sock.close()
+                self._tcp.close()
+                if port != 0 or attempt == 31:
+                    raise
+                continue
+            break
         self._tcp.listen(16)
         self._tcp.settimeout(0.1)
 
@@ -168,8 +185,19 @@ class GossipNode:
         with self._lock:
             self._bcast_seq += 1
             bid = f"{self.node_id}-{self._bcast_seq}"
-            self._bcasts[bid] = [payload, self.broadcast_retransmits]
+            self._bcasts[bid] = [payload, self._retransmit_budget()]
             self._seen_bcasts[bid] = time.monotonic()
+
+    def _retransmit_budget(self) -> int:
+        """Retransmit budget scaled to cluster size (memberlist's
+        RetransmitMult * ceil(log10(n+1))): a fixed budget starves large
+        clusters because sends target random — possibly repeated —
+        peers.  Caller holds the lock."""
+        n = len(self.members)
+        return max(
+            self.broadcast_retransmits,
+            self.broadcast_retransmits * math.ceil(math.log10(n + 1)),
+        )
 
     def _take_bcasts(self) -> List[dict]:
         out = []
@@ -196,7 +224,7 @@ class GossipNode:
                 self._seen_bcasts[bid] = time.monotonic()
                 # Re-gossip what we just learned (memberlist broadcast
                 # queue semantics).
-                self._bcasts[bid] = [b.get("payload"), self.broadcast_retransmits]
+                self._bcasts[bid] = [b.get("payload"), self._retransmit_budget()]
             if self.on_message is not None:
                 try:
                     self.on_message(b.get("payload"))

@@ -17,6 +17,7 @@ from __future__ import annotations
 import datetime as dt
 import json
 import os
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -167,6 +168,11 @@ class Field:
         self.path = path
         self.options = options or FieldOptions()
         self.options.validate()
+        # Unique creation id: schema broadcasts carry it so a delete only
+        # ever applies to the incarnation it was issued against (gossip
+        # delivery is at-least-once and unordered; wall clocks are not
+        # comparable across nodes).  Receivers adopt the originator's id.
+        self.creation_id = uuid.uuid4().hex
         self.views: Dict[str, View] = {}
         self.cache_debounce = cache_debounce
         self.on_create_shard = on_create_shard
@@ -199,7 +205,16 @@ class Field:
         p = self._meta_path()
         if os.path.exists(p):
             with open(p) as f:
-                self.options = FieldOptions.from_dict(json.load(f))
+                doc = json.load(f)
+            # Old format: the whole file is the options dict.
+            opts = doc.get("options", doc) if isinstance(doc, dict) else doc
+            self.options = FieldOptions.from_dict(opts)
+            # creation_id must survive restart: a fresh uuid after reopen
+            # would make this node ignore deletes of its own fields and
+            # re-advertise them under an untombstoned id.
+            cid = doc.get("cid") if isinstance(doc, dict) else None
+            if cid:
+                self.creation_id = cid
             self.bsi_groups = []
             if self.options.type == FIELD_TYPE_INT:
                 self.bsi_groups.append(
@@ -210,7 +225,9 @@ class Field:
         if self.path is None:
             return
         with open(self._meta_path(), "w") as f:
-            json.dump(self.options.to_dict(), f)
+            json.dump(
+                {"options": self.options.to_dict(), "cid": self.creation_id}, f
+            )
 
     def open(self):
         if self.path is None:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
 from .fragment import Fragment
@@ -37,10 +38,66 @@ class Holder:
         # lists and device stacks (MeshEngine).  Per-index so ingest into
         # one index cannot evict another index's resident stacks.
         self._shard_epochs: Dict[str, int] = {}
+        # Schema tombstones: creation_ids of deleted indexes/fields, kept
+        # so at-least-once gossip and periodic NodeStatus anti-entropy
+        # cannot resurrect a deleted object (creation_id -> local time,
+        # GC'd after TOMBSTONE_TTL).
+        self.schema_tombstones: Dict[str, float] = {}
+
+    # -- schema tombstones --------------------------------------------------
+
+    MAX_TOMBSTONES = 4096
+
+    def tombstone(self, creation_id: str):
+        if not creation_id:
+            return
+        if creation_id in self.schema_tombstones:
+            return
+        self.schema_tombstones[creation_id] = time.time()
+        # Bounded by count, evicting oldest-inserted (dicts preserve
+        # insertion order) — a TTL-only prune grows without bound under
+        # delete churn and rebuilds the dict per insert.
+        while len(self.schema_tombstones) > self.MAX_TOMBSTONES:
+            self.schema_tombstones.pop(next(iter(self.schema_tombstones)))
+        self._save_tombstones()
+
+    def is_tombstoned(self, creation_id: Optional[str]) -> bool:
+        return bool(creation_id) and creation_id in self.schema_tombstones
+
+    def _tombstones_path(self) -> Optional[str]:
+        return (
+            os.path.join(self.path, ".tombstones")
+            if self.path is not None
+            else None
+        )
+
+    def _save_tombstones(self):
+        p = self._tombstones_path()
+        if p is None:
+            return
+        import json
+
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.schema_tombstones, f)
+        os.replace(tmp, p)
+
+    def _load_tombstones(self):
+        p = self._tombstones_path()
+        if p is None or not os.path.exists(p):
+            return
+        import json
+
+        try:
+            with open(p) as f:
+                self.schema_tombstones.update(json.load(f))
+        except (OSError, ValueError):
+            pass
 
     def open(self):
         if self.path is not None:
             os.makedirs(self.path, exist_ok=True)
+            self._load_tombstones()
             for name in sorted(os.listdir(self.path)):
                 p = os.path.join(self.path, name)
                 if os.path.isdir(p) and not name.startswith("."):

@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import uuid
 from typing import Dict, List, Optional
 
 from ..roaring import Bitmap
@@ -35,6 +36,8 @@ class Index:
         self.path = path
         self.keys = keys
         self.track_existence = track_existence
+        # See Field.creation_id: guards delete-index redelivery.
+        self.creation_id = uuid.uuid4().hex
         self.fields: Dict[str, Field] = {}
         self._mu = threading.RLock()
         self.cache_debounce = cache_debounce
@@ -57,7 +60,12 @@ class Index:
             return
         with open(self._meta_path(), "w") as f:
             json.dump(
-                {"keys": self.keys, "trackExistence": self.track_existence}, f
+                {
+                    "keys": self.keys,
+                    "trackExistence": self.track_existence,
+                    "cid": self.creation_id,
+                },
+                f,
             )
 
     def load_meta(self):
@@ -67,6 +75,9 @@ class Index:
             doc = json.load(f)
         self.keys = doc.get("keys", False)
         self.track_existence = doc.get("trackExistence", True)
+        # See Field._load_meta: creation_id must survive restart.
+        if doc.get("cid"):
+            self.creation_id = doc["cid"]
 
     def open(self):
         if self.path is not None:

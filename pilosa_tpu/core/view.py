@@ -7,6 +7,7 @@ row data, ``standard_YYYY[MM[DD[HH]]]`` hold time-quantum copies, and
 
 from __future__ import annotations
 
+import itertools
 import os
 from typing import Dict, Optional
 
@@ -49,11 +50,16 @@ class View:
         self.on_create_shard = on_create_shard
         # Bumped on every mutation of any fragment of this view — the
         # MeshEngine invalidates its HBM field stacks against this token
-        # instead of walking per-fragment versions each query.
+        # instead of walking per-fragment versions each query.  Writers of
+        # different shards hold only their own fragment lock, so the bump
+        # is an atomic counter (a lost increment would validate a stale
+        # HBM stack forever).
+        self._version_counter = itertools.count(1)
         self.version = 0
 
     def _bump_version(self):
-        self.version += 1
+        # next() on itertools.count is atomic under the GIL.
+        self.version = next(self._version_counter)
 
     def open(self):
         """Load existing fragments from disk."""

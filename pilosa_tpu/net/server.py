@@ -152,8 +152,22 @@ class Handler:
         else:
             try:
                 doc = json.loads(body) if body else {}
+            except UnicodeDecodeError:
+                # A non-UTF-8 body is a client error, not a server crash
+                # (json.loads raises UnicodeDecodeError, not
+                # JSONDecodeError, on undecodable bytes).
+                from ..executor import QueryResponse as _QR
+
+                payload = proto.encode_query_response(
+                    _QR([]), err="request body is not valid UTF-8"
+                )
+                return 400, proto.CONTENT_TYPE, payload
             except json.JSONDecodeError:
-                doc = {"query": body.decode() if isinstance(body, bytes) else body}
+                # Raw-PQL body fallback (the body decoded as UTF-8, it
+                # just isn't JSON).
+                doc = {
+                    "query": body.decode() if isinstance(body, bytes) else body
+                }
             if isinstance(doc, str):
                 doc = {"query": doc}
         req = QueryRequest(

@@ -27,6 +27,7 @@ explicit HBM residency manager.
 from __future__ import annotations
 
 import functools
+import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -98,6 +99,9 @@ class MeshEngine:
             OrderedDict()
         )
         self._resident_bytes = 0
+        # (weakref to evicted device matrix, nbytes): evicted stacks whose
+        # HBM may still be held by an in-flight dispatch.
+        self._pending_free: list = []
         self._zeros: Dict[int, object] = {}
         self._scalars: Dict[int, object] = {}
         self._bits: Dict[Tuple[int, int], object] = {}
@@ -214,7 +218,8 @@ class MeshEngine:
             for r in f.row_ids():
                 mat[si, row_index[r]] = f.row_words(r)
         while (
-            self._resident_bytes + mat.nbytes > self.max_resident_bytes
+            self._resident_bytes + self._pending_bytes() + mat.nbytes
+            > self.max_resident_bytes
             and self._stacks
         ):
             self._evict(next(iter(self._stacks)))
@@ -229,10 +234,26 @@ class MeshEngine:
         return stack
 
     def _evict(self, key):
+        # Drop the cache reference only — never .delete() the device
+        # buffer: an in-flight dispatch may hold this stack in its operand
+        # list (single-dispatch composition captures several stacks), and
+        # deleting a captured buffer fails the query under memory
+        # pressure.  The HBM is freed once the last holder drops it; until
+        # then the bytes stay counted in _pending_free so the admission
+        # check cannot over-admit against memory that is still live.
         stack = self._stacks.pop(key, None)
         if stack is not None:
             self._resident_bytes -= stack.matrix.nbytes
-            stack.matrix.delete()
+            self._pending_free.append(
+                (weakref.ref(stack.matrix), stack.matrix.nbytes)
+            )
+
+    def _pending_bytes(self) -> int:
+        """Purge freed evictees; return bytes of evicted-but-still-live
+        device buffers."""
+        live = [(r, n) for r, n in self._pending_free if r() is not None]
+        self._pending_free = live
+        return sum(n for _, n in live)
 
     def _zero_stack(self, canonical):
         """Cached zeros uint32[S, 1, WORDS] used as the empty-leaf operand."""

@@ -246,6 +246,12 @@ class Server:
             # Drop fragments this node no longer owns (holder.go
             # holderCleaner :852-902).
             self.cluster.clean_holder()
+            # Re-exchange NodeStatus (schema + per-field available shards)
+            # over the reliable fan-out: a create-shard gossip broadcast
+            # whose retransmit budget drained before reaching some node is
+            # repaired here within one anti-entropy interval
+            # (server.go NodeStatus :626-674).
+            self.cluster.send_sync(self.cluster.node_status())
 
         self._spawn(
             sync_and_clean,

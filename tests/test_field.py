@@ -169,6 +169,29 @@ def test_name_validation():
         idx.create_field("UPPER")
 
 
+def test_creation_id_and_tombstones_survive_restart(tmp_path):
+    """creation_ids and schema tombstones persist: a restarted node must
+    still honor deletes issued against its pre-restart incarnations and
+    must not re-advertise tombstoned schema (code-review r3)."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    icid, fcid = idx.creation_id, f.creation_id
+    g = idx.create_field("g")
+    gcid = g.creation_id
+    idx.delete_field("g")
+    h.tombstone(gcid)
+    h.close()
+
+    h2 = Holder(str(tmp_path / "data"))
+    h2.open()
+    assert h2.index("i").creation_id == icid
+    assert h2.index("i").field("f").creation_id == fcid
+    assert h2.is_tombstoned(gcid)
+    h2.close()
+
+
 def test_delete_field_and_index(tmp_path):
     h = Holder(str(tmp_path))
     h.open()
