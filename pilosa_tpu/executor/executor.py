@@ -917,6 +917,10 @@ class Executor:
         ids_arg, _ = c.uint_slice_arg("ids")
         n, _ = c.uint_arg("n")
 
+        fused = self._mesh_topn_full(index, c, shards, opt)
+        if fused is not None:
+            return fused
+
         pairs = self._execute_topn_shards(index, c, shards, opt)
         if not pairs or ids_arg or opt.remote:
             return pairs
@@ -929,6 +933,51 @@ class Executor:
         if n and n < len(trimmed):
             trimmed = trimmed[:n]
         return trimmed
+
+    def _mesh_topn_full(self, index, c: Call, shards, opt):
+        """Single-dispatch TopN: both reference phases (approximate
+        candidate scan + exact recount, executor.go :694-733) collapse
+        into one device program with one tiny readback — exact totals
+        for every cache candidate, gated and trimmed on device.  Applies
+        when every requested shard is local and no attribute/Tanimoto
+        filter needs host candidate metadata; otherwise returns None and
+        the two-phase composition path runs.  Remote (re-entrant) calls
+        also fall through: peers must return untrimmed phase pairs for
+        the coordinator's merge."""
+        if self.mesh_engine is None or opt.remote:
+            return None
+        if c.args.get("attrName") or c.args.get("attrValues"):
+            return None
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 0:
+            return None
+        if len(c.children) > 1:
+            raise Error("TopN() can only have one input bitmap")
+        local = set(self._local_shards(index, shards))
+        if any(s not in local for s in shards):
+            return None
+        field_name = c.args.get("_field") or DEFAULT_FIELD
+        n, _ = c.uint_arg("n")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        if min_threshold <= 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+        try:
+            if not c.children:
+                return self.mesh_engine.topn_cache_only(
+                    index, field_name, shards, n, min_threshold, row_ids or None
+                )
+            return self.mesh_engine.topn_full(
+                index,
+                field_name,
+                c.children[0],
+                shards,
+                n,
+                min_threshold,
+                row_ids or None,
+            )
+        except ValueError:
+            return None
 
     def _execute_topn_shards(self, index, c, shards, opt):
         def map_fn(shard):

@@ -57,6 +57,27 @@ class _FieldStack:
         self.pos = {s: i for i, s in enumerate(shards)}
 
 
+class _TopNCandidates:
+    """Candidate set + per-shard row-count matrix for fused TopN.
+
+    ``cands`` is the id-DESCENDING union of the per-fragment ranked-cache
+    entries (fragment.top's candidate walk, fragment.go :1018-1040);
+    descending so the device ``top_k``'s lowest-index tie-break equals
+    the (-count, -id) pair order.  ``host_cnt`` int32[S, K_pad] holds
+    each candidate's true row count per canonical shard (the phase-2
+    ``cnt`` gate); ``dev_cnt``/``dev_idxs`` are its device twins.
+    Padding columns carry count 0 so the threshold gate (>= 1) drops
+    them on device."""
+
+    __slots__ = ("cands", "dev_idxs", "dev_cnt", "host_cnt")
+
+    def __init__(self, cands, dev_idxs, dev_cnt, host_cnt):
+        self.cands = cands
+        self.dev_idxs = dev_idxs
+        self.dev_cnt = dev_cnt
+        self.host_cnt = host_cnt
+
+
 class _Lowering:
     """Flat operand list + per-operand shardings for one query program."""
 
@@ -107,6 +128,10 @@ class MeshEngine:
         self._bits: Dict[Tuple[int, int], object] = {}
         self._masks: "OrderedDict[Tuple[int, bytes], object]" = OrderedDict()
         self._canonical: Dict[str, Tuple[int, List[int]]] = {}
+        # (index, field) -> (stack token, _TopNCandidates): the cache
+        # candidate union + per-shard row-count matrix backing the fused
+        # TopN program, rebuilt when the field stack's token changes.
+        self._topn_cands: Dict[Tuple[str, str], tuple] = {}
         # Count of fused device dispatches (one per kernel invocation;
         # cluster tests assert it advances when the fused path runs).
         self.fused_dispatches = 0
@@ -616,6 +641,189 @@ class MeshEngine:
         scores = np.array(scores)
         scores[:, ~present] = 0
         return scores, src_counts, dict(stack.pos)
+
+    # -- fused full TopN ----------------------------------------------------
+
+    # Above this candidate-union size the [S, K, W] gather risks HBM
+    # pressure; callers fall back to the two-phase path.
+    MAX_TOPN_CANDIDATES = 4096
+
+    def _build_topn_candidates(self, index, field, stack, cands):
+        """Assemble the id-descending candidate arrays for a stack."""
+        from ..core.view import VIEW_STANDARD as _STD
+
+        S = stack.matrix.shape[0]
+        K = len(cands)
+        K_pad = max(8, 1 << (K - 1).bit_length()) if K else 8
+        host_cnt = np.zeros((S, K_pad), dtype=np.int32)
+        for si, s in enumerate(stack.shards):
+            frag = self.holder.fragment(index, field, _STD, s)
+            if frag is None:
+                continue
+            for ki, r in enumerate(cands):
+                host_cnt[si, ki] = frag.row_count(r)
+        idxs = np.zeros(K_pad, dtype=np.int32)
+        for ki, r in enumerate(cands):
+            idxs[ki] = stack.row_index.get(r, 0)
+        return _TopNCandidates(
+            list(cands),
+            jnp.asarray(idxs),
+            jax.device_put(jnp.asarray(host_cnt), shard_sharding(self.mesh)),
+            host_cnt,
+        )
+
+    def _topn_candidates(self, index, field, stack, row_ids=None):
+        """Cached candidate arrays; explicit ids= queries build ad-hoc."""
+        from ..core.view import VIEW_STANDARD as _STD
+
+        if row_ids:
+            cands = sorted(set(row_ids), reverse=True)
+            return self._build_topn_candidates(index, field, stack, cands)
+        key = (index, field)
+        cached = self._topn_cands.get(key)
+        if cached is not None and cached[0] == stack.versions:
+            return cached[1]
+        cand_set = set()
+        for s in stack.shards:
+            frag = self.holder.fragment(index, field, _STD, s)
+            if frag is not None:
+                cand_set.update(r for r, _ in frag.cache.top())
+        entry = self._build_topn_candidates(
+            index, field, stack, sorted(cand_set, reverse=True)
+        )
+        self._topn_cands[key] = (stack.versions, entry)
+        return entry
+
+    def topn_full_async(
+        self,
+        index: str,
+        field: str,
+        src_call: Call,
+        shards,
+        n: int,
+        min_threshold: int,
+        row_ids=None,
+    ):
+        """Dispatch the whole TopN (phase-1 scoring + gates + exact
+        phase-2 totals + trim) as ONE device program; returns
+        (candidates, n_out, device result) with the result left on
+        device for pipelining, or None when the fused path doesn't
+        apply (candidate union too large)."""
+        stack = self.field_stack(index, field, VIEW_STANDARD)
+        if stack is None:
+            return [], None, None
+        entry = self._topn_candidates(index, field, stack, row_ids)
+        if not entry.cands:
+            return [], None, None
+        if len(entry.cands) > self.MAX_TOPN_CANDIDATES:
+            return None
+        # ids= mode and n=0 skip the device trim (never truncate).
+        n_out = None
+        if n and not row_ids:
+            n_out = min(int(n), entry.dev_idxs.shape[0])
+        lw = _Lowering(self, stack.shards)
+        prog = self._lower(index, src_call, lw)
+        mask = self._mask_words(shards, stack.shards)
+        self.fused_dispatches += 1
+        out = kernels.topn_full_tree(
+            self.mesh,
+            prog,
+            tuple(lw.specs),
+            n_out,
+            mask,
+            stack.matrix,
+            entry.dev_idxs,
+            entry.dev_cnt,
+            self._scalar(max(int(min_threshold), 1)),
+            *lw.operands,
+        )
+        return entry.cands, n_out, out
+
+    def topn_full(
+        self,
+        index: str,
+        field: str,
+        src_call: Call,
+        shards,
+        n: int,
+        min_threshold: int,
+        row_ids=None,
+    ):
+        """Synchronous fused TopN -> sorted (row_id, count) pairs, one
+        tiny readback (int32[n] ids+counts, or int32[K] totals)."""
+        from ..core import cache as cache_mod
+
+        res = self.topn_full_async(
+            index, field, src_call, shards, n, min_threshold, row_ids
+        )
+        if res is None:
+            return None
+        cands, n_out, out = res
+        if out is None:
+            return []
+        if n_out is None:
+            totals = np.asarray(jax.device_get(out))
+            pairs = [
+                (cands[k], int(totals[k]))
+                for k in range(len(cands))
+                if totals[k] > 0
+            ]
+            pairs.sort(key=cache_mod.pair_sort_key)
+            return pairs
+        vals, top_idx = jax.device_get(out)
+        return [
+            (cands[int(i)], int(v))
+            for v, i in zip(vals, top_idx)
+            if v > 0 and int(i) < len(cands)
+        ]
+
+    def topn_cache_only(
+        self, index: str, field: str, shards, n, min_threshold, row_ids=None
+    ):
+        """TopN with NO src bitmap: counts come straight from the cached
+        per-shard row counts — a vectorized host reduce (phase-1
+        per-shard top-n union + phase-2 exact totals over all requested
+        shards), zero device work.  Returns sorted trimmed pairs, or
+        None when the candidate union is too large."""
+        from ..core import cache as cache_mod
+
+        stack = self.field_stack(index, field, VIEW_STANDARD)
+        if stack is None:
+            return []
+        entry = self._topn_candidates(index, field, stack, row_ids)
+        if row_ids:
+            n = 0  # explicit ids: never truncate
+        K = len(entry.cands)
+        if K == 0:
+            return []
+        if K > self.MAX_TOPN_CANDIDATES:
+            return None
+        rows = [stack.pos[s] for s in shards if s in stack.pos]
+        if not rows:
+            return []
+        thr = max(int(min_threshold), 1)
+        cnt = entry.host_cnt[np.asarray(rows, dtype=np.intp)][:, :K]
+        gated = np.where(cnt >= thr, cnt, 0)
+        totals = gated.sum(axis=0, dtype=np.int64)
+        if n:
+            # Phase-1 candidate union: each shard contributes its top-n
+            # by (count desc, id desc) — stable argsort over the
+            # id-descending candidate axis gives exactly that order.
+            sel = np.argsort(-gated, axis=1, kind="stable")[:, : int(n)]
+            pos = np.nonzero(np.take_along_axis(gated, sel, axis=1) > 0)
+            union = np.zeros(K, dtype=bool)
+            union[sel[pos]] = True
+        else:
+            union = (gated > 0).any(axis=0)
+        pairs = [
+            (entry.cands[k], int(totals[k]))
+            for k in np.nonzero(union)[0]
+            if totals[k] > 0
+        ]
+        pairs.sort(key=cache_mod.pair_sort_key)
+        if n:
+            pairs = pairs[: int(n)]
+        return pairs
 
     def group_counts(
         self,

@@ -154,6 +154,44 @@ def topn_tree(mesh, prog, specs, mask, cand_mat, idxs, *operands):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def topn_full_tree(mesh, prog, specs, n_out, mask, cand_mat, idxs, cnt, thr, *operands):
+    """FULL TopN in ONE dispatch: evaluate the src tree, gather + score
+    every cache candidate per shard, apply fragment.top's per-shard
+    gates (row-count >= threshold AND score >= threshold, which also
+    encodes count > 0 since threshold >= 1), psum the exact
+    per-candidate totals over ICI, and trim to the top ``n_out`` on
+    device — the reference's two-phase TopN (executor.go :694-733:
+    approximate phase 1 + exact phase-2 recount) collapsed into one
+    program with one tiny readback.
+
+    Candidates are ordered id-DESCENDING by the caller so ``top_k``'s
+    stable lowest-index tie-break reproduces the (-count, -id) pair
+    sort (cache.go bitmapPairs).  ``n_out=None`` skips the trim and
+    returns the full int32[K] totals (the ids= / no-n mode)."""
+
+    def body(m, cmat, ix, cn, th, *ops):
+        src = _filter(prog, m, ops)
+        cands = jnp.take(cmat, ix, axis=1)
+        scores = jnp.sum(_pc(jnp.bitwise_and(cands, src[:, None, :])), axis=-1)
+        gate = jnp.logical_and(cn >= th, scores >= th)
+        totals = jax.lax.psum(
+            jnp.sum(jnp.where(gate, scores, 0), axis=0), SHARD_AXIS
+        )
+        if n_out is None:
+            return totals
+        return jax.lax.top_k(totals, n_out)
+
+    out_specs = P() if n_out is None else (P(), P())
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(SHARD_AXIS), P())
+        + specs,
+        out_specs=out_specs,
+    )(mask, cand_mat, idxs, cnt, thr, *operands)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def sum_tree(mesh, prog, specs, pspec, mask, plane_mat, *operands):
     """BSI Sum in ONE dispatch: plane slice + filter tree + weighted
     popcounts (fragment.go sum :716-742) -> (int32[D] plane counts,

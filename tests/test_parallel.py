@@ -183,8 +183,13 @@ def test_executor_mesh_topn(holder, mesh):
     plain = Executor(holder)
     engine = MeshEngine(holder, mesh)
     calls = []
-    orig = engine.topn_scores
-    engine.topn_scores = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    for name in ("topn_scores", "topn_full", "topn_cache_only"):
+        orig = getattr(engine, name)
+        setattr(
+            engine,
+            name,
+            (lambda o: lambda *a, **k: calls.append(1) or o(*a, **k))(orig),
+        )
     fused = Executor(holder, mesh_engine=engine)
     # Candidate including a row id absent from the data (99).
     for q in [
@@ -273,3 +278,59 @@ def test_executor_mesh_min_max(holder, mesh):
         "Max(Row(f=10), field=v)",
     ]:
         assert fused.execute("i", q).results == plain.execute("i", q).results, q
+
+
+def test_fused_topn_ties_thresholds(holder, mesh):
+    """Fused full-TopN semantics: cross-shard tie ordering (-count, -id),
+    threshold gating, n=0 (no trim), and ids= (never truncate) all match
+    the per-shard two-phase path bit for bit."""
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    src = idx.create_field("s")
+    rows, cols, srows, scols = [], [], [], []
+    # Rows 1..6 engineered so several aggregate counts tie exactly:
+    # per-shard counts differ but totals collide (rows 2/5 and 3/4).
+    per_shard = {
+        1: [30, 0, 10],  # total 40
+        2: [10, 10, 10],  # total 30 (ties row 5)
+        3: [20, 0, 0],  # total 20 (ties row 4)
+        4: [0, 0, 20],  # total 20
+        5: [0, 30, 0],  # total 30
+        6: [1, 1, 0],  # total 2 (thresholded out at >=3)
+    }
+    for s in range(3):
+        base = s * SHARD_WIDTH
+        for r, picks in per_shard.items():
+            for c in range(picks[s]):
+                rows.append(r)
+                cols.append(base + c)
+        for c in range(200):
+            srows.append(0)
+            scols.append(base + c)
+    f.import_bulk(rows, cols)
+    src.import_bulk(srows, scols)
+    for field in (f, src):
+        for v in field.views.values():
+            for frag in v.fragments.values():
+                frag.cache.recalculate()
+
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    for q in [
+        "TopN(f, Row(s=0), n=3)",
+        "TopN(f, Row(s=0), n=4)",  # trim lands inside the 20/20 tie
+        "TopN(f, Row(s=0))",  # n=0: all positive candidates
+        "TopN(f, Row(s=0), threshold=3)",
+        "TopN(f, Row(s=0), threshold=25)",
+        "TopN(f, Row(s=0), ids=[2, 3, 5, 99])",
+        "TopN(f, n=2)",  # no src: cache-only path
+        "TopN(f)",
+        "TopN(f, threshold=21)",
+        "TopN(f, ids=[1, 4, 99])",
+    ]:
+        got = fused.execute("i", q).results
+        want = plain.execute("i", q).results
+        assert got == want, (q, got, want)
+    # Tie order inside a trimmed result is (count desc, id desc).
+    top4 = fused.execute("i", "TopN(f, Row(s=0), n=4)").results[0]
+    assert top4 == [(1, 40), (5, 30), (2, 30), (4, 20)]
