@@ -18,6 +18,8 @@ for Rows, GroupCount list for GroupBy, bool for mutations.
 from __future__ import annotations
 
 import datetime as dt
+import threading
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -273,6 +275,24 @@ def _merge_group_counts(
 
 _MAXINT = (1 << 63) - 1
 
+_WRITE_CALLS = {"Set", "Clear", "SetRowAttrs", "SetColumnAttrs", "Store", "ClearRow"}
+
+
+def _call_cacheable(c: Call) -> bool:
+    """True when a parsed call can be safely reused across executions:
+    read-only and free of string/bool args anywhere in the tree (key
+    translation rewrites those in place, executor/translate.py:67-98)."""
+    if c.name in _WRITE_CALLS:
+        return False
+    for v in c.args.values():
+        if isinstance(v, (str, bool)):
+            return False
+        if isinstance(v, list) and any(isinstance(x, (str, bool)) for x in v):
+            return False
+        if isinstance(v, Condition) and isinstance(v.value, (str, bool)):
+            return False
+    return all(_call_cacheable(ch) for ch in c.children)
+
 
 class Executor:
     """Single-node query executor; the cluster layer overrides ``_mapper``
@@ -305,6 +325,32 @@ class Executor:
 
         self.stats = stats if stats is not None else NopStatsClient()
         self.tracer = tracer if tracer is not None else NopTracer()
+        # Parsed-query LRU: a hot query stream re-sends the same PQL text,
+        # and for the O(1) small-query path the parse would dominate.
+        # Only side-effect-free numeric read queries are cached (string/
+        # bool args are rewritten in place by key translation, and write
+        # calls must re-validate per execution).
+        self._parse_cache: "OrderedDict[str, Query]" = OrderedDict()
+        self._parse_lock = threading.Lock()
+        # (index, query-text) -> (field, row_id) | False: prepared plans
+        # for the O(1) Count(Row) lane (False = checked, not eligible).
+        self._fast_plans: "OrderedDict[Tuple[str, str], object]" = OrderedDict()
+
+    _PARSE_CACHE_MAX = 512
+
+    def _parse_cached(self, s: str) -> Query:
+        with self._parse_lock:
+            q = self._parse_cache.get(s)
+            if q is not None:
+                self._parse_cache.move_to_end(s)
+                return q
+        q = pql.parse(s)
+        if all(_call_cacheable(c) for c in q.calls):
+            with self._parse_lock:
+                self._parse_cache[s] = q
+                while len(self._parse_cache) > self._PARSE_CACHE_MAX:
+                    self._parse_cache.popitem(last=False)
+        return q
 
     # -- entry point (executor.go Execute :84) -----------------------------
 
@@ -315,14 +361,72 @@ class Executor:
         shards: Optional[List[int]] = None,
         opt: Optional[ExecOptions] = None,
     ) -> QueryResponse:
+        # O(1) small-query lane: a bare Count(Row(f=n)) on a single node
+        # answers from maintained row cardinalities without touching the
+        # dispatch stack (reference analogue: summing roaring container
+        # ``n`` fields instead of materializing the row).
+        if (
+            opt is None
+            and shards is not None
+            and self.cluster is None
+            and self.translator is None
+            and isinstance(query, str)
+        ):
+            resp = self._execute_fast_count(index, query, shards)
+            if resp is not None:
+                return resp
         with self.tracer.start_span("executor.Execute", index=index):
             return self._execute_outer(index, query, shards, opt)
+
+    def _execute_fast_count(self, index, query, shards):
+        key = (index, query)
+        plan = self._fast_plans.get(key)
+        if plan is None:
+            try:
+                q = self._parse_cached(query)
+            except Exception:
+                return None
+            plan = False
+            if len(q.calls) == 1 and q.calls[0].name == "Count":
+                c = q.calls[0]
+                if len(c.children) == 1:
+                    ch = c.children[0]
+                    if (
+                        ch.name == "Row"
+                        and not ch.children
+                        and len(ch.args) == 1
+                    ):
+                        (fname, row), = ch.args.items()
+                        if isinstance(row, int) and not isinstance(row, bool):
+                            plan = (fname, row)
+            with self._parse_lock:
+                self._fast_plans[key] = plan
+                while len(self._fast_plans) > self._PARSE_CACHE_MAX:
+                    self._fast_plans.popitem(last=False)
+        if plan is False:
+            return None
+        fname, row = plan
+        idx = self.holder.index(index)
+        f = idx.field(fname) if idx is not None else None
+        if f is None or f.options.type == FIELD_TYPE_INT:
+            with self._parse_lock:
+                self._fast_plans.pop(key, None)
+            return None
+        view = f.view(VIEW_STANDARD)
+        total = 0
+        if view is not None:
+            frags = view.fragments
+            for s in shards:
+                frag = frags.get(s)
+                if frag is not None:
+                    total += frag.row_count(row)
+        return QueryResponse([total])
 
     def _execute_outer(self, index, query, shards, opt):
         if not index:
             raise Error("index required")
         if isinstance(query, str):
-            query = pql.parse(query)
+            query = self._parse_cached(query)
         idx = self.holder.index(index)
         if idx is None:
             raise IndexNotFoundError(index)
@@ -717,6 +821,10 @@ class Executor:
         if len(c.children) != 1:
             raise Error("Count() requires a single bitmap input")
 
+        fast = self._count_from_cardinalities(index, c.children[0], shards)
+        if fast is not None:
+            return fast
+
         fused = self._mesh_count(index, c.children[0], shards, opt)
 
         def map_fn(shard):
@@ -748,6 +856,33 @@ class Executor:
             index, shards, c, opt, map_fn, lambda p, v: (p or 0) + v
         )
         return result or 0
+
+    def _count_from_cardinalities(self, index, child: Call, shards):
+        """O(1)-per-shard Count of an unfiltered Row: sum the maintained
+        per-row cardinalities (rowstore counts) with ZERO device work —
+        the analogue of the reference summing roaring container ``n``
+        fields (roaring.go Count).  Applies only to a bare
+        ``Row(field=id)`` over locally-owned shards; anything with a
+        filter tree, time bounds, or remote shards returns None."""
+        if child.name != "Row" or child.children or len(child.args) != 1:
+            return None
+        (field_name, row_val), = child.args.items()
+        if isinstance(row_val, bool) or not isinstance(row_val, int):
+            return None
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx is not None else None
+        if f is None or f.options.type == FIELD_TYPE_INT:
+            return None
+        if self.cluster is not None:
+            local = set(self._local_shards(index, shards))
+            if any(s not in local for s in shards):
+                return None
+        total = 0
+        for s in shards:
+            frag = self.holder.fragment(index, field_name, VIEW_STANDARD, s)
+            if frag is not None:
+                total += frag.row_count(row_val)
+        return total
 
     def _mesh_count(self, index, child: Call, shards, opt):
         """Fused Count over the local shard set via the mesh engine;

@@ -168,3 +168,30 @@ def test_min_max_tie_counts(ex):
     q(ex, "Set(1, v=7) Set(2, v=7) Set(3, v=50)")
     assert q(ex, "Min(field=v)")[0].to_dict() == {"value": 7, "count": 2}
     assert q(ex, "Max(field=v)")[0].to_dict() == {"value": 50, "count": 1}
+
+
+def test_fast_count_lane():
+    """The O(1) Count(Row) lane: answers from row cardinalities, tracks
+    mutations, and bails out to the full path on shape changes."""
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    f.import_bulk([1] * 100 + [2] * 50, list(range(100)) + list(range(50)))
+    ex = Executor(h)
+    q = "Count(Row(f=1))"
+    assert ex.execute("i", q, shards=[0]).results[0] == 100
+    assert ("i", q) in ex._fast_plans  # plan prepared
+    ex.execute("i", "Set(777, f=1)")
+    assert ex.execute("i", q, shards=[0]).results[0] == 101
+    ex.execute("i", "Clear(777, f=1)")
+    assert ex.execute("i", q, shards=[0]).results[0] == 100
+    # Non-eligible shapes are remembered as False, still correct.
+    q2 = "Count(Intersect(Row(f=1), Row(f=2)))"
+    assert ex.execute("i", q2, shards=[0]).results[0] == 50
+    assert ex._fast_plans[("i", q2)] is False
+    # Absent shards contribute zero; absent field falls through and errors.
+    assert ex.execute("i", q, shards=[0, 5]).results[0] == 100
+    idx.delete_field("f")
+    idx.create_field("f")
+    assert ex.execute("i", q, shards=[0]).results[0] == 0
