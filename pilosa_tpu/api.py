@@ -11,6 +11,7 @@ from __future__ import annotations
 import csv
 import datetime as dt
 import io
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -133,6 +134,10 @@ class API:
             mesh_engine=mesh_engine,
         )
         self.mesh_engine = mesh_engine
+        # Multi-host collective replay worker (lazy; see
+        # mesh_collective_accept).
+        self._mesh_replay_q = None
+        self._mesh_replay_lock = threading.Lock()
         if cluster is not None:
             self.attach_cluster(cluster, node)
 
@@ -688,6 +693,59 @@ class API:
 
     def get_translate_data(self, offset: int) -> bytes:
         return self.translate_store.reader(offset)
+
+    def mesh_collective_accept(self, index: str, query: str, shards=None):
+        """Accept a multi-host collective Count dispatch from a peer
+        (route /internal/mesh/count): validate NOW (so a bad dispatch
+        fails the initiator's synchronous handoff with a 400 instead of
+        hanging its psum), then replay on the worker thread —
+        deterministic lowering over identical holder state yields the
+        identical program, so the cross-process rendezvous completes
+        (parallel/multihost.py)."""
+        if self.mesh_engine is None:
+            raise ApiError("mesh engine not available")
+        from . import pql as pql_mod
+
+        q = pql_mod.parse(query)
+        if len(q.calls) != 1:
+            raise ApiError("collective dispatch carries exactly one call")
+        if self.holder.index(index) is None:
+            raise NotFoundError(f"index not found: {index}")
+        with self._mesh_replay_lock:
+            if self._mesh_replay_q is None:
+                import queue as queue_mod
+
+                self._mesh_replay_q = queue_mod.Queue()
+                t = threading.Thread(
+                    target=self._mesh_replay_loop, daemon=True,
+                    name="mesh-replay",
+                )
+                t.start()
+        self._mesh_replay_q.put((index, q.calls[0], shards))
+        return True
+
+    def _mesh_replay_loop(self):
+        """Replays peer dispatches in arrival order (the initiating node
+        serializes its own collectives under the engine lock and hands
+        them off in order, so arrival order IS initiation order)."""
+        import jax
+
+        while True:
+            index, call, shards = self._mesh_replay_q.get()
+            try:
+                if shards is None:
+                    idx = self.holder.index(index)
+                    shards = (
+                        [int(s) for s in idx.available_shards()] if idx else []
+                    )
+                with self.mesh_engine.collective_lock:
+                    jax.device_get(
+                        self.mesh_engine.count_async(
+                            index, call, shards, broadcast=False
+                        )
+                    )
+            except Exception as e:
+                self.logger.printf("mesh replay failed: %s", e)
 
     def translate_keys(self, index: str, field: str, keys: List[str]) -> List[int]:
         if field:

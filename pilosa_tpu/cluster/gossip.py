@@ -35,6 +35,7 @@ cluster.ReceiveEvent (cluster.go:1658).
 
 from __future__ import annotations
 
+import base64
 import json
 import math
 import random
@@ -181,7 +182,22 @@ class GossipNode:
         """Queue an arbitrary message to gossip to every member
         (broadcast.go SendAsync): piggybacks on probe traffic with a
         retransmit budget, id-deduped at receivers, also exchanged in
-        push/pull syncs."""
+        push/pull syncs.
+
+        Cluster messages travel as [1-byte type][protobuf] frames
+        (net.privproto), base64-wrapped inside the gossip envelope —
+        the payload encoding parity of broadcast.go:75-83; payloads the
+        frame codec doesn't know stay plain JSON."""
+        try:
+            from ..net import privproto
+
+            payload = {
+                "pb": base64.b64encode(
+                    privproto.marshal_cluster_message(payload)
+                ).decode()
+            }
+        except (ValueError, KeyError, TypeError):
+            pass  # non-cluster payload: gossip it as-is
         with self._lock:
             self._bcast_seq += 1
             bid = f"{self.node_id}-{self._bcast_seq}"
@@ -227,9 +243,21 @@ class GossipNode:
                 self._bcasts[bid] = [b.get("payload"), self._retransmit_budget()]
             if self.on_message is not None:
                 try:
-                    self.on_message(b.get("payload"))
+                    self.on_message(self._decode_payload(b.get("payload")))
                 except Exception:
                     pass
+
+    @staticmethod
+    def _decode_payload(payload):
+        """Unwrap a [type][protobuf] frame back to the handler dict;
+        plain payloads pass through."""
+        if isinstance(payload, dict) and set(payload) == {"pb"}:
+            from ..net import privproto
+
+            return privproto.unmarshal_cluster_message(
+                base64.b64decode(payload["pb"])
+            )
+        return payload
 
     # -- wire --------------------------------------------------------------
 

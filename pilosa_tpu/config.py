@@ -68,6 +68,13 @@ class Config:
         self.translation_primary_url = ""
         # mesh (TPU-native: devices for the shard mesh; 0 = all)
         self.mesh_devices = 0
+        # multi-host JAX runtime (jax.distributed): coordinator address
+        # enables it; peers are the other servers' base URLs that must
+        # replay collective dispatches (parallel/multihost.py).
+        self.jax_coordinator = ""
+        self.jax_num_processes = 0
+        self.jax_process_id = 0
+        self.mesh_peers: List[str] = []
 
     # -- loading -----------------------------------------------------------
 
@@ -127,6 +134,12 @@ class Config:
         )
         mesh = doc.get("mesh", {})
         self.mesh_devices = mesh.get("devices", self.mesh_devices)
+        self.jax_coordinator = mesh.get("jax-coordinator", self.jax_coordinator)
+        self.jax_num_processes = mesh.get(
+            "jax-num-processes", self.jax_num_processes
+        )
+        self.jax_process_id = mesh.get("jax-process-id", self.jax_process_id)
+        self.mesh_peers = mesh.get("peers", self.mesh_peers)
 
     def load_env(self, environ=None):
         env = environ if environ is not None else os.environ
@@ -159,6 +172,10 @@ class Config:
             ("tracing_sampler_type", "TRACING_SAMPLER_TYPE", str),
             ("translation_primary_url", "TRANSLATION_PRIMARY_URL", str),
             ("mesh_devices", "MESH_DEVICES", int),
+            ("jax_coordinator", "JAX_COORDINATOR", str),
+            ("jax_num_processes", "JAX_NUM_PROCESSES", int),
+            ("jax_process_id", "JAX_PROCESS_ID", int),
+            ("mesh_peers", "MESH_PEERS", list),
         ]:
             v = get(name, cast)
             if v is not None:
@@ -208,6 +225,10 @@ primary-url = "{self.translation_primary_url}"
 
 [mesh]
 devices = {self.mesh_devices}
+jax-coordinator = "{self.jax_coordinator}"
+jax-num-processes = {self.jax_num_processes}
+jax-process-id = {self.jax_process_id}
+peers = [{", ".join(f'"{u}"' for u in self.mesh_peers)}]
 """
 
     def bind_host_port(self):

@@ -1,4 +1,5 @@
 from .executor import (
+    Error,
     ExecOptions,
     Executor,
     FieldRow,
@@ -9,6 +10,7 @@ from .executor import (
 )
 
 __all__ = [
+    "Error",
     "ExecOptions",
     "Executor",
     "FieldRow",

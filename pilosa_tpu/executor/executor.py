@@ -332,9 +332,12 @@ class Executor:
         # calls must re-validate per execution).
         self._parse_cache: "OrderedDict[str, Query]" = OrderedDict()
         self._parse_lock = threading.Lock()
-        # (index, query-text) -> (field, row_id) | False: prepared plans
-        # for the O(1) Count(Row) lane (False = checked, not eligible).
+        # (index, query-text) -> Row Call | False: prepared plans for the
+        # O(1) Count(Row) lane (False = checked, not eligible).
         self._fast_plans: "OrderedDict[Tuple[str, str], object]" = OrderedDict()
+        # index -> (shard_epoch, default shard list): available_shards()
+        # walks every field's bitmap, too slow for the O(1) lane.
+        self._fast_shards: Dict[str, Tuple[int, List[int]]] = {}
 
     _PARSE_CACHE_MAX = 512
 
@@ -367,60 +370,67 @@ class Executor:
         # ``n`` fields instead of materializing the row).
         if (
             opt is None
-            and shards is not None
             and self.cluster is None
             and self.translator is None
             and isinstance(query, str)
         ):
-            resp = self._execute_fast_count(index, query, shards)
+            resp, parsed = self._execute_fast_count(index, query, shards)
             if resp is not None:
                 return resp
+            if parsed is not None:
+                query = parsed  # don't re-parse on the outer path
         with self.tracer.start_span("executor.Execute", index=index):
             return self._execute_outer(index, query, shards, opt)
 
     def _execute_fast_count(self, index, query, shards):
+        """O(1)-lane probe: returns (response, parsed).  ``response`` is
+        set when the lane answered; otherwise ``parsed`` (when available)
+        lets the caller skip re-parsing.  Eligibility and counting both
+        live in _count_from_cardinalities — one implementation for the
+        prepared lane and the generic Count path."""
         key = (index, query)
         plan = self._fast_plans.get(key)
+        parsed = None
         if plan is None:
             try:
-                q = self._parse_cached(query)
+                parsed = self._parse_cached(query)
             except Exception:
-                return None
+                return None, None  # outer path surfaces the parse error
             plan = False
-            if len(q.calls) == 1 and q.calls[0].name == "Count":
-                c = q.calls[0]
-                if len(c.children) == 1:
-                    ch = c.children[0]
-                    if (
-                        ch.name == "Row"
-                        and not ch.children
-                        and len(ch.args) == 1
-                    ):
-                        (fname, row), = ch.args.items()
-                        if isinstance(row, int) and not isinstance(row, bool):
-                            plan = (fname, row)
+            if (
+                len(parsed.calls) == 1
+                and parsed.calls[0].name == "Count"
+                and len(parsed.calls[0].children) == 1
+            ):
+                ch = parsed.calls[0].children[0]
+                # Structural eligibility is static per query text; field
+                # shape/type stays dynamic (checked per execution by
+                # _count_from_cardinalities).
+                if ch.name == "Row" and not ch.children and len(ch.args) == 1:
+                    (row_val,) = ch.args.values()
+                    if isinstance(row_val, int) and not isinstance(row_val, bool):
+                        plan = ch
             with self._parse_lock:
                 self._fast_plans[key] = plan
                 while len(self._fast_plans) > self._PARSE_CACHE_MAX:
                     self._fast_plans.popitem(last=False)
         if plan is False:
-            return None
-        fname, row = plan
-        idx = self.holder.index(index)
-        f = idx.field(fname) if idx is not None else None
-        if f is None or f.options.type == FIELD_TYPE_INT:
-            with self._parse_lock:
-                self._fast_plans.pop(key, None)
-            return None
-        view = f.view(VIEW_STANDARD)
-        total = 0
-        if view is not None:
-            frags = view.fragments
-            for s in shards:
-                frag = frags.get(s)
-                if frag is not None:
-                    total += frag.row_count(row)
-        return QueryResponse([total])
+            return None, parsed
+        if not shards:  # same default as _execute: every available shard
+            epoch = self.holder.shard_epoch(index)
+            cached = self._fast_shards.get(index)
+            if cached is not None and cached[0] == epoch:
+                shards = cached[1]
+            else:
+                idx = self.holder.index(index)
+                if idx is None:
+                    return None, parsed
+                shards = [int(s) for s in idx.available_shards()]
+                self._fast_shards[index] = (epoch, shards)
+        total = self._count_from_cardinalities(index, plan, shards)
+        if total is None:
+            return None, parsed
+        return QueryResponse([total]), parsed
 
     def _execute_outer(self, index, query, shards, opt):
         if not index:
@@ -529,6 +539,15 @@ class Executor:
         if ids is not None and not isinstance(ids, list):
             raise Error("ids must be a list")
 
+    @staticmethod
+    def _field_arg(c: Call) -> str:
+        """field=row argument with the reference's error shape
+        (executor.go wraps pql.Call.FieldArg errors per call)."""
+        try:
+            return c.field_arg()
+        except ValueError:
+            raise Error(f"{c.name}() argument required: field") from None
+
     # -- map/reduce over shards (executor.go mapReduce :2183) --------------
 
     def map_reduce(self, index, shards, call, opt, map_fn, reduce_fn):
@@ -615,7 +634,7 @@ class Executor:
             else:
                 idx = self.holder.index(index)
                 if idx is not None:
-                    field_name = c.field_arg()
+                    field_name = self._field_arg(c)
                     fld = idx.field(field_name)
                     if fld is not None and fld.row_attr_store is not None:
                         row_id, ok = c.uint_arg(field_name)
@@ -647,7 +666,7 @@ class Executor:
         idx = self.holder.index(index)
         if idx is None:
             raise IndexNotFoundError(index)
-        field_name = c.field_arg()
+        field_name = self._field_arg(c)
         f = idx.field(field_name)
         if f is None:
             raise FieldNotFoundError(field_name)
@@ -694,7 +713,7 @@ class Executor:
         if c.has_condition_arg():
             return self._execute_bsi_range_shard(index, c, shard)
 
-        field_name = c.field_arg()
+        field_name = self._field_arg(c)
         idx = self.holder.index(index)
         if idx is None:
             raise IndexNotFoundError(index)
@@ -877,9 +896,13 @@ class Executor:
             local = set(self._local_shards(index, shards))
             if any(s not in local for s in shards):
                 return None
+        view = f.view(VIEW_STANDARD)
+        if view is None:
+            return 0
+        frags = view.fragments  # resolve once, not per shard
         total = 0
         for s in shards:
-            frag = self.holder.fragment(index, field_name, VIEW_STANDARD, s)
+            frag = frags.get(s)
             if frag is not None:
                 total += frag.row_count(row_val)
         return total
@@ -1464,7 +1487,7 @@ class Executor:
         col_id, ok = c.uint_arg("_col")
         if not ok:
             raise Error("Set() column argument 'col' required")
-        field_name = c.field_arg()
+        field_name = self._field_arg(c)
         idx = self.holder.index(index)
         if idx is None:
             raise IndexNotFoundError(index)
@@ -1501,7 +1524,7 @@ class Executor:
         )
 
     def _execute_clear_bit(self, index, c: Call, opt) -> bool:
-        field_name = c.field_arg()
+        field_name = self._field_arg(c)
         idx = self.holder.index(index)
         if idx is None:
             raise IndexNotFoundError(index)
@@ -1550,7 +1573,7 @@ class Executor:
             self.cluster.client(node).query(index, str(c), remote=True)
 
     def _execute_clear_row(self, index, c: Call, shards, opt) -> bool:
-        field_name = c.field_arg()
+        field_name = self._field_arg(c)
         f = self.holder_field(index, field_name)
         if f.options.type not in (
             FIELD_TYPE_SET,
@@ -1580,7 +1603,7 @@ class Executor:
         )
 
     def _execute_set_row(self, index, c: Call, shards, opt) -> bool:
-        field_name = c.field_arg()
+        field_name = self._field_arg(c)
         f = self.holder_field(index, field_name)
         if f.options.type != FIELD_TYPE_SET:
             raise Error(

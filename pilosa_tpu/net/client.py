@@ -209,7 +209,14 @@ class InternalClient:
     # -- cluster -----------------------------------------------------------
 
     def send_message(self, msg: dict):
-        self._post("/internal/cluster/message", msg)
+        """Cluster control-plane message as [1-byte type][protobuf]
+        (broadcast.go:75-83 + internal/private.proto via net.privproto)."""
+        from . import privproto
+
+        self._post(
+            "/internal/cluster/message",
+            body=privproto.marshal_cluster_message(msg),
+        )
 
     def nodes(self) -> list:
         return self._get("/internal/nodes")

@@ -66,6 +66,8 @@ class Handler:
         r("POST", "/cluster/resize/remove-node", self._remove_node)
         r("POST", "/cluster/resize/set-coordinator", self._set_coordinator)
         r("GET", "/debug/vars", self._debug_vars)
+        r("GET", "/debug/pprof", self._debug_pprof)
+        r("POST", "/debug/pprof/trace", self._debug_pprof_trace)
         # Internal routes (http/handler.go:262-272).
         r("POST", "/internal/cluster/message", self._cluster_message)
         r("GET", "/internal/fragment/blocks", self._fragment_blocks)
@@ -88,6 +90,18 @@ class Handler:
         r("POST", "/internal/translate/keys", self._translate_keys)
         r("POST", "/internal/fragment/data", self._post_fragment_data)
         r("GET", "/internal/fragment/data", self._get_fragment_data)
+        r("POST", "/internal/mesh/count", self._mesh_count)
+
+    def _mesh_count(self, q, body, **kw):
+        """Accept a collective dispatch from a multi-host peer: validate,
+        enqueue for the replay worker, answer immediately — the worker
+        enters the same shard_map so the initiator's psum can rendezvous
+        (parallel/multihost.py SPMD serving)."""
+        doc = json.loads(body)
+        self.api.mesh_collective_accept(
+            doc["index"], doc["query"], doc.get("shards")
+        )
+        return {"accepted": True}
 
     def _route(self, method, pattern, fn):
         self.routes.append(Route(method, pattern, fn))
@@ -346,8 +360,55 @@ class Handler:
             return stats.snapshot()
         return {}
 
+    def _debug_pprof(self, q, b, **kw):
+        """/debug/pprof equivalent (http/handler.go:241): a full thread
+        stack dump — the Python analogue of goroutine profiles."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        threads = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for ident, frame in frames.items():
+            out[threads.get(ident, str(ident))] = traceback.format_stack(frame)
+        return {"threads": out, "count": len(out)}
+
+    _pprof_trace_lock = threading.Lock()
+
+    def _debug_pprof_trace(self, q, b, **kw):
+        """Start/stop a jax.profiler trace (the device-side profile the
+        reference's CPU pprof cannot see).  ?seconds=N (capped at 10)
+        captures a bounded trace into ?dir= (default: a fresh temp dir).
+        Concurrent captures are rejected instead of crashing the
+        profiler."""
+        import tempfile
+        import time as time_mod
+
+        import jax
+
+        seconds = min(float(q.get("seconds", ["1"])[0]), 10.0)
+        dirs = q.get("dir")
+        trace_dir = dirs[0] if dirs else tempfile.mkdtemp(prefix="pilosa-xprof-")
+        if not Handler._pprof_trace_lock.acquire(blocking=False):
+            raise ValueError("a profiler trace is already running")
+        try:
+            jax.profiler.start_trace(trace_dir)
+            time_mod.sleep(seconds)
+            jax.profiler.stop_trace()
+        finally:
+            Handler._pprof_trace_lock.release()
+        return {"traceDir": trace_dir, "seconds": seconds}
+
     def _cluster_message(self, q, b, **kw):
-        self.api.cluster_message(json.loads(b))
+        """POST /internal/cluster/message: [1-byte type][protobuf] frames
+        (type bytes 0-15, broadcast.go:55-73); legacy JSON bodies (first
+        byte '{') still accepted."""
+        from . import privproto
+
+        if b and b[0] <= 31:
+            self.api.cluster_message(privproto.unmarshal_cluster_message(b))
+        else:
+            self.api.cluster_message(json.loads(b))
         return {}
 
     def _fragment_blocks(self, q, b, **kw):

@@ -27,6 +27,7 @@ explicit HBM residency manager.
 from __future__ import annotations
 
 import functools
+import threading
 import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -41,11 +42,14 @@ from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
 from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
 from . import kernels
-from .mesh import SHARD_AXIS, pad_shards, replicated_sharding, shard_sharding
+from .mesh import SHARD_AXIS, pad_shards, put_global
 
 
 class _FieldStack:
-    """Device-resident uint32[S, R, WORDS] for one (index, field, view)."""
+    """Device-resident uint32[R, S, WORDS] for one (index, field, view) —
+    rows MAJOR (P(None, SHARD_AXIS)) so per-query row slices are
+    contiguous per-device HBM blocks (middle-axis slicing measured ~7x
+    slower on v5e: 95 vs 705 GB/s effective)."""
 
     __slots__ = ("matrix", "row_index", "versions", "shards", "pos")
 
@@ -94,7 +98,7 @@ class _Lowering:
         if i is None:
             i = len(self.operands)
             self.operands.append(mat)
-            self.specs.append(P(SHARD_AXIS))
+            self.specs.append(P(None, SHARD_AXIS))
             self._mat_ids[key] = i
         return i
 
@@ -132,6 +136,23 @@ class MeshEngine:
         # candidate union + per-shard row-count matrix backing the fused
         # TopN program, rebuilt when the field stack's token changes.
         self._topn_cands: Dict[Tuple[str, str], tuple] = {}
+        # Multi-host SPMD serving hook (parallel/multihost.py): when the
+        # mesh spans processes, every process must enter the same
+        # dispatch for its collectives to rendezvous.  The server sets
+        # this to a fn(index, call, shards) that SYNCHRONOUSLY hands the
+        # dispatch to every peer server (net route /internal/mesh/count;
+        # peers accept fast and replay on a worker).  ``collective_lock``
+        # serializes this process's collective dispatches so one node's
+        # query stream enters collectives in one order; deployments
+        # should route collective queries through a single entry node —
+        # cross-node concurrent initiation is not globally ordered.
+        self.collective_broadcast = None
+        self.collective_lock = threading.Lock()
+        # Only Count is wired for peer replay; on a multi-process
+        # runtime every other fused path falls back to the per-shard
+        # host path (correct, device-per-fragment) instead of entering
+        # a collective no peer would join.
+        self.multiproc = jax.process_count() > 1
         # Count of fused device dispatches (one per kernel invocation;
         # cluster tests assert it advances when the fused path runs).
         self.fused_dispatches = 0
@@ -141,7 +162,7 @@ class MeshEngine:
         dominant dispatch cost through high-latency transports)."""
         s = self._scalars.get(v)
         if s is None:
-            s = jnp.int32(v)
+            s = put_global(self.mesh, np.int32(v), P())
             self._scalars[v] = s
         return s
 
@@ -151,7 +172,7 @@ class MeshEngine:
         if b is None:
             from ..ops import bsi as bsi_ops
 
-            b = jnp.asarray(bsi_ops.to_bits(value, depth))
+            b = put_global(self.mesh, bsi_ops.to_bits(value, depth), P())
             self._bits[key] = b
         return b
 
@@ -184,7 +205,7 @@ class MeshEngine:
             for i, s in enumerate(canonical):
                 if s in req:
                     host[i, 0] = 0xFFFFFFFF
-            m = jax.device_put(jnp.asarray(host), shard_sharding(self.mesh))
+            m = put_global(self.mesh, host, P(SHARD_AXIS))
             self._masks[key] = m
             while len(self._masks) > 1024:  # tiny buffers, but bounded
                 self._masks.popitem(last=False)
@@ -236,12 +257,12 @@ class MeshEngine:
             row_ids = [0]
         row_index = {r: i for i, r in enumerate(row_ids)}
         S = pad_shards(len(canonical), self.mesh)
-        mat = np.zeros((S, len(row_ids), bitops.WORDS), dtype=np.uint32)
+        mat = np.zeros((len(row_ids), S, bitops.WORDS), dtype=np.uint32)
         for si, f in enumerate(frags):
             if f is None:
                 continue
             for r in f.row_ids():
-                mat[si, row_index[r]] = f.row_words(r)
+                mat[row_index[r], si] = f.row_words(r)
         while (
             self._resident_bytes + self._pending_bytes() + mat.nbytes
             > self.max_resident_bytes
@@ -249,7 +270,7 @@ class MeshEngine:
         ):
             self._evict(next(iter(self._stacks)))
         stack = _FieldStack(
-            jax.device_put(jnp.asarray(mat), shard_sharding(self.mesh)),
+            put_global(self.mesh, mat, P(None, SHARD_AXIS)),
             row_index,
             token,
             list(canonical),
@@ -281,13 +302,14 @@ class MeshEngine:
         return sum(n for _, n in live)
 
     def _zero_stack(self, canonical):
-        """Cached zeros uint32[S, 1, WORDS] used as the empty-leaf operand."""
+        """Cached zeros uint32[1, S, WORDS] used as the empty-leaf operand."""
         S = pad_shards(len(canonical), self.mesh)
         z = self._zeros.get(S)
         if z is None:
-            z = jax.device_put(
-                jnp.zeros((S, 1, bitops.WORDS), dtype=jnp.uint32),
-                shard_sharding(self.mesh),
+            z = put_global(
+                self.mesh,
+                np.zeros((1, S, bitops.WORDS), dtype=np.uint32),
+                P(None, SHARD_AXIS),
             )
             self._zeros[S] = z
         return z
@@ -452,13 +474,28 @@ class MeshEngine:
         """Count(tree): one fused dispatch, one psum."""
         return int(self.count_async(index, c, shards))
 
-    def count_async(self, index: str, c: Call, shards: List[int]):
+    def count_async(
+        self, index: str, c: Call, shards: List[int], broadcast: bool = True
+    ):
         """Count(tree) returning the device scalar without host sync —
         callers pipeline query streams and fetch results in one transfer
-        (the async analogue of mapReduce's result channel)."""
+        (the async analogue of mapReduce's result channel).  On a
+        multi-host mesh the dispatch is replayed on peer servers so the
+        psum rendezvous completes; ``broadcast=False`` marks a replay
+        (peers must not re-broadcast back)."""
         canonical = self.canonical_shards(index)
         if not canonical:
             return jnp.int32(0)
+        if broadcast and self.collective_broadcast is not None:
+            # Lock covers handoff + dispatch so this node's collectives
+            # enqueue in one order everywhere; a peer that cannot accept
+            # raises HERE, before anything blocks in the psum.
+            with self.collective_lock:
+                self.collective_broadcast(index, c, shards)
+                return self._dispatch_count(index, c, shards, canonical)
+        return self._dispatch_count(index, c, shards, canonical)
+
+    def _dispatch_count(self, index, c, shards, canonical):
         lw = _Lowering(self, canonical)
         prog = self._lower(index, c, lw)
         mask = self._mask_words(shards, canonical)
@@ -478,6 +515,8 @@ class MeshEngine:
         out over the canonical shard axis; returns (stack, canonical).
         Pass ``canonical`` when the result joins other operands of one
         dispatch (shared shard-axis snapshot)."""
+        if self.multiproc:
+            return None, []
         if canonical is None:
             canonical = self.canonical_shards(index)
         if not canonical:
@@ -514,38 +553,89 @@ class MeshEngine:
             return ("ones",)
         return self._lower(index, filter_call, lw)
 
-    def sum(self, index: str, field_name: str, filter_call: Optional[Call], shards):
-        """BSI Sum over the mesh (returns the ValCount parts: total,
-        count) — ONE fused dispatch incl. the plane slice and the filter
-        tree."""
+    def sum_async(
+        self, index: str, field_name: str, filter_call: Optional[Call], shards
+    ):
+        """BSI Sum dispatch with the result left on device: returns
+        ((counts, n) device arrays, depth, bsig) or None.  Callers
+        pipeline query streams; ``sum`` is the one-readback wrapper."""
+        if self.multiproc:
+            return None  # no peer replay for Sum yet (see collective_broadcast)
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx is not None else None
         bsig = f.bsi_group(field_name) if f is not None else None
         if bsig is None:
-            return 0, 0
+            return None
         depth = bsig.bit_depth()
         stack = self.field_stack(index, field_name, view_bsi_name(field_name))
         if stack is None:
-            return 0, 0
+            return None
         canonical = stack.shards
         lw = _Lowering(self, canonical)
         prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
         self.fused_dispatches += 1
-        counts, n = jax.device_get(
-            kernels.sum_tree(
-                self.mesh,
-                prog,
-                tuple(lw.specs),
-                self._plane_spec(stack, depth),
-                mask,
-                stack.matrix,
-                *lw.operands,
-            )
+        dev = kernels.sum_tree(
+            self.mesh,
+            prog,
+            tuple(lw.specs),
+            self._plane_spec(stack, depth),
+            mask,
+            stack.matrix,
+            *lw.operands,
         )
+        return dev, depth, bsig
+
+    def sum(self, index: str, field_name: str, filter_call: Optional[Call], shards):
+        """BSI Sum over the mesh (returns the ValCount parts: total,
+        count) — ONE fused dispatch incl. the plane slice and the filter
+        tree, ONE readback."""
+        res = self.sum_async(index, field_name, filter_call, shards)
+        if res is None:
+            return 0, 0
+        dev, depth, bsig = res
+        counts, n = jax.device_get(dev)
         total = sum(int(counts[i]) << i for i in range(depth))
         n = int(n)
         return total + n * bsig.min, n
+
+    def min_max_async(
+        self,
+        index: str,
+        field_name: str,
+        filter_call: Optional[Call],
+        shards,
+        is_min: bool,
+    ):
+        """BSI Min/Max dispatch with the (flags, counts) result left on
+        device: returns (dev, canonical, depth, bsig) or None."""
+        if self.multiproc:
+            return None
+        idx = self.holder.index(index)
+        f = idx.field(field_name) if idx is not None else None
+        bsig = f.bsi_group(field_name) if f is not None else None
+        if bsig is None:
+            return None
+        depth = bsig.bit_depth()
+        stack = self.field_stack(index, field_name, view_bsi_name(field_name))
+        if stack is None:
+            return None
+        canonical = stack.shards
+        lw = _Lowering(self, canonical)
+        prog = self._lower_filter(index, filter_call, lw)
+        mask = self._mask_words(shards, canonical)
+        self.fused_dispatches += 1
+        dev = kernels.minmax_tree(
+            self.mesh,
+            prog,
+            tuple(lw.specs),
+            self._plane_spec(stack, depth),
+            is_min,
+            mask,
+            stack.matrix,
+            *lw.operands,
+        )
+        return dev, canonical, depth, bsig
 
     def min_max(
         self,
@@ -558,34 +648,11 @@ class MeshEngine:
         """BSI Min/Max: per-shard plane walks in one dispatch, host reduce
         (fragment.go min/max :745-806 + ValCount.smaller/larger).  Returns
         (value, count) or (0, 0)."""
-        from . import kernels
-
-        idx = self.holder.index(index)
-        f = idx.field(field_name) if idx is not None else None
-        bsig = f.bsi_group(field_name) if f is not None else None
-        if bsig is None:
+        res = self.min_max_async(index, field_name, filter_call, shards, is_min)
+        if res is None:
             return 0, 0
-        depth = bsig.bit_depth()
-        stack = self.field_stack(index, field_name, view_bsi_name(field_name))
-        if stack is None:
-            return 0, 0
-        canonical = stack.shards
-        lw = _Lowering(self, canonical)
-        prog = self._lower_filter(index, filter_call, lw)
-        mask = self._mask_words(shards, canonical)
-        self.fused_dispatches += 1
-        flags, counts = jax.device_get(
-            kernels.minmax_tree(
-                self.mesh,
-                prog,
-                tuple(lw.specs),
-                self._plane_spec(stack, depth),
-                is_min,
-                mask,
-                stack.matrix,
-                *lw.operands,
-            )
-        )
+        dev, canonical, depth, bsig = res
+        flags, counts = jax.device_get(dev)
         # Reduce like ValCount.smaller/larger (executor.go:2652-2696):
         # strictly-better value wins; ties keep the first shard's count.
         # The mask zeroed non-requested shards' filters, so their counts
@@ -609,6 +676,8 @@ class MeshEngine:
         dispatch pair: (scores int32[S, K], src_counts int32[S],
         shard_pos).  ``shard_pos`` maps shard -> row of the canonical axis;
         candidates absent from the row table score 0."""
+        if self.multiproc:
+            return None
         from . import kernels
 
         stack = self.field_stack(index, field, VIEW_STANDARD)
@@ -617,9 +686,13 @@ class MeshEngine:
         present = np.asarray(
             [r in stack.row_index for r in candidate_rows], dtype=bool
         )
-        idxs = jnp.asarray(
-            [stack.row_index.get(r, 0) for r in candidate_rows],
-            dtype=jnp.int32,
+        idxs = put_global(
+            self.mesh,
+            np.asarray(
+                [stack.row_index.get(r, 0) for r in candidate_rows],
+                dtype=np.int32,
+            ),
+            P(),
         )
         lw = _Lowering(self, stack.shards)
         prog = self._lower(index, src_call, lw)
@@ -636,9 +709,10 @@ class MeshEngine:
         )
         # ONE host transfer for both results (each sync readback pays a
         # full relay RTT through the tunnel); np.array copy because
-        # device-array views are read-only host buffers.
+        # device-array views are read-only host buffers.  The kernel's
+        # score matrix is rows-major [K, S]; callers consume [S, K].
         scores, src_counts = jax.device_get((dev_scores, dev_counts))
-        scores = np.array(scores)
+        scores = np.array(scores).T
         scores[:, ~present] = 0
         return scores, src_counts, dict(stack.pos)
 
@@ -652,7 +726,7 @@ class MeshEngine:
         """Assemble the id-descending candidate arrays for a stack."""
         from ..core.view import VIEW_STANDARD as _STD
 
-        S = stack.matrix.shape[0]
+        S = stack.matrix.shape[1]
         K = len(cands)
         K_pad = max(8, 1 << (K - 1).bit_length()) if K else 8
         host_cnt = np.zeros((S, K_pad), dtype=np.int32)
@@ -667,8 +741,10 @@ class MeshEngine:
             idxs[ki] = stack.row_index.get(r, 0)
         return _TopNCandidates(
             list(cands),
-            jnp.asarray(idxs),
-            jax.device_put(jnp.asarray(host_cnt), shard_sharding(self.mesh)),
+            put_global(self.mesh, idxs, P()),
+            # Device twin is [K_pad, S] to line up with the kernel's
+            # rows-major score matrix.
+            put_global(self.mesh, host_cnt.T.copy(), P(None, SHARD_AXIS)),
             host_cnt,
         )
 
@@ -709,6 +785,8 @@ class MeshEngine:
         (candidates, n_out, device result) with the result left on
         device for pipelining, or None when the fused path doesn't
         apply (candidate union too large)."""
+        if self.multiproc:
+            return None  # fall back to the host two-phase path
         stack = self.field_stack(index, field, VIEW_STANDARD)
         if stack is None:
             return [], None, None
@@ -825,7 +903,7 @@ class MeshEngine:
             pairs = pairs[: int(n)]
         return pairs
 
-    def group_counts(
+    def group_counts_async(
         self,
         index: str,
         fields: List[str],
@@ -833,11 +911,10 @@ class MeshEngine:
         filter_call: Optional[Call],
         shards: List[int],
     ):
-        """Fused GroupBy over 1 or 2 Rows children: every group combination
-        counted in ONE sharded dispatch — row gathers and the filter tree
-        evaluate in-body (BASELINE config #5's 8-way GroupBy+Count shard
-        reduce).  Returns int32[Ka(,Kb)] counts in row-id order, over the
-        requested shard subset only."""
+        """Fused GroupBy dispatch with the int32[Ka(,Kb)] count tensor
+        left on device; returns None when the fused path doesn't apply."""
+        if self.multiproc:
+            return None
         if len(fields) not in (1, 2):
             raise ValueError("fused GroupBy supports 1 or 2 fields")
         canonical = self.canonical_shards(index)
@@ -851,8 +928,13 @@ class MeshEngine:
                 return None
             stacks.append(stack)
             idx_arrays.append(
-                jnp.asarray(
-                    [stack.row_index.get(r, 0) for r in rows], dtype=jnp.int32
+                put_global(
+                    self.mesh,
+                    np.asarray(
+                        [stack.row_index.get(r, 0) for r in rows],
+                        dtype=np.int32,
+                    ),
+                    P(),
                 )
             )
         lw = _Lowering(self, canonical)
@@ -860,30 +942,44 @@ class MeshEngine:
         mask = self._mask_words(shards, canonical)
         self.fused_dispatches += 1
         if len(fields) == 1:
-            return np.asarray(
-                kernels.group1_tree(
-                    self.mesh,
-                    prog,
-                    tuple(lw.specs),
-                    mask,
-                    stacks[0].matrix,
-                    idx_arrays[0],
-                    *lw.operands,
-                )
-            )
-        return np.asarray(
-            kernels.group2_tree(
+            return kernels.group1_tree(
                 self.mesh,
                 prog,
                 tuple(lw.specs),
                 mask,
                 stacks[0].matrix,
                 idx_arrays[0],
-                stacks[1].matrix,
-                idx_arrays[1],
                 *lw.operands,
             )
+        return kernels.group2_tree(
+            self.mesh,
+            prog,
+            tuple(lw.specs),
+            mask,
+            stacks[0].matrix,
+            idx_arrays[0],
+            stacks[1].matrix,
+            idx_arrays[1],
+            *lw.operands,
         )
+
+    def group_counts(
+        self,
+        index: str,
+        fields: List[str],
+        row_lists: List[List[int]],
+        filter_call: Optional[Call],
+        shards: List[int],
+    ):
+        """Fused GroupBy over 1 or 2 Rows children: every group combination
+        counted in ONE sharded dispatch — row gathers and the filter tree
+        evaluate in-body (BASELINE config #5's 8-way GroupBy+Count shard
+        reduce).  Returns int32[Ka(,Kb)] counts in row-id order, over the
+        requested shard subset only."""
+        dev = self.group_counts_async(index, fields, row_lists, filter_call, shards)
+        if dev is None:
+            return None
+        return np.asarray(dev)
 
 
 # Back-compat aliases: the production programs live in kernels.py (one

@@ -47,6 +47,21 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def put_global(mesh: Mesh, arr, spec: PartitionSpec):
+    """Place a host array on the mesh with ``spec``.  Single-process this
+    is a plain sharded device_put; in a multi-process runtime
+    (jax.distributed) it assembles a GLOBAL array where each process
+    contributes only the blocks its addressable devices own — the only
+    legal way to build shard_map operands on a pod."""
+    import jax.numpy as jnp
+
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(arr), sh)
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(host.shape, sh, lambda idx: host[idx])
+
+
 def pad_shards(n_shards: int, mesh: Mesh) -> int:
     """Shard count padded up to a multiple of the mesh size."""
     n_dev = mesh.devices.size

@@ -50,29 +50,23 @@ def global_mesh(n_devices: Optional[int] = None):
 
 
 def global_stack(mesh, host_array):
-    """Assemble a shard-axis-sharded GLOBAL array in a multi-process
-    runtime: every process holds the full host truth (each pilosa node
-    replays the same holder files) and contributes only the blocks its
-    addressable devices own.  Single-process this degrades to a plain
-    sharded device_put."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    """Shard-axis-sharded GLOBAL array (each process contributes the
+    blocks its addressable devices own); thin wrapper over
+    mesh.put_global."""
+    from jax.sharding import PartitionSpec
 
-    from .mesh import SHARD_AXIS
+    from .mesh import SHARD_AXIS, put_global
 
-    sh = NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
-    return jax.make_array_from_callback(
-        host_array.shape, sh, lambda idx: host_array[idx]
-    )
+    return put_global(mesh, host_array, PartitionSpec(SHARD_AXIS))
 
 
 def replicated(mesh, host_array):
     """A fully-replicated global array (per-process identical copies)."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import PartitionSpec
 
-    sh = NamedSharding(mesh, PartitionSpec())
-    return jax.make_array_from_callback(
-        host_array.shape, sh, lambda idx: host_array[idx]
-    )
+    from .mesh import put_global
+
+    return put_global(mesh, host_array, PartitionSpec())
 
 
 def local_device_count() -> int:

@@ -9,6 +9,7 @@ server.go:334-428) and spawns the anti-entropy / metrics loops.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -97,6 +98,26 @@ class Server:
         host, port = self.config.bind_host_port()
         if port_override is not None:
             port = port_override
+        # jax.distributed must come up before ANY device touch (holder
+        # open may place fragments) — the analogue of setupNetworking
+        # preceding holder.Open (server/server.go:302-331, server.go:334).
+        if self.config.jax_coordinator:
+            from .parallel import multihost
+
+            multihost.initialize(
+                coordinator_address=self.config.jax_coordinator,
+                num_processes=self.config.jax_num_processes or None,
+                process_id=(
+                    self.config.jax_process_id
+                    if self.config.jax_num_processes
+                    else None
+                ),
+            )
+            self.logger.printf(
+                "jax.distributed up: process %d/%d",
+                multihost.process_index(),
+                multihost.process_count(),
+            )
         self.translate_store.open()
         self._setup_cluster(host, port)
         self.holder.open()
@@ -125,17 +146,64 @@ class Server:
 
     def _make_mesh_engine(self):
         """Fused device query path over the local mesh (parallel package);
-        None when no usable devices (the per-shard path still works)."""
+        None when no usable devices (the per-shard path still works).
+
+        With ``--jax-coordinator`` the JAX distributed runtime is
+        initialized FIRST (the analogue of setupNetworking,
+        server/server.go:302-331) so the mesh spans every host's devices;
+        collective dispatches are then replayed on the configured peer
+        servers so the psum can rendezvous (SPMD serving)."""
         if self.config.mesh_devices < 0:
             return None
         try:
             from .parallel import MeshEngine, make_mesh
 
             mesh = make_mesh(self.config.mesh_devices or None)
-            return MeshEngine(self.holder, mesh)
+            engine = MeshEngine(self.holder, mesh)
+            if self.config.mesh_peers:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._mesh_pool = ThreadPoolExecutor(
+                    max_workers=max(4, len(self.config.mesh_peers)),
+                    thread_name_prefix="mesh-peer",
+                )
+                engine.collective_broadcast = self._broadcast_dispatch
+            return engine
         except Exception as e:
             self.logger.printf("mesh engine unavailable: %s", e)
             return None
+
+    def _broadcast_dispatch(self, index, call, shards):
+        """Synchronously hand a collective dispatch to every peer server.
+        Peers validate + enqueue and answer in one RTT (the replay runs
+        on their worker thread), so waiting here is cheap — and a peer
+        that is down or rejects the dispatch raises NOW, failing the
+        query fast instead of leaving this process blocked forever in a
+        psum no peer will join."""
+        import urllib.request
+
+        body = json.dumps(
+            {"index": index, "query": str(call), "shards": list(shards)}
+        ).encode()
+
+        def post(url):
+            req = urllib.request.Request(
+                f"{url}/internal/mesh/count", data=body, method="POST"
+            )
+            req.add_header("Content-Type", "application/json")
+            urllib.request.urlopen(req, timeout=30).read()
+
+        futures = [
+            self._mesh_pool.submit(post, url) for url in self.config.mesh_peers
+        ]
+        errs = []
+        for url, f in zip(self.config.mesh_peers, futures):
+            try:
+                f.result(timeout=35)
+            except Exception as e:
+                errs.append(f"{url}: {e}")
+        if errs:
+            raise RuntimeError(f"mesh peers unavailable: {'; '.join(errs)}")
 
     def _setup_cluster(self, host: str, port: int):
         """Wire the cluster when hosts or gossip seeds are configured

