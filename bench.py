@@ -101,14 +101,18 @@ def emit_raw(metric, value, unit, vs_baseline):
     )
 
 
-def engine_p50(fn, k1, k2, rounds=3):
+def engine_p50(fn, k1, k2, rounds=4, min_per=0.0):
     """Marginal per-query device time in a pipelined stream: dispatch k
     queries, fetch ALL results with one device_get, and take the slope
     between k1 and k2.  The axon relay's block_until_ready returns
     before execution (see module docstring), and the fixed readback RTT
     is identical for both batch sizes, so the slope is the honest
     engine time.  ``fn(i)`` receives the rep index so every rep is a
-    DIFFERENT query.  Returns (seconds_per_query, k1-batch values)."""
+    DIFFERENT query.  Relay RTT variance can corrupt a slope whose
+    device-time delta it rivals, so callers pass ``min_per`` — the
+    bytes-derived physical floor — and a violating sample is re-taken
+    (the audit at the end still hard-fails if it never converges).
+    Returns (seconds_per_query, k1-batch values)."""
     import jax
 
     def run(k):
@@ -117,10 +121,21 @@ def engine_p50(fn, k1, k2, rounds=3):
         return time.perf_counter() - t0, vals
 
     run(2)  # warm: compile + readback channel
-    t1, values = min((run(k1) for _ in range(rounds)), key=lambda r: r[0])
-    t2, _ = min((run(k2) for _ in range(rounds)), key=lambda r: r[0])
-    per = (t2 - t1) / (k2 - k1)
-    return max(per, 1e-9), values
+    per, values = 0.0, None
+    for _attempt in range(3):
+        t1, values = min((run(k1) for _ in range(rounds)), key=lambda r: r[0])
+        t2, _ = min((run(k2) for _ in range(rounds)), key=lambda r: r[0])
+        per = max((t2 - t1) / (k2 - k1), 1e-9)
+        if per >= min_per:
+            break
+        progress(f"  resampling: slope {per * 1e6:.1f} us/q below physical floor")
+    return per, values
+
+
+def floor_per_query(nbytes):
+    """Fastest possible per-query seconds for a program that must read
+    ``nbytes`` from HBM (spec bandwidth + audit slack)."""
+    return nbytes / (V5E_HBM_SPEC_GBS * 1.25 * 1e9)
 
 
 def sync_p50(fn, reps=8):
@@ -183,7 +198,10 @@ def main():
     stream_fn = jax.jit(
         lambda x: jax.lax.population_count(x).astype(jnp.uint32).sum()
     )
-    t_bw, _ = engine_p50(lambda i: stream_fn(streams[i % 3]), 3, 12)
+    t_bw, _ = engine_p50(
+        lambda i: stream_fn(streams[i % 3]), 3, 12,
+        min_per=floor_per_query(1 << 30),
+    )
     hbm_gbs = streams[0].nbytes / t_bw / 1e9
     del streams
     progress(f"measured HBM read bandwidth: {hbm_gbs:.0f} GB/s")
@@ -269,7 +287,8 @@ def main():
     progress("north-star warm done")
     t_ns, r_ns_all = engine_p50(
         lambda i: eng.count_async("bench", ns_calls[i % len(ns_calls)], shards),
-        12, 60,
+        12, 132,
+        min_per=floor_per_query(2 * N_SHARDS * ROW_BYTES),
     )
     progress("north-star timed")
 
@@ -284,7 +303,8 @@ def main():
     jax.device_get(eng.count_async("b10m", c2_calls[0], shards10))
     t_c2, r_c2_all = engine_p50(
         lambda i: eng.count_async("b10m", c2_calls[i % len(c2_calls)], shards10),
-        10, 110,
+        10, 210,
+        min_per=floor_per_query(4 * N_SHARDS_10M * ROW_BYTES),
     )
     progress("config2 timed")
 
@@ -295,7 +315,8 @@ def main():
     ]
     jax.device_get(eng.count_async("bench", c4_calls[0], shards))
     t_c4, r_c4_all = engine_p50(
-        lambda i: eng.count_async("bench", c4_calls[i % 2], shards), 8, 40
+        lambda i: eng.count_async("bench", c4_calls[i % 2], shards), 8, 104,
+        min_per=floor_per_query(3 * N_SHARDS * ROW_BYTES),
     )
     progress("config4 timed")
 
@@ -307,17 +328,22 @@ def main():
             "bench", "top", topn_srcs[i % len(topn_srcs)], shards, 5, 0
         )[2],
         4, 16,
+        min_per=floor_per_query((TOPN_ROWS + 1) * N_SHARDS * ROW_BYTES),
     )
     progress("topn engine timed")
 
+    bsi_floor = floor_per_query((BSI_DEPTH + 1) * N_SHARDS * ROW_BYTES)
     t_sum_eng, _ = engine_p50(
-        lambda i: eng.sum_async("bench", "v", None, shards)[0], 4, 20
+        lambda i: eng.sum_async("bench", "v", None, shards)[0], 4, 32,
+        min_per=bsi_floor,
     )
     t_min_eng, _ = engine_p50(
-        lambda i: eng.min_max_async("bench", "v", None, shards, True)[0], 4, 20
+        lambda i: eng.min_max_async("bench", "v", None, shards, True)[0], 4, 32,
+        min_per=bsi_floor,
     )
     t_max_eng, _ = engine_p50(
-        lambda i: eng.min_max_async("bench", "v", None, shards, False)[0], 4, 20
+        lambda i: eng.min_max_async("bench", "v", None, shards, False)[0], 4, 32,
+        min_per=bsi_floor,
     )
     progress("sum/min/max engine timed")
 
@@ -326,7 +352,8 @@ def main():
             "bench", ["ga", "gb"], [list(range(GROUPS_A)), list(range(GROUPS_B))],
             None, shards,
         ),
-        4, 20,
+        4, 24,
+        min_per=floor_per_query((GROUPS_A + GROUPS_B) * N_SHARDS * ROW_BYTES),
     )
     progress("groupby engine timed")
 
@@ -334,8 +361,15 @@ def main():
     c1_queries = [f"Count(Row(f={10 + k}))" for k in range(F_ROWS)]
     for q in c1_queries:  # build each query's prepared plan (the lane's
         ex1.execute("b1", q)  # steady state: clients repeat query texts)
-    t_c1, _ = sync_p50(
-        lambda i: ex1.execute("b1", c1_queries[i % F_ROWS]).results[0], reps=24
+    # µs-scale host path: time a 100-call loop per round (a single-call
+    # median is dominated by scheduler jitter on the relay host).
+    t_c1 = min(
+        cpu_time(
+            lambda: [ex1.execute("b1", c1_queries[j % F_ROWS]) for j in range(100)],
+            reps=1,
+        )
+        / 100
+        for _ in range(5)
     )
     r_c1 = ex1.execute("b1", c1_queries[0]).results[0]
     progress("config1 timed")
@@ -553,11 +587,12 @@ def main():
     emit("http_count_e2e_p50", t_http, c_c2)
     emit_raw("http_count_qps", qps, "qps", qps * c_c2)
 
-    # Physics check: nothing may beat the memory system.  Ceiling is the
-    # larger of the measured STREAM number and the chip spec (a relay-
-    # congested measurement may undershoot the chip; nothing can exceed
-    # the spec).
-    ceiling = max(hbm_gbs, V5E_HBM_SPEC_GBS)
+    # Physics check: nothing may beat the memory system.  The ceiling is
+    # the chip SPEC: a relay-congested measurement may undershoot the
+    # chip (must not fail valid metrics), and a noise-inflated
+    # measurement must not raise the bar above physics.  The measured
+    # STREAM number is telemetry.
+    ceiling = V5E_HBM_SPEC_GBS
     ns_bytes = 2 * N_SHARDS * ROW_BYTES
     for metric, seconds, nbytes in PHYSICS + [
         ("count_intersect_1B_cols_p50", t_ns, ns_bytes)

@@ -69,15 +69,18 @@ class _TopNCandidates:
     descending so the device ``top_k``'s lowest-index tie-break equals
     the (-count, -id) pair order.  ``host_cnt`` int32[S, K_pad] holds
     each candidate's true row count per canonical shard (the phase-2
-    ``cnt`` gate); ``dev_cnt``/``dev_idxs`` are its device twins.
-    Padding columns carry count 0 so the threshold gate (>= 1) drops
-    them on device."""
+    ``cnt`` gate); ``dev_cnt`` is its device twin and ``idxs`` the
+    STATIC stack-row index tuple (compile-cache key: candidate sets are
+    stable per field, and identity/reverse layouts lower to slice/rev
+    instead of a gather — kernels.gather_rows).  Padding columns carry
+    count 0 so the threshold gate (>= 1) drops them on device."""
 
-    __slots__ = ("cands", "dev_idxs", "dev_cnt", "host_cnt")
+    __slots__ = ("cands", "idxs", "dyn_idxs", "dev_cnt", "host_cnt")
 
-    def __init__(self, cands, dev_idxs, dev_cnt, host_cnt):
+    def __init__(self, cands, idxs, dyn_idxs, dev_cnt, host_cnt):
         self.cands = cands
-        self.dev_idxs = dev_idxs
+        self.idxs = idxs  # static tuple when gather-free, else None
+        self.dyn_idxs = dyn_idxs  # traced device vector otherwise
         self.dev_cnt = dev_cnt
         self.host_cnt = host_cnt
 
@@ -736,12 +739,23 @@ class MeshEngine:
                 continue
             for ki, r in enumerate(cands):
                 host_cnt[si, ki] = frag.row_count(r)
-        idxs = np.zeros(K_pad, dtype=np.int32)
-        for ki, r in enumerate(cands):
-            idxs[ki] = stack.row_index.get(r, 0)
+        idxs = tuple(stack.row_index.get(r, 0) for r in cands) + (0,) * (
+            K_pad - K
+        )
+        # Gather-free layouts (whole row table) become STATIC compile
+        # keys; arbitrary (cache-subset or client ids=) sets stay traced
+        # so they can never churn the executable cache.
+        if kernels.gather_free(idxs):
+            static_idxs, dyn_idxs = idxs, None
+        else:
+            static_idxs = None
+            dyn_idxs = put_global(
+                self.mesh, np.asarray(idxs, dtype=np.int32), P()
+            )
         return _TopNCandidates(
             list(cands),
-            put_global(self.mesh, idxs, P()),
+            static_idxs,
+            dyn_idxs,
             # Device twin is [K_pad, S] to line up with the kernel's
             # rows-major score matrix.
             put_global(self.mesh, host_cnt.T.copy(), P(None, SHARD_AXIS)),
@@ -796,23 +810,27 @@ class MeshEngine:
         if len(entry.cands) > self.MAX_TOPN_CANDIDATES:
             return None
         # ids= mode and n=0 skip the device trim (never truncate).
+        K_pad = entry.host_cnt.shape[1]
         n_out = None
         if n and not row_ids:
-            n_out = min(int(n), entry.dev_idxs.shape[0])
+            n_out = min(int(n), K_pad)
         lw = _Lowering(self, stack.shards)
         prog = self._lower(index, src_call, lw)
         mask = self._mask_words(shards, stack.shards)
+        extra_ops = () if entry.idxs is not None else (entry.dyn_idxs,)
+        extra_specs = () if entry.idxs is not None else (P(),)
         self.fused_dispatches += 1
         out = kernels.topn_full_tree(
             self.mesh,
             prog,
-            tuple(lw.specs),
+            extra_specs + tuple(lw.specs),
             n_out,
+            entry.idxs,
             mask,
             stack.matrix,
-            entry.dev_idxs,
             entry.dev_cnt,
             self._scalar(max(int(min_threshold), 1)),
+            *extra_ops,
             *lw.operands,
         )
         return entry.cands, n_out, out
@@ -921,45 +939,53 @@ class MeshEngine:
         if not canonical:
             return None
         stacks = []
-        idx_arrays = []
+        statics = []
+        extra_ops = []
         for fname, rows in zip(fields, row_lists):
             stack = self.field_stack(index, fname, VIEW_STANDARD, canonical)
             if stack is None:
                 return None
             stacks.append(stack)
-            idx_arrays.append(
-                put_global(
-                    self.mesh,
-                    np.asarray(
-                        [stack.row_index.get(r, 0) for r in rows],
-                        dtype=np.int32,
-                    ),
-                    P(),
+            t = tuple(stack.row_index.get(r, 0) for r in rows)
+            # Full-row-table (gather-free) lists become static compile
+            # keys; subset lists (shard-restricted queries, child limit/
+            # column args) stay traced — they vary per query and must
+            # not recompile.
+            if kernels.gather_free(t):
+                statics.append(t)
+            else:
+                statics.append(None)
+                extra_ops.append(
+                    put_global(
+                        self.mesh, np.asarray(t, dtype=np.int32), P()
+                    )
                 )
-            )
         lw = _Lowering(self, canonical)
         prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
+        extra_specs = (P(),) * len(extra_ops)
         self.fused_dispatches += 1
         if len(fields) == 1:
             return kernels.group1_tree(
                 self.mesh,
                 prog,
-                tuple(lw.specs),
+                extra_specs + tuple(lw.specs),
+                statics[0],
                 mask,
                 stacks[0].matrix,
-                idx_arrays[0],
+                *extra_ops,
                 *lw.operands,
             )
         return kernels.group2_tree(
             self.mesh,
             prog,
-            tuple(lw.specs),
+            extra_specs + tuple(lw.specs),
+            statics[0],
+            statics[1],
             mask,
             stacks[0].matrix,
-            idx_arrays[0],
             stacks[1].matrix,
-            idx_arrays[1],
+            *extra_ops,
             *lw.operands,
         )
 

@@ -99,6 +99,34 @@ def apply_prog(prog, operands):
     return out
 
 
+def gather_free(idxs) -> bool:
+    """True when a static index tuple needs no gather: identity (slice)
+    or full-reverse (lax.rev).  ONLY such tuples may be jit-static —
+    arbitrary tuples as compile keys would recompile per distinct
+    client-controlled id set and grow the executable cache without
+    bound; those stay traced operands instead."""
+    lst = list(idxs)
+    return lst == list(range(len(lst))) or lst == list(
+        range(len(lst) - 1, -1, -1)
+    )
+
+
+def gather_rows(mat, idxs):
+    """Candidate-row extraction from a rows-major uint32[R, S, W] stack.
+    ``idxs`` is either a gather-free static tuple (identity -> slice,
+    full-reverse -> lax.rev; the ~125 GB/s materialized gather becomes a
+    ~400+ GB/s reindex) or a traced int32[K] vector (jnp.take)."""
+    if isinstance(idxs, tuple):
+        K, R = len(idxs), mat.shape[0]
+        lst = list(idxs)
+        if lst == list(range(K)):
+            return jax.lax.slice_in_dim(mat, 0, K, axis=0)
+        if K == R and lst == list(range(R - 1, -1, -1)):
+            return jax.lax.rev(mat, (0,))
+        raise ValueError("static idxs must be gather-free (see gather_free)")
+    return jnp.take(mat, idxs, axis=0)
+
+
 def _filter(prog, mask, ops):
     """Masked filter row: the evaluated tree & mask, or the bare mask
     (uint32[S, 1], broadcasting) for prog ("ones",)."""
@@ -154,8 +182,8 @@ def topn_tree(mesh, prog, specs, mask, cand_mat, idxs, *operands):
     )(mask, cand_mat, idxs, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
-def topn_full_tree(mesh, prog, specs, n_out, mask, cand_mat, idxs, cnt, thr, *operands):
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def topn_full_tree(mesh, prog, specs, n_out, cand_idxs, mask, cand_mat, cnt, thr, *operands):
     """FULL TopN in ONE dispatch: evaluate the src tree, gather + score
     every cache candidate per shard, apply fragment.top's per-shard
     gates (row-count >= threshold AND score >= threshold, which also
@@ -168,11 +196,22 @@ def topn_full_tree(mesh, prog, specs, n_out, mask, cand_mat, idxs, cnt, thr, *op
     Candidates are ordered id-DESCENDING by the caller so ``top_k``'s
     stable lowest-index tie-break reproduces the (-count, -id) pair
     sort (cache.go bitmapPairs).  ``n_out=None`` skips the trim and
-    returns the full int32[K] totals (the ids= / no-n mode)."""
+    returns the full int32[K] totals (the ids= / no-n mode).
 
-    def body(m, cmat, ix, cn, th, *ops):
-        src = _filter(prog, m, ops)
-        cands = jnp.take(cmat, ix, axis=0)
+    ``cand_idxs`` is a gather-free STATIC tuple when the candidate set
+    is the whole row table (the common case), or None — in which case
+    the FIRST entry of ``operands``/``specs`` is a traced int32[K]
+    index vector (arbitrary, client-controlled candidate sets must not
+    become compile keys)."""
+
+    def body(m, cmat, cn, th, *ops):
+        if cand_idxs is None:
+            ix, *rest = ops
+            cands = gather_rows(cmat, ix)
+        else:
+            rest = ops
+            cands = gather_rows(cmat, cand_idxs)
+        src = _filter(prog, m, tuple(rest))
         scores = jnp.sum(_pc(jnp.bitwise_and(cands, src[None, :, :])), axis=-1)
         gate = jnp.logical_and(cn >= th, scores >= th)
         totals = jax.lax.psum(
@@ -187,10 +226,10 @@ def topn_full_tree(mesh, prog, specs, n_out, mask, cand_mat, idxs, cnt, thr, *op
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P(), P(None, SHARD_AXIS), P())
+        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS), P())
         + specs,
         out_specs=out_specs,
-    )(mask, cand_mat, idxs, cnt, thr, *operands)
+    )(mask, cand_mat, cnt, thr, *operands)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
@@ -240,39 +279,47 @@ def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
     )(mask, plane_mat, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def group1_tree(mesh, prog, specs, mask, mat_a, idxs_a, *operands):
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def group1_tree(mesh, prog, specs, idxs_a, mask, mat_a, *operands):
     """Single-field GroupBy in ONE dispatch -> int32[Ka], replicated."""
 
-    def body(m, ma, ia, *ops):
-        f = _filter(prog, m, ops)
-        a = jnp.bitwise_and(jnp.take(ma, ia, axis=0), f[None, :, :])
+    def body(m, ma, *ops):
+        if idxs_a is None:
+            ia, *rest = ops
+        else:
+            ia, rest = idxs_a, ops
+        a = jnp.bitwise_and(
+            gather_rows(ma, ia), _filter(prog, m, tuple(rest))[None, :, :]
+        )
         return jax.lax.psum(jnp.sum(_pc(a), axis=(1, 2)), SHARD_AXIS)
 
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P()) + specs,
+        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS)) + specs,
         out_specs=P(),
-    )(mask, mat_a, idxs_a, *operands)
+    )(mask, mat_a, *operands)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def group2_tree(mesh, prog, specs, mask, mat_a, idxs_a, mat_b, idxs_b, *operands):
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def group2_tree(mesh, prog, specs, idxs_a, idxs_b, mask, mat_a, mat_b, *operands):
     """Two-field GroupBy in ONE dispatch: all (Ka, Kb) pair intersection
     counts (executeGroupByShard, executor.go:1056, without the host
     iterator) -> int32[Ka, Kb], replicated."""
 
-    def body(m, ma, ia, mb, ib, *ops):
-        f = _filter(prog, m, ops)
-        a = jnp.bitwise_and(jnp.take(ma, ia, axis=0), f[None, :, :])
-        b = jnp.take(mb, ib, axis=0)
+    def body(m, ma, mb, *ops):
+        rest = list(ops)
+        ia = idxs_a if idxs_a is not None else rest.pop(0)
+        ib = idxs_b if idxs_b is not None else rest.pop(0)
+        f = _filter(prog, m, tuple(rest))
+        a = jnp.bitwise_and(gather_rows(ma, ia), f[None, :, :])
+        b = gather_rows(mb, ib)
         inter = jnp.bitwise_and(a[:, None, :, :], b[None, :, :, :])
         return jax.lax.psum(jnp.sum(_pc(inter), axis=(2, 3)), SHARD_AXIS)
 
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P(), P(None, SHARD_AXIS), P()) + specs,
+        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS)) + specs,
         out_specs=P(),
-    )(mask, mat_a, idxs_a, mat_b, idxs_b, *operands)
+    )(mask, mat_a, mat_b, *operands)

@@ -350,3 +350,92 @@ def test_keyed_index_http_end_to_end(tmp_path):
         assert out["results"][0] == 2
     finally:
         cluster.close()
+
+
+# -- TopN cache-fill behavior (executor_test.go TopN_fill :1039-1095) ------
+
+
+def _fresh_ex():
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    return h, f, Executor(h, translator=QueryTranslator(TranslateFile()))
+
+
+def _recalc(f):
+    for v in f.views.values():
+        for frag in v.fragments.values():
+            frag.cache.recalculate()
+
+
+def test_topn_fill():
+    """n=1 must refetch exact counts across ALL shards even when the
+    phase-1 candidate came from one shard's cache (the 'fill')."""
+    h, f, ex = _fresh_ex()
+    ex.execute("i", "".join(
+        f"Set({c}, f={r}) " for r, c in [
+            (0, 0), (0, 1), (0, 2), (0, SHARD_WIDTH),
+            (1, SHARD_WIDTH + 2), (1, SHARD_WIDTH),
+        ]
+    ))
+    _recalc(f)
+    (pairs,) = ex.execute("i", "TopN(f, n=1)").results
+    assert [(p[0], p[1]) for p in pairs] == [(0, 4)]
+
+
+def test_topn_fill_small():
+    """Row 0 spread one-bit-per-shard must still beat locally-dense rows
+    (executor_test.go TopN_fill_small)."""
+    h, f, ex = _fresh_ex()
+    bits = [(0, 0), (0, SHARD_WIDTH), (0, 2 * SHARD_WIDTH),
+            (0, 3 * SHARD_WIDTH), (0, 4 * SHARD_WIDTH),
+            (1, 0), (1, 1),
+            (2, SHARD_WIDTH), (2, SHARD_WIDTH + 1),
+            (3, 2 * SHARD_WIDTH), (3, 2 * SHARD_WIDTH + 1),
+            (4, 3 * SHARD_WIDTH), (4, 3 * SHARD_WIDTH + 1)]
+    ex.execute("i", "".join(f"Set({c}, f={r}) " for r, c in bits))
+    _recalc(f)
+    (pairs,) = ex.execute("i", "TopN(f, n=1)").results
+    assert [(p[0], p[1]) for p in pairs] == [(0, 5)]
+
+
+# -- time-quantum Clear fanout (executor_test.go Time_Clear_Quantums) ------
+
+
+@pytest.mark.parametrize("quantum,expected", [
+    ("Y", [3, 4, 5, 6]),
+    ("M", [3, 4, 5, 6]),
+    ("D", [3, 4, 5, 6]),
+    ("H", [3, 4, 5, 6, 7]),
+    ("YM", [3, 4, 5, 6]),
+    ("YMD", [3, 4, 5, 6]),
+    ("YMDH", [3, 4, 5, 6, 7]),
+    ("MD", [3, 4, 5, 6]),
+    ("MDH", [3, 4, 5, 6, 7]),
+    ("DH", [3, 4, 5, 6, 7]),
+])
+def test_time_clear_quantums(quantum, expected):
+    """Clear must remove the column from EVERY time view the quantum
+    fanned the writes into (executor_test.go:1981-2040 exact table)."""
+    h = Holder()
+    h.open()
+    idx = h.create_index(quantum.lower())
+    idx.create_field("f", FieldOptions(type="time", time_quantum=quantum))
+    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
+    ex.execute(quantum.lower(), """
+        Set(2, f=1, 1999-12-31T00:00)
+        Set(3, f=1, 2000-01-01T00:00)
+        Set(4, f=1, 2000-01-02T00:00)
+        Set(5, f=1, 2000-02-01T00:00)
+        Set(6, f=1, 2001-01-01T00:00)
+        Set(7, f=1, 2002-01-01T02:00)
+        Set(2, f=1, 1999-12-30T00:00)
+        Set(2, f=1, 2002-02-01T00:00)
+        Set(2, f=10, 2001-01-01T00:00)
+    """)
+    ex.execute(quantum.lower(), "Clear(2, f=1)")
+    (row,) = ex.execute(
+        quantum.lower(), "Range(f=1, 1999-12-31T00:00, 2002-01-01T03:00)"
+    ).results
+    assert row.columns().tolist() == expected
