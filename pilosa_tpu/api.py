@@ -694,23 +694,59 @@ class API:
     def get_translate_data(self, offset: int) -> bytes:
         return self.translate_store.reader(offset)
 
-    def mesh_collective_accept(self, index: str, query: str, shards=None):
-        """Accept a multi-host collective Count dispatch from a peer
-        (route /internal/mesh/count): validate NOW (so a bad dispatch
+    def mesh_collective_accept(self, payload: dict):
+        """Accept a multi-host collective dispatch descriptor from a peer
+        (route /internal/mesh/dispatch): validate NOW (so a bad dispatch
         fails the initiator's synchronous handoff with a 400 instead of
         hanging its psum), then replay on the worker thread —
         deterministic lowering over identical holder state yields the
         identical program, so the cross-process rendezvous completes
-        (parallel/multihost.py)."""
+        (parallel/multihost.py).  Kinds mirror the engine's fused paths:
+        count / sum / minmax / topn / topn_scores / group."""
         if self.mesh_engine is None:
             raise ApiError("mesh engine not available")
         from . import pql as pql_mod
 
-        q = pql_mod.parse(query)
-        if len(q.calls) != 1:
-            raise ApiError("collective dispatch carries exactly one call")
-        if self.holder.index(index) is None:
-            raise NotFoundError(f"index not found: {index}")
+        kind = payload.get("kind")
+        required = {
+            "count": ("query",),
+            "sum": ("field",),
+            "minmax": ("field", "isMin"),
+            "topn": ("field", "src", "n", "minThreshold", "cands"),
+            "topn_scores": ("field", "rows", "src"),
+            "group": ("fields", "rows"),
+        }.get(kind)
+        if required is None:
+            raise ApiError(f"unknown collective kind: {kind}")
+        missing = [k for k in required if k not in payload]
+        if missing:
+            raise ApiError(f"collective {kind} missing: {missing}")
+        idx = self.holder.index(payload.get("index", ""))
+        if idx is None:
+            raise NotFoundError(f"index not found: {payload.get('index')}")
+        # Field existence/type checks: a replay that silently declines to
+        # dispatch (e.g. unknown field -> None) would strand the
+        # initiator's collective, so reject at accept time.
+        for fname in (
+            [payload["field"]] if "field" in payload else payload.get("fields", [])
+        ):
+            f = idx.field(fname)
+            if f is None:
+                raise NotFoundError(f"field not found: {fname}")
+            if kind in ("sum", "minmax") and f.bsi_group(fname) is None:
+                raise ApiError(f"field is not BSI: {fname}")
+        # Parse every call text ONCE up front: a syntax error must
+        # surface to the initiator as a 400, not strand its collective;
+        # the parsed calls ride the queue so the worker doesn't re-parse.
+        payload = dict(payload)
+        payload["_calls"] = {}
+        for key in ("query", "src", "filter"):
+            text = payload.get(key)
+            if text:
+                q = pql_mod.parse(text)
+                if len(q.calls) != 1:
+                    raise ApiError("collective dispatch carries exactly one call")
+                payload["_calls"][key] = q.calls[0]
         with self._mesh_replay_lock:
             if self._mesh_replay_q is None:
                 import queue as queue_mod
@@ -721,7 +757,7 @@ class API:
                     name="mesh-replay",
                 )
                 t.start()
-        self._mesh_replay_q.put((index, q.calls[0], shards))
+        self._mesh_replay_q.put(payload)
         return True
 
     def _mesh_replay_loop(self):
@@ -731,21 +767,71 @@ class API:
         import jax
 
         while True:
-            index, call, shards = self._mesh_replay_q.get()
+            payload = self._mesh_replay_q.get()
             try:
-                if shards is None:
-                    idx = self.holder.index(index)
-                    shards = (
-                        [int(s) for s in idx.available_shards()] if idx else []
-                    )
                 with self.mesh_engine.collective_lock:
-                    jax.device_get(
-                        self.mesh_engine.count_async(
-                            index, call, shards, broadcast=False
-                        )
+                    dev = self._mesh_replay_dispatch(payload)
+                if dev is not None:
+                    jax.device_get(dev)
+                else:
+                    # The initiator dispatched and is blocked in its
+                    # collective; a declined replay strands it.  Accept-
+                    # time validation makes this unreachable for known
+                    # schema; scream if it happens anyway.
+                    self.logger.printf(
+                        "mesh replay DID NOT DISPATCH (initiator may hang): %r",
+                        {k: v for k, v in payload.items() if k != "_calls"},
                     )
             except Exception as e:
                 self.logger.printf("mesh replay failed: %s", e)
+
+    def _mesh_replay_dispatch(self, payload: dict):
+        """Enter the same fused dispatch the initiator described; returns
+        the device result (or None when nothing dispatched)."""
+        eng = self.mesh_engine
+        kind = payload["kind"]
+        index = payload["index"]
+        shards = payload.get("shards")
+        if shards is None:
+            idx = self.holder.index(index)
+            shards = [int(s) for s in idx.available_shards()] if idx else []
+
+        def call_of(key):
+            return payload["_calls"].get(key)  # parsed at accept time
+
+        if kind == "count":
+            return eng.count_async(index, call_of("query"), shards, broadcast=False)
+        if kind == "sum":
+            res = eng.sum_async(
+                index, payload["field"], call_of("filter"), shards, broadcast=False
+            )
+            return None if res is None else res[0]
+        if kind == "minmax":
+            res = eng.min_max_async(
+                index, payload["field"], call_of("filter"), shards,
+                payload["isMin"], broadcast=False,
+            )
+            return None if res is None else res[0]
+        if kind == "topn":
+            res = eng.topn_full_async(
+                index, payload["field"], call_of("src"), shards,
+                payload["n"], payload["minThreshold"],
+                row_ids=payload.get("rowIds"), broadcast=False,
+                replay_cands=payload["cands"],
+            )
+            return None if res is None else res[2]
+        if kind == "topn_scores":
+            res = eng.topn_scores_async(
+                index, payload["field"], payload["rows"], call_of("src"),
+                shards, broadcast=False,
+            )
+            return None if res is None else res[0]
+        if kind == "group":
+            return eng.group_counts_async(
+                index, payload["fields"], payload["rows"], call_of("filter"),
+                shards, broadcast=False,
+            )
+        raise ApiError(f"unknown collective kind: {kind}")
 
     def translate_keys(self, index: str, field: str, keys: List[str]) -> List[int]:
         if field:

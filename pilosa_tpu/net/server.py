@@ -90,17 +90,14 @@ class Handler:
         r("POST", "/internal/translate/keys", self._translate_keys)
         r("POST", "/internal/fragment/data", self._post_fragment_data)
         r("GET", "/internal/fragment/data", self._get_fragment_data)
-        r("POST", "/internal/mesh/count", self._mesh_count)
+        r("POST", "/internal/mesh/dispatch", self._mesh_dispatch)
 
-    def _mesh_count(self, q, body, **kw):
+    def _mesh_dispatch(self, q, body, **kw):
         """Accept a collective dispatch from a multi-host peer: validate,
         enqueue for the replay worker, answer immediately — the worker
-        enters the same shard_map so the initiator's psum can rendezvous
-        (parallel/multihost.py SPMD serving)."""
-        doc = json.loads(body)
-        self.api.mesh_collective_accept(
-            doc["index"], doc["query"], doc.get("shards")
-        )
+        enters the same shard_map so the initiator's collective can
+        rendezvous (parallel/multihost.py SPMD serving)."""
+        self.api.mesh_collective_accept(json.loads(body))
         return {"accepted": True}
 
     def _route(self, method, pattern, fn):

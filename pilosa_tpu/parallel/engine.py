@@ -151,10 +151,11 @@ class MeshEngine:
         # cross-node concurrent initiation is not globally ordered.
         self.collective_broadcast = None
         self.collective_lock = threading.Lock()
-        # Only Count is wired for peer replay; on a multi-process
-        # runtime every other fused path falls back to the per-shard
-        # host path (correct, device-per-fragment) instead of entering
-        # a collective no peer would join.
+        # Count/Sum/Min/Max/fused-TopN/TopN-scorer/GroupBy all replay on
+        # peers; without a configured broadcast on a multi-process mesh
+        # every fused path falls back to the per-shard host path instead
+        # of entering a collective no peer would join
+        # (_peerless_multiproc).  bitmap_stack/bitmap_row stay gated.
         self.multiproc = jax.process_count() > 1
         # Count of fused device dispatches (one per kernel invocation;
         # cluster tests assert it advances when the fused path runs).
@@ -489,14 +490,32 @@ class MeshEngine:
         canonical = self.canonical_shards(index)
         if not canonical:
             return jnp.int32(0)
-        if broadcast and self.collective_broadcast is not None:
-            # Lock covers handoff + dispatch so this node's collectives
-            # enqueue in one order everywhere; a peer that cannot accept
-            # raises HERE, before anything blocks in the psum.
-            with self.collective_lock:
-                self.collective_broadcast(index, c, shards)
-                return self._dispatch_count(index, c, shards, canonical)
+        if broadcast and self._peerless_multiproc:
+            raise ValueError("multi-process mesh without peer broadcast")
+        if broadcast:
+            return self._collective(
+                "count",
+                {"index": index, "query": str(c), "shards": list(shards)},
+                lambda: self._dispatch_count(index, c, shards, canonical),
+            )
         return self._dispatch_count(index, c, shards, canonical)
+
+    @property
+    def _peerless_multiproc(self) -> bool:
+        """Multi-process mesh with NO peer replay configured: entering a
+        collective would hang forever (no other process joins), so fused
+        paths fall back to the per-shard host path instead."""
+        return self.multiproc and self.collective_broadcast is None
+
+    def _collective(self, kind, payload, dispatch):
+        """Run a fused dispatch; on a peer-replayed mesh, hand the
+        descriptor to every peer first, under the lock (a peer that
+        cannot accept raises HERE, before anything blocks in a psum)."""
+        if self.collective_broadcast is not None:
+            with self.collective_lock:
+                self.collective_broadcast(kind, payload)
+                return dispatch()
+        return dispatch()
 
     def _dispatch_count(self, index, c, shards, canonical):
         lw = _Lowering(self, canonical)
@@ -557,13 +576,18 @@ class MeshEngine:
         return self._lower(index, filter_call, lw)
 
     def sum_async(
-        self, index: str, field_name: str, filter_call: Optional[Call], shards
+        self,
+        index: str,
+        field_name: str,
+        filter_call: Optional[Call],
+        shards,
+        broadcast: bool = True,
     ):
         """BSI Sum dispatch with the result left on device: returns
         ((counts, n) device arrays, depth, bsig) or None.  Callers
         pipeline query streams; ``sum`` is the one-readback wrapper."""
-        if self.multiproc:
-            return None  # no peer replay for Sum yet (see collective_broadcast)
+        if broadcast and self._peerless_multiproc:
+            return None
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx is not None else None
         bsig = f.bsi_group(field_name) if f is not None else None
@@ -577,16 +601,32 @@ class MeshEngine:
         lw = _Lowering(self, canonical)
         prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
-        self.fused_dispatches += 1
-        dev = kernels.sum_tree(
-            self.mesh,
-            prog,
-            tuple(lw.specs),
-            self._plane_spec(stack, depth),
-            mask,
-            stack.matrix,
-            *lw.operands,
-        )
+
+        def dispatch():
+            self.fused_dispatches += 1
+            return kernels.sum_tree(
+                self.mesh,
+                prog,
+                tuple(lw.specs),
+                self._plane_spec(stack, depth),
+                mask,
+                stack.matrix,
+                *lw.operands,
+            )
+
+        if broadcast:
+            dev = self._collective(
+                "sum",
+                {
+                    "index": index,
+                    "field": field_name,
+                    "filter": None if filter_call is None else str(filter_call),
+                    "shards": list(shards),
+                },
+                dispatch,
+            )
+        else:
+            dev = dispatch()
         return dev, depth, bsig
 
     def sum(self, index: str, field_name: str, filter_call: Optional[Call], shards):
@@ -609,10 +649,11 @@ class MeshEngine:
         filter_call: Optional[Call],
         shards,
         is_min: bool,
+        broadcast: bool = True,
     ):
         """BSI Min/Max dispatch with the (flags, counts) result left on
         device: returns (dev, canonical, depth, bsig) or None."""
-        if self.multiproc:
+        if broadcast and self._peerless_multiproc:
             return None
         idx = self.holder.index(index)
         f = idx.field(field_name) if idx is not None else None
@@ -627,17 +668,34 @@ class MeshEngine:
         lw = _Lowering(self, canonical)
         prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
-        self.fused_dispatches += 1
-        dev = kernels.minmax_tree(
-            self.mesh,
-            prog,
-            tuple(lw.specs),
-            self._plane_spec(stack, depth),
-            is_min,
-            mask,
-            stack.matrix,
-            *lw.operands,
-        )
+
+        def dispatch():
+            self.fused_dispatches += 1
+            return kernels.minmax_tree(
+                self.mesh,
+                prog,
+                tuple(lw.specs),
+                self._plane_spec(stack, depth),
+                is_min,
+                mask,
+                stack.matrix,
+                *lw.operands,
+            )
+
+        if broadcast:
+            dev = self._collective(
+                "minmax",
+                {
+                    "index": index,
+                    "field": field_name,
+                    "filter": None if filter_call is None else str(filter_call),
+                    "shards": list(shards),
+                    "isMin": bool(is_min),
+                },
+                dispatch,
+            )
+        else:
+            dev = dispatch()
         return dev, canonical, depth, bsig
 
     def min_max(
@@ -672,17 +730,23 @@ class MeshEngine:
             return 0, 0
         return best_val + bsig.min, best_n
 
-    def topn_scores(
-        self, index: str, field: str, candidate_rows: List[int], src_call: Call, shards
+    def topn_scores_async(
+        self,
+        index: str,
+        field: str,
+        candidate_rows: List[int],
+        src_call: Call,
+        shards,
+        broadcast: bool = True,
     ):
-        """Batched TopN phase-1 scoring across ALL requested shards in one
-        dispatch pair: (scores int32[S, K], src_counts int32[S],
-        shard_pos).  ``shard_pos`` maps shard -> row of the canonical axis;
-        candidates absent from the row table score 0."""
-        if self.multiproc:
-            return None
+        """TopN phase-1 scoring dispatch with results left on device:
+        returns ((scores, counts) device pair, present mask, shard_pos)
+        or None.  Peer replays use this directly — the device_get then
+        happens OUTSIDE the collective lock."""
         from . import kernels
 
+        if broadcast and self._peerless_multiproc:
+            return None
         stack = self.field_stack(index, field, VIEW_STANDARD)
         if stack is None:
             return None
@@ -700,16 +764,54 @@ class MeshEngine:
         lw = _Lowering(self, stack.shards)
         prog = self._lower(index, src_call, lw)
         mask = self._mask_words(shards, stack.shards)
-        self.fused_dispatches += 1
-        dev_scores, dev_counts = kernels.topn_tree(
-            self.mesh,
-            prog,
-            tuple(lw.specs),
-            mask,
-            stack.matrix,
-            idxs,
-            *lw.operands,
+
+        def dispatch():
+            self.fused_dispatches += 1
+            return kernels.topn_tree(
+                self.mesh,
+                prog,
+                tuple(lw.specs),
+                mask,
+                stack.matrix,
+                idxs,
+                *lw.operands,
+            )
+
+        if broadcast:
+            dev = self._collective(
+                "topn_scores",
+                {
+                    "index": index,
+                    "field": field,
+                    "rows": [int(r) for r in candidate_rows],
+                    "src": str(src_call),
+                    "shards": list(shards),
+                },
+                dispatch,
+            )
+        else:
+            dev = dispatch()
+        return dev, present, dict(stack.pos)
+
+    def topn_scores(
+        self,
+        index: str,
+        field: str,
+        candidate_rows: List[int],
+        src_call: Call,
+        shards,
+        broadcast: bool = True,
+    ):
+        """Batched TopN phase-1 scoring across ALL requested shards in one
+        dispatch pair: (scores int32[S, K], src_counts int32[S],
+        shard_pos).  ``shard_pos`` maps shard -> row of the canonical axis;
+        candidates absent from the row table score 0."""
+        res = self.topn_scores_async(
+            index, field, candidate_rows, src_call, shards, broadcast
         )
+        if res is None:
+            return None
+        (dev_scores, dev_counts), present, pos = res
         # ONE host transfer for both results (each sync readback pays a
         # full relay RTT through the tunnel); np.array copy because
         # device-array views are read-only host buffers.  The kernel's
@@ -717,7 +819,7 @@ class MeshEngine:
         scores, src_counts = jax.device_get((dev_scores, dev_counts))
         scores = np.array(scores).T
         scores[:, ~present] = 0
-        return scores, src_counts, dict(stack.pos)
+        return scores, src_counts, pos
 
     # -- fused full TopN ----------------------------------------------------
 
@@ -793,18 +895,31 @@ class MeshEngine:
         n: int,
         min_threshold: int,
         row_ids=None,
+        broadcast: bool = True,
+        replay_cands=None,
     ):
         """Dispatch the whole TopN (phase-1 scoring + gates + exact
         phase-2 totals + trim) as ONE device program; returns
         (candidates, n_out, device result) with the result left on
         device for pipelining, or None when the fused path doesn't
-        apply (candidate union too large)."""
-        if self.multiproc:
-            return None  # fall back to the host two-phase path
+        apply (candidate union too large).
+
+        ``replay_cands``: a peer replay ships the INITIATOR's resolved
+        candidate set — the no-ids candidate union comes from ranked
+        cache state, which is timing-dependent per host; rebuilding it
+        locally could yield a different K and a mismatched collective
+        shape."""
+        if broadcast and self._peerless_multiproc:
+            return None
         stack = self.field_stack(index, field, VIEW_STANDARD)
         if stack is None:
             return [], None, None
-        entry = self._topn_candidates(index, field, stack, row_ids)
+        if replay_cands is not None:
+            entry = self._build_topn_candidates(
+                index, field, stack, list(replay_cands)
+            )
+        else:
+            entry = self._topn_candidates(index, field, stack, row_ids)
         if not entry.cands:
             return [], None, None
         if len(entry.cands) > self.MAX_TOPN_CANDIDATES:
@@ -819,20 +934,40 @@ class MeshEngine:
         mask = self._mask_words(shards, stack.shards)
         extra_ops = () if entry.idxs is not None else (entry.dyn_idxs,)
         extra_specs = () if entry.idxs is not None else (P(),)
-        self.fused_dispatches += 1
-        out = kernels.topn_full_tree(
-            self.mesh,
-            prog,
-            extra_specs + tuple(lw.specs),
-            n_out,
-            entry.idxs,
-            mask,
-            stack.matrix,
-            entry.dev_cnt,
-            self._scalar(max(int(min_threshold), 1)),
-            *extra_ops,
-            *lw.operands,
-        )
+
+        def dispatch():
+            self.fused_dispatches += 1
+            return kernels.topn_full_tree(
+                self.mesh,
+                prog,
+                extra_specs + tuple(lw.specs),
+                n_out,
+                entry.idxs,
+                mask,
+                stack.matrix,
+                entry.dev_cnt,
+                self._scalar(max(int(min_threshold), 1)),
+                *extra_ops,
+                *lw.operands,
+            )
+
+        if broadcast:
+            out = self._collective(
+                "topn",
+                {
+                    "index": index,
+                    "field": field,
+                    "src": str(src_call),
+                    "shards": list(shards),
+                    "n": int(n),
+                    "minThreshold": int(min_threshold),
+                    "rowIds": None if not row_ids else [int(r) for r in row_ids],
+                    "cands": [int(c) for c in entry.cands],
+                },
+                dispatch,
+            )
+        else:
+            out = dispatch()
         return entry.cands, n_out, out
 
     def topn_full(
@@ -928,10 +1063,11 @@ class MeshEngine:
         row_lists: List[List[int]],
         filter_call: Optional[Call],
         shards: List[int],
+        broadcast: bool = True,
     ):
         """Fused GroupBy dispatch with the int32[Ka(,Kb)] count tensor
         left on device; returns None when the fused path doesn't apply."""
-        if self.multiproc:
+        if broadcast and self._peerless_multiproc:
             return None
         if len(fields) not in (1, 2):
             raise ValueError("fused GroupBy supports 1 or 2 fields")
@@ -964,30 +1100,46 @@ class MeshEngine:
         prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
         extra_specs = (P(),) * len(extra_ops)
-        self.fused_dispatches += 1
-        if len(fields) == 1:
-            return kernels.group1_tree(
+
+        def dispatch():
+            self.fused_dispatches += 1
+            if len(fields) == 1:
+                return kernels.group1_tree(
+                    self.mesh,
+                    prog,
+                    extra_specs + tuple(lw.specs),
+                    statics[0],
+                    mask,
+                    stacks[0].matrix,
+                    *extra_ops,
+                    *lw.operands,
+                )
+            return kernels.group2_tree(
                 self.mesh,
                 prog,
                 extra_specs + tuple(lw.specs),
                 statics[0],
+                statics[1],
                 mask,
                 stacks[0].matrix,
+                stacks[1].matrix,
                 *extra_ops,
                 *lw.operands,
             )
-        return kernels.group2_tree(
-            self.mesh,
-            prog,
-            extra_specs + tuple(lw.specs),
-            statics[0],
-            statics[1],
-            mask,
-            stacks[0].matrix,
-            stacks[1].matrix,
-            *extra_ops,
-            *lw.operands,
-        )
+
+        if broadcast:
+            return self._collective(
+                "group",
+                {
+                    "index": index,
+                    "fields": list(fields),
+                    "rows": [[int(r) for r in rows] for rows in row_lists],
+                    "filter": None if filter_call is None else str(filter_call),
+                    "shards": list(shards),
+                },
+                dispatch,
+            )
+        return dispatch()
 
     def group_counts(
         self,

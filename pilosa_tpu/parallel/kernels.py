@@ -127,6 +127,20 @@ def gather_rows(mat, idxs):
     return jnp.take(mat, idxs, axis=0)
 
 
+def replicate_shards(x, n_dev, axis=0):
+    """[.., S_local, ..] -> replicated [.., S_total, ..]: scatter the
+    local block at this device's offset and psum.  Equivalent to a tiled
+    all_gather, but psum outputs are INFERRED replicated by shard_map's
+    vma check on every jax version (tiled all_gather is not)."""
+    i = jax.lax.axis_index(SHARD_AXIS)
+    local = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis] = local * n_dev
+    out = jnp.zeros(tuple(shape), x.dtype)
+    out = jax.lax.dynamic_update_slice_in_dim(out, x, i * local, axis=axis)
+    return jax.lax.psum(out, SHARD_AXIS)
+
+
 def _filter(prog, mask, ops):
     """Masked filter row: the evaluated tree & mask, or the bare mask
     (uint32[S, 1], broadcasting) for prog ("ones",)."""
@@ -166,19 +180,27 @@ def topn_tree(mesh, prog, specs, mask, cand_mat, idxs, *operands):
     """TopN phase-1 in ONE dispatch: evaluate the src tree, gather the
     candidate rows in-body, score every candidate per shard
     (fragment.go top :1018/:1089) -> (scores int32[K, S],
-    src_counts int32[S]), kept sharded."""
+    src_counts int32[S]), replicated."""
 
     def body(m, cmat, ix, *ops):
         src = _filter(prog, m, ops)
         cands = jnp.take(cmat, ix, axis=0)
         scores = jnp.sum(_pc(jnp.bitwise_and(cands, src[None, :, :])), axis=-1)
-        return scores, jnp.sum(_pc(jnp.broadcast_to(src, cmat.shape[1:])), axis=-1)
+        counts = jnp.sum(_pc(jnp.broadcast_to(src, cmat.shape[1:])), axis=-1)
+        # Replicated outputs (tiny int matrices): on a multi-process mesh
+        # the caller's device_get only sees addressable shards, so
+        # sharded outputs would silently drop remote shards.
+        n_dev = mesh.shape[SHARD_AXIS]
+        return (
+            replicate_shards(scores, n_dev, axis=1),
+            replicate_shards(counts, n_dev, axis=0),
+        )
 
     return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P()) + specs,
-        out_specs=(P(None, SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P()),
     )(mask, cand_mat, idxs, *operands)
 
 
@@ -261,7 +283,7 @@ def sum_tree(mesh, prog, specs, pspec, mask, plane_mat, *operands):
 def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
     """BSI Min/Max in ONE dispatch: per-shard plane walks
     (fragment.go min/max :745-806) -> (flags int32[S, D],
-    counts int32[S]), kept sharded for the host ValCount reduce."""
+    counts int32[S]), replicated for the host ValCount reduce."""
 
     def body(m, pm, *ops):
         f = _filter(prog, m, ops)
@@ -269,13 +291,19 @@ def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
         fb = jnp.broadcast_to(f, p.shape[1:])
         fn = bsi_ops.min_flags if is_min else bsi_ops.max_flags
         flags, counts = jax.vmap(fn, in_axes=(1, 0))(p, fb)
-        return flags.astype(jnp.int32), counts
+        # Replicated (see topn_tree/replicate_shards): the host ValCount
+        # reduce needs EVERY shard's flags, including remote processes'.
+        n_dev = mesh.shape[SHARD_AXIS]
+        return (
+            replicate_shards(flags.astype(jnp.int32), n_dev, axis=0),
+            replicate_shards(counts, n_dev, axis=0),
+        )
 
     return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS)) + specs,
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P()),
     )(mask, plane_mat, *operands)
 
 

@@ -173,22 +173,20 @@ class Server:
             self.logger.printf("mesh engine unavailable: %s", e)
             return None
 
-    def _broadcast_dispatch(self, index, call, shards):
-        """Synchronously hand a collective dispatch to every peer server.
-        Peers validate + enqueue and answer in one RTT (the replay runs
-        on their worker thread), so waiting here is cheap — and a peer
-        that is down or rejects the dispatch raises NOW, failing the
-        query fast instead of leaving this process blocked forever in a
-        psum no peer will join."""
+    def _broadcast_dispatch(self, kind, payload):
+        """Synchronously hand a collective dispatch descriptor to every
+        peer server.  Peers validate + enqueue and answer in one RTT
+        (the replay runs on their worker thread), so waiting here is
+        cheap — and a peer that is down or rejects the dispatch raises
+        NOW, failing the query fast instead of leaving this process
+        blocked forever in a collective no peer will join."""
         import urllib.request
 
-        body = json.dumps(
-            {"index": index, "query": str(call), "shards": list(shards)}
-        ).encode()
+        body = json.dumps(dict(payload, kind=kind)).encode()
 
         def post(url):
             req = urllib.request.Request(
-                f"{url}/internal/mesh/count", data=body, method="POST"
+                f"{url}/internal/mesh/dispatch", data=body, method="POST"
             )
             req.add_header("Content-Type", "application/json")
             urllib.request.urlopen(req, timeout=30).read()

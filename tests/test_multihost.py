@@ -86,7 +86,9 @@ srv = Server(cfg)
 srv.open()
 
 # Identical holder truth in both processes (each pod host replays the
-# same data): 4 shards, rows 1 and 2 overlap by 50 columns per shard.
+# same data): 4 shards, rows 1 and 2 overlap by 50 columns per shard,
+# plus a BSI field and two group fields for the aggregate collectives.
+from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.fragment import SHARD_WIDTH
 idx = srv.holder.create_index("i")
 f = idx.create_field("f")
@@ -97,6 +99,17 @@ for s in range(4):
     for c in range(50, 150):
         rows.append(2); cols.append(s * SHARD_WIDTH + c)
 f.import_bulk(rows, cols)
+v = idx.create_field("v", FieldOptions(type="int", min=0, max=100))
+vcols = [s * SHARD_WIDTH + c for s in range(4) for c in range(10)]
+v.import_values(vcols, [(c % 7) + 1 for c in range(len(vcols))])
+ga = idx.create_field("ga")
+gb = idx.create_field("gb")
+ga.import_bulk([0, 0, 1, 1], [0, 1, SHARD_WIDTH, SHARD_WIDTH + 1])
+gb.import_bulk([0, 0, 0, 0], [0, 1, SHARD_WIDTH, SHARD_WIDTH + 1])
+for field in (f, ga, gb):
+    for vw in field.views.values():
+        for frag in vw.fragments.values():
+            frag.cache.recalculate()
 
 print(f"READY {pid}", flush=True)
 import time
@@ -179,15 +192,36 @@ def test_two_server_collective_count_http(tmp_path):
                     ready[i] = True
         assert all(ready), "servers did not come up"
 
-        # ONE fused Count over HTTP to node 0: node 0 broadcasts the
-        # dispatch to node 1, both enter the shard_map, psum crosses the
-        # process boundary. 50 overlapping columns x 4 shards = 200.
-        body = b"Count(Intersect(Row(f=1), Row(f=2)))"
-        req = urllib.request.Request(
-            f"http://localhost:{ports[0]}/index/i/query", data=body, method="POST"
-        )
-        out = json.loads(urllib.request.urlopen(req, timeout=120).read())
-        assert out["results"][0] == 200, out
+        # Fused collectives over HTTP to node 0: node 0 hands each
+        # dispatch to node 1, both enter the shard_map, the collective
+        # crosses the process boundary.
+        def query(body):
+            req = urllib.request.Request(
+                f"http://localhost:{ports[0]}/index/i/query",
+                data=body.encode(), method="POST",
+            )
+            return json.loads(
+                urllib.request.urlopen(req, timeout=120).read()
+            )["results"][0]
+
+        # 50 overlapping columns x 4 shards = 200.
+        assert query("Count(Intersect(Row(f=1), Row(f=2)))") == 200
+        # Sum: 40 values of ((c % 7) + 1), c = 0..39.
+        want_sum = sum((c % 7) + 1 for c in range(40))
+        vc = query("Sum(field=v)")
+        assert (vc["value"], vc["count"]) == (want_sum, 40), vc
+        assert query("Min(field=v)")["value"] == 1
+        assert query("Max(field=v)")["value"] == 7
+        # Fused TopN: row 1 has 400 bits, row 2 has 400.
+        pairs = query("TopN(f, n=2)")
+        assert {(p["id"], p["count"]) for p in pairs} == {(1, 400), (2, 400)}
+        # Fused 2-field GroupBy.
+        groups = query("GroupBy(Rows(field=ga), Rows(field=gb))")
+        got = {
+            (g["group"][0]["rowID"], g["group"][1]["rowID"]): g["count"]
+            for g in groups
+        }
+        assert got == {(0, 0): 2, (1, 0): 2}, got
     finally:
         for p in procs:
             p.kill()
