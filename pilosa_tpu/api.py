@@ -735,18 +735,23 @@ class API:
                 raise NotFoundError(f"field not found: {fname}")
             if kind in ("sum", "minmax") and f.bsi_group(fname) is None:
                 raise ApiError(f"field is not BSI: {fname}")
-        # Parse every call text ONCE up front: a syntax error must
-        # surface to the initiator as a 400, not strand its collective;
-        # the parsed calls ride the queue so the worker doesn't re-parse.
+        # Parse every call text ONCE up front: a syntax error (or an
+        # empty required text) must surface to the initiator as a 400,
+        # not strand its collective; the parsed calls ride the queue so
+        # the worker doesn't re-parse.  Only the optional filter may be
+        # absent/None.
         payload = dict(payload)
         payload["_calls"] = {}
         for key in ("query", "src", "filter"):
             text = payload.get(key)
-            if text:
-                q = pql_mod.parse(text)
-                if len(q.calls) != 1:
-                    raise ApiError("collective dispatch carries exactly one call")
-                payload["_calls"][key] = q.calls[0]
+            if text is None and (key == "filter" or key not in required):
+                continue
+            if not text:
+                raise ApiError(f"collective {kind}: empty {key}")
+            q = pql_mod.parse(text)
+            if len(q.calls) != 1:
+                raise ApiError("collective dispatch carries exactly one call")
+            payload["_calls"][key] = q.calls[0]
         with self._mesh_replay_lock:
             if self._mesh_replay_q is None:
                 import queue as queue_mod

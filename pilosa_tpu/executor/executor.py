@@ -915,8 +915,14 @@ class Executor:
         local = self._local_shards(index, shards)
         if not local:
             return None
+        from ..parallel.engine import PeerlessMeshError
+
         try:
             return set(local), self.mesh_engine.count(index, child, local)
+        except PeerlessMeshError:
+            # Multi-process mesh with no peer broadcast configured:
+            # the per-shard path is the correct fallback.
+            return None
         except ValueError:
             # Unsupported call shape: fall back to the per-shard path.
             return None

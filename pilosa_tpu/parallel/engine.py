@@ -114,6 +114,11 @@ class _Lowering:
 DEFAULT_RESIDENCY_BYTES = 8 << 30  # HBM budget for resident field stacks
 
 
+class PeerlessMeshError(RuntimeError):
+    """A collective was requested on a multi-process mesh that has no
+    peer broadcast configured — entering it would hang forever."""
+
+
 class MeshEngine:
     def __init__(self, holder, mesh: Mesh, max_resident_bytes: int = DEFAULT_RESIDENCY_BYTES):
         self.holder = holder
@@ -491,14 +496,13 @@ class MeshEngine:
         if not canonical:
             return jnp.int32(0)
         if broadcast and self._peerless_multiproc:
-            raise ValueError("multi-process mesh without peer broadcast")
-        if broadcast:
-            return self._collective(
-                "count",
-                {"index": index, "query": str(c), "shards": list(shards)},
-                lambda: self._dispatch_count(index, c, shards, canonical),
-            )
-        return self._dispatch_count(index, c, shards, canonical)
+            raise PeerlessMeshError("multi-process mesh without peer broadcast")
+        return self._collective(
+            "count",
+            {"index": index, "query": str(c), "shards": list(shards)},
+            lambda: self._dispatch_count(index, c, shards, canonical),
+            broadcast,
+        )
 
     @property
     def _peerless_multiproc(self) -> bool:
@@ -507,11 +511,12 @@ class MeshEngine:
         paths fall back to the per-shard host path instead."""
         return self.multiproc and self.collective_broadcast is None
 
-    def _collective(self, kind, payload, dispatch):
+    def _collective(self, kind, payload, dispatch, broadcast=True):
         """Run a fused dispatch; on a peer-replayed mesh, hand the
         descriptor to every peer first, under the lock (a peer that
-        cannot accept raises HERE, before anything blocks in a psum)."""
-        if self.collective_broadcast is not None:
+        cannot accept raises HERE, before anything blocks in a psum).
+        ``broadcast=False`` marks a peer replay: dispatch directly."""
+        if broadcast and self.collective_broadcast is not None:
             with self.collective_lock:
                 self.collective_broadcast(kind, payload)
                 return dispatch()
@@ -614,19 +619,17 @@ class MeshEngine:
                 *lw.operands,
             )
 
-        if broadcast:
-            dev = self._collective(
-                "sum",
-                {
-                    "index": index,
-                    "field": field_name,
-                    "filter": None if filter_call is None else str(filter_call),
-                    "shards": list(shards),
-                },
-                dispatch,
-            )
-        else:
-            dev = dispatch()
+        dev = self._collective(
+            "sum",
+            {
+                "index": index,
+                "field": field_name,
+                "filter": None if filter_call is None else str(filter_call),
+                "shards": list(shards),
+            },
+            dispatch,
+            broadcast,
+        )
         return dev, depth, bsig
 
     def sum(self, index: str, field_name: str, filter_call: Optional[Call], shards):
@@ -682,20 +685,18 @@ class MeshEngine:
                 *lw.operands,
             )
 
-        if broadcast:
-            dev = self._collective(
-                "minmax",
-                {
-                    "index": index,
-                    "field": field_name,
-                    "filter": None if filter_call is None else str(filter_call),
-                    "shards": list(shards),
-                    "isMin": bool(is_min),
-                },
-                dispatch,
-            )
-        else:
-            dev = dispatch()
+        dev = self._collective(
+            "minmax",
+            {
+                "index": index,
+                "field": field_name,
+                "filter": None if filter_call is None else str(filter_call),
+                "shards": list(shards),
+                "isMin": bool(is_min),
+            },
+            dispatch,
+            broadcast,
+        )
         return dev, canonical, depth, bsig
 
     def min_max(
@@ -777,20 +778,18 @@ class MeshEngine:
                 *lw.operands,
             )
 
-        if broadcast:
-            dev = self._collective(
-                "topn_scores",
-                {
-                    "index": index,
-                    "field": field,
-                    "rows": [int(r) for r in candidate_rows],
-                    "src": str(src_call),
-                    "shards": list(shards),
-                },
-                dispatch,
-            )
-        else:
-            dev = dispatch()
+        dev = self._collective(
+            "topn_scores",
+            {
+                "index": index,
+                "field": field,
+                "rows": [int(r) for r in candidate_rows],
+                "src": str(src_call),
+                "shards": list(shards),
+            },
+            dispatch,
+            broadcast,
+        )
         return dev, present, dict(stack.pos)
 
     def topn_scores(
@@ -951,23 +950,21 @@ class MeshEngine:
                 *lw.operands,
             )
 
-        if broadcast:
-            out = self._collective(
-                "topn",
-                {
-                    "index": index,
-                    "field": field,
-                    "src": str(src_call),
-                    "shards": list(shards),
-                    "n": int(n),
-                    "minThreshold": int(min_threshold),
-                    "rowIds": None if not row_ids else [int(r) for r in row_ids],
-                    "cands": [int(c) for c in entry.cands],
-                },
-                dispatch,
-            )
-        else:
-            out = dispatch()
+        out = self._collective(
+            "topn",
+            {
+                "index": index,
+                "field": field,
+                "src": str(src_call),
+                "shards": list(shards),
+                "n": int(n),
+                "minThreshold": int(min_threshold),
+                "rowIds": None if not row_ids else [int(r) for r in row_ids],
+                "cands": [int(c) for c in entry.cands],
+            },
+            dispatch,
+            broadcast,
+        )
         return entry.cands, n_out, out
 
     def topn_full(
@@ -1127,19 +1124,18 @@ class MeshEngine:
                 *lw.operands,
             )
 
-        if broadcast:
-            return self._collective(
-                "group",
-                {
-                    "index": index,
-                    "fields": list(fields),
-                    "rows": [[int(r) for r in rows] for rows in row_lists],
-                    "filter": None if filter_call is None else str(filter_call),
-                    "shards": list(shards),
-                },
-                dispatch,
-            )
-        return dispatch()
+        return self._collective(
+            "group",
+            {
+                "index": index,
+                "fields": list(fields),
+                "rows": [[int(r) for r in rows] for rows in row_lists],
+                "filter": None if filter_call is None else str(filter_call),
+                "shards": list(shards),
+            },
+            dispatch,
+            broadcast,
+        )
 
     def group_counts(
         self,

@@ -439,3 +439,37 @@ def test_time_clear_quantums(quantum, expected):
         quantum.lower(), "Range(f=1, 1999-12-31T00:00, 2002-01-01T03:00)"
     ).results
     assert row.columns().tolist() == expected
+
+
+# -- keyed Rows previous / SetColumnAttrs exclude --------------------------
+
+
+def test_rows_keys_previous():
+    """Rows over a keyed field pages with previous=<key>
+    (executor_test.go Rows_Keys :2677)."""
+    h, idx, ex = make_ex(keys=True, field_keys=True)
+    ex.execute("i", 'Set("a", f="r1") Set("b", f="r2") Set("c", f="r3")')
+    (rows,) = ex.execute("i", "Rows(field=f)").results
+    assert rows.keys == ["r1", "r2", "r3"]
+    (rows,) = ex.execute("i", 'Rows(field=f, previous="r1")').results
+    assert rows.keys == ["r2", "r3"]
+    (rows,) = ex.execute("i", 'Rows(field=f, previous="r1", limit=1)').results
+    assert rows.keys == ["r2"]
+
+
+def test_set_column_attrs_no_field():
+    """SetColumnAttrs takes no field argument — column attrs live on the
+    index (executor_test.go SetColumnAttrs_ExcludeField :1931)."""
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
+    ex.execute("i", "Set(10, f=1)")
+    ex.execute("i", 'SetColumnAttrs(10, foo="bar")')
+    assert idx.column_attr_store.attrs(10) == {"foo": "bar"}
+    # Round-trips through a query with columnAttrs on.
+    resp = ex.execute("i", "Options(Row(f=1), columnAttrs=true)")
+    assert [(s.id, s.attrs) for s in resp.column_attr_sets] == [
+        (10, {"foo": "bar"})
+    ]
