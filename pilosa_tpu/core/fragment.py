@@ -30,6 +30,7 @@ import hashlib
 import json
 import os
 import threading
+from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -45,6 +46,7 @@ SHARD_WIDTH = ops.SHARD_WIDTH
 WORDS64 = bitops.WORDS64
 
 HASH_BLOCK_SIZE = 100  # rows per anti-entropy checksum block
+MUTLOG_MAX = 512  # engine incremental-sync window (rows)
 DEFAULT_MAX_OP_N = 2000
 
 # Row ids used for bool fields (fragment.go:82-84).
@@ -113,6 +115,13 @@ class Fragment:
         self._dev_version = -1
         self._dev_matrix = None
         self._dev_index: Dict[int, int] = {}
+        # Bounded mutation log: (version, row_id) per _touch.  The mesh
+        # engine replays the tail to scatter-update its resident HBM
+        # stacks instead of re-uploading whole views per write (the
+        # SURVEY "op-log batching -> device scatter" hard part); a log
+        # that no longer reaches back to the engine's sync point forces
+        # a full rebuild (mutations_since -> None).
+        self._mutlog: "deque" = deque(maxlen=MUTLOG_MAX)
 
         # Lazily-built mutex occupancy vector: column -> owning row (-1 none).
         self._mutex_owners: Optional[np.ndarray] = None
@@ -247,9 +256,39 @@ class Fragment:
 
     def _touch(self, row_id: int):
         self._version += 1
+        self._mutlog.append((self._version, row_id))
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         if self._on_touch is not None:
             self._on_touch()
+
+    def mutations_since(self, version: int):
+        """Row ids touched after ``version``, or None when the bounded
+        log no longer covers that span (caller must full-rebuild).
+        Versions are consecutive (each _touch bumps by one), so coverage
+        is exactly ``self._version - version`` trailing entries."""
+        with self._mu:
+            if version >= self._version:
+                return []
+            missing = self._version - version
+            if missing > len(self._mutlog):
+                return None
+            return sorted({r for v, r in self._mutlog if v > version})
+
+    def sync_snapshot(self, version: int):
+        """ATOMIC (new_version, {row_id: words}) of every row touched
+        after ``version`` — dirty scan, word reads, and the version
+        stamp all under the fragment lock, so a concurrent writer can
+        never land between them and be recorded as synced without its
+        words (the engine's incremental HBM sync depends on this).
+        Returns None when the mutation log no longer covers the span."""
+        with self._mu:
+            if version >= self._version:
+                return self._version, {}
+            missing = self._version - version
+            if missing > len(self._mutlog):
+                return None
+            rows = sorted({r for v, r in self._mutlog if v > version})
+            return self._version, {r: self.row_words(r) for r in rows}
 
     @_locked
     def set_bit(self, row_id: int, column_id: int) -> bool:

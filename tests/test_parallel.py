@@ -334,3 +334,53 @@ def test_fused_topn_ties_thresholds(holder, mesh):
     # Tie order inside a trimmed result is (count desc, id desc).
     top4 = fused.execute("i", "TopN(f, Row(s=0), n=4)").results[0]
     assert top4 == [(1, 40), (5, 30), (2, 30), (4, 20)]
+
+
+def test_incremental_stack_sync(holder, mesh):
+    """Small write deltas scatter into the resident HBM stack instead of
+    re-uploading the whole view (SURVEY "mutability on an accelerator":
+    op-log batching -> device scatter).  Rebuilds happen only for shape
+    changes (new rows) or mutation-log overflow."""
+    build_data(holder)
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder)
+    call = pql.parse("Row(f=10)").calls[0]
+    shards = list(range(8))
+    base = eng.count("i", call, shards)
+    assert (eng.stack_rebuilds, eng.stack_updates) == (1, 0)
+
+    # Point writes across several shards (set two, clear one of them
+    # back): ONE incremental sync, no rebuild.
+    ex.execute("i", f"Set({3 * SHARD_WIDTH + 99}, f=10)")
+    ex.execute("i", f"Set({5 * SHARD_WIDTH + 98}, f=10)")
+    ex.execute("i", f"Clear({5 * SHARD_WIDTH + 98}, f=10)")
+    assert eng.count("i", call, shards) == base + 1
+    assert (eng.stack_rebuilds, eng.stack_updates) == (1, 1)
+
+    # Repeated write/read cycles keep using the scatter path.
+    for k in range(3):
+        ex.execute("i", f"Set({k}, f=11)")
+        eng.count("i", call, shards)
+    assert eng.stack_rebuilds == 1 and eng.stack_updates == 4
+
+    # A brand-new row id changes the stack shape: full rebuild.
+    ex.execute("i", "Set(7, f=999)")
+    got = eng.count("i", pql.parse("Row(f=999)").calls[0], shards)
+    assert got == 1
+    assert eng.stack_rebuilds == 2
+
+    # Mutation-log overflow (bulk import touching > MUTLOG_MAX rows'
+    # worth of entries) forces a rebuild, not a wrong answer.
+    from pilosa_tpu.core.fragment import MUTLOG_MAX
+
+    frag = holder.fragment("i", "f", "standard", 0)
+    for i in range(MUTLOG_MAX + 10):
+        frag.set_bit(10, (i * 17) % SHARD_WIDTH)
+    want_after = eng.count("i", call, shards)
+    oracle = sum(
+        holder.fragment("i", "f", "standard", s).row_count(10)
+        for s in range(8)
+        if holder.fragment("i", "f", "standard", s) is not None
+    )
+    assert want_after == oracle
+    assert eng.stack_rebuilds == 3  # overflow path rebuilt
