@@ -261,19 +261,6 @@ class Fragment:
         if self._on_touch is not None:
             self._on_touch()
 
-    def mutations_since(self, version: int):
-        """Row ids touched after ``version``, or None when the bounded
-        log no longer covers that span (caller must full-rebuild).
-        Versions are consecutive (each _touch bumps by one), so coverage
-        is exactly ``self._version - version`` trailing entries."""
-        with self._mu:
-            if version >= self._version:
-                return []
-            missing = self._version - version
-            if missing > len(self._mutlog):
-                return None
-            return sorted({r for v, r in self._mutlog if v > version})
-
     def sync_snapshot(self, version: int):
         """ATOMIC (new_version, {row_id: words}) of every row touched
         after ``version`` — dirty scan, word reads, and the version

@@ -60,8 +60,8 @@ class _FieldStack:
         self.versions = versions
         self.shards = shards
         self.pos = {s: i for i, s in enumerate(shards)}
-        # Per-canonical-position (id(fragment), synced fragment version):
-        # the scatter-update reconciliation point (see
+        # Per-canonical-position (weakref(fragment), synced fragment
+        # version): the scatter-update reconciliation point (see
         # MeshEngine._try_incremental_sync).
         self.frag_sync = frag_sync or []
 
@@ -305,6 +305,14 @@ class MeshEngine:
             return None
 
         frags = [self.holder.fragment(index, field, view, s) for s in canonical]
+        # Sync points are captured BEFORE reading any row words: a write
+        # landing mid-build then has version > recorded and the next
+        # incremental sync re-scatters its row (idempotent full-word
+        # set) — never a silently-lost update.
+        frag_sync = [
+            (None, -1) if f is None else (weakref.ref(f), f._version)
+            for f in frags
+        ]
         row_ids = sorted(
             {r for f in frags if f is not None for r in f.row_ids()}
         )
@@ -330,9 +338,7 @@ class MeshEngine:
             row_index,
             token,
             list(canonical),
-            frag_sync=[
-                (None, -1) if f is None else (id(f), f._version) for f in frags
-            ],
+            frag_sync=frag_sync,
         )
         self._stacks[key] = stack
         self._resident_bytes += mat.nbytes
@@ -357,13 +363,17 @@ class MeshEngine:
         new_sync = list(cached.frag_sync)
         for si, s in enumerate(canonical):
             frag = self.holder.fragment(index, field, view, s)
-            fid, synced = cached.frag_sync[si]
+            fref, synced = cached.frag_sync[si]
             if frag is None:
-                if fid is not None:
+                if fref is not None:
                     return None  # fragment removed
                 continue
-            if fid != id(frag):
+            # Weakref identity (NOT id(): a recycled address would pass
+            # for the old fragment and serve its stale rows forever).
+            if fref is None or fref() is not frag:
                 return None  # fragment replaced (reopen/resize)
+            if frag._version == synced:
+                continue  # unlocked fast skip: clean fragment, no lock
             snap = frag.sync_snapshot(synced)
             if snap is None:
                 return None  # log overflow: too much changed
@@ -376,7 +386,7 @@ class MeshEngine:
                 if len(updates) > self.MAX_INCREMENTAL_ROWS:
                     return None
             if dirty:
-                new_sync[si] = (fid, new_version)
+                new_sync[si] = (fref, new_version)
         if updates:
             # Admission: the non-donated scatter transiently doubles this
             # stack's footprint; evict others first like the rebuild path.
