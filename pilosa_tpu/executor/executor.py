@@ -1031,17 +1031,15 @@ class Executor:
             frag, bsig, filt = ctx
             depth = bsig.bit_depth()
             planes = frag.device_planes(depth)
-            flags, n = (
-                bsi_ops.min_flags(planes, filt)
+            hi, lo, n = (
+                bsi_ops.min_valcount(planes, filt)
                 if is_min
-                else bsi_ops.max_flags(planes, filt)
+                else bsi_ops.max_valcount(planes, filt)
             )
             n = int(n)
             if n == 0:
                 return ValCount()
-            flags = np.asarray(flags)
-            val = sum(1 << i for i in range(depth) if flags[i])
-            return ValCount(val + bsig.min, n)
+            return ValCount(((int(hi) << 31) | int(lo)) + bsig.min, n)
 
         def reduce_fn(p, v):
             p = p or ValCount()

@@ -177,3 +177,79 @@ def max_flags(planes, filter_row):
         flags.append(took)
     flags.reverse()
     return jnp.stack(flags), bitops.popcount(consider)
+
+
+@jax.jit
+def min_valcount(planes, filter_row):
+    """Word-local min walk -> (hi uint32, lo uint32, count int32);
+    value = (hi << 31) | lo.
+
+    The classic keep-mask walk (min_flags) takes a full per-shard
+    reduction barrier per plane to decide each bit, forcing the running
+    mask through HBM ~3x per plane.  Observing that the lexicographic
+    min distributes over words, the walk instead runs INSIDE each 32-bit
+    word (the per-word branch is ``zeros != 0`` — elementwise), keeping
+    a word-local candidate mask and value; one lexicographic (hi, lo)
+    min-reduce over the word values yields the shard min and the
+    word-local finals give the attaining-column count with no second
+    pass.  Everything between the plane loads and the output reduces is
+    register-resident elementwise work XLA fuses into ONE pass.
+
+    The value is split into two uint32 halves (bits 0..30 in lo, bits
+    31..62 in hi) because bit_depth may reach 63 and x64 is off on
+    device — a single int32 accumulator overflows at depth >= 32.
+    count 0 means no column considered."""
+    depth = planes.shape[0] - 1
+    keep0 = planes[depth] & filter_row
+    keep = keep0
+    lo = jnp.zeros(keep.shape, jnp.uint32)
+    hi = jnp.zeros(keep.shape, jnp.uint32)
+    for i in range(depth - 1, -1, -1):
+        zeros = keep & ~planes[i]
+        has0 = zeros != 0
+        keep = jnp.where(has0, zeros, keep)
+        bit = jnp.where(has0, jnp.uint32(0), jnp.uint32(1 << min(i, 31) if i < 31 else 1 << (i - 31)))
+        if i < 31:
+            lo = lo | bit
+        else:
+            hi = hi | bit
+    valid = keep0 != 0
+    full = jnp.uint32(0xFFFFFFFF)
+    min_hi = jnp.min(jnp.where(valid, hi, full))
+    in_hi = valid & (hi == min_hi)
+    min_lo = jnp.min(jnp.where(in_hi, lo, full))
+    attain = in_hi & (lo == min_lo)
+    count = jnp.sum(
+        jnp.where(attain, jax.lax.population_count(keep).astype(jnp.int32), 0)
+    )
+    return min_hi, min_lo, count
+
+
+@jax.jit
+def max_valcount(planes, filter_row):
+    """Word-local max walk -> (hi uint32, lo uint32, count int32);
+    see min_valcount."""
+    depth = planes.shape[0] - 1
+    keep0 = planes[depth] & filter_row
+    keep = keep0
+    lo = jnp.zeros(keep.shape, jnp.uint32)
+    hi = jnp.zeros(keep.shape, jnp.uint32)
+    for i in range(depth - 1, -1, -1):
+        ones = keep & planes[i]
+        has1 = ones != 0
+        keep = jnp.where(has1, ones, keep)
+        bit = jnp.where(has1, jnp.uint32(1 << min(i, 31) if i < 31 else 1 << (i - 31)), jnp.uint32(0))
+        if i < 31:
+            lo = lo | bit
+        else:
+            hi = hi | bit
+    valid = keep0 != 0
+    zero = jnp.uint32(0)
+    max_hi = jnp.max(jnp.where(valid, hi, zero))
+    in_hi = valid & (hi == max_hi)
+    max_lo = jnp.max(jnp.where(in_hi, lo, zero))
+    attain = in_hi & (lo == max_lo)
+    count = jnp.sum(
+        jnp.where(attain, jax.lax.population_count(keep).astype(jnp.int32), 0)
+    )
+    return max_hi, max_lo, count

@@ -785,8 +785,10 @@ class MeshEngine:
         is_min: bool,
         broadcast: bool = True,
     ):
-        """BSI Min/Max dispatch with the (flags, counts) result left on
-        device: returns (dev, canonical, depth, bsig) or None."""
+        """BSI Min/Max dispatch with the per-shard (hi, lo, counts)
+        result left on device (value = (hi << 31) | lo — split halves
+        because bit_depth reaches 63 with x64 off): returns
+        (dev, canonical, depth, bsig) or None."""
         if broadcast and self._peerless_multiproc:
             return None
         idx = self.holder.index(index)
@@ -845,7 +847,7 @@ class MeshEngine:
         if res is None:
             return 0, 0
         dev, canonical, depth, bsig = res
-        flags, counts = jax.device_get(dev)
+        his, los, counts = jax.device_get(dev)
         # Reduce like ValCount.smaller/larger (executor.go:2652-2696):
         # strictly-better value wins; ties keep the first shard's count.
         # The mask zeroed non-requested shards' filters, so their counts
@@ -855,7 +857,7 @@ class MeshEngine:
             n = int(counts[si])
             if n == 0:
                 continue
-            val = sum(1 << i for i in range(depth) if flags[si, i])
+            val = (int(his[si]) << 31) | int(los[si])
             if best_n == 0 or (val < best_val if is_min else val > best_val):
                 best_val, best_n = val, n
         if best_n == 0:

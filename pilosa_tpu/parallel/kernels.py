@@ -281,21 +281,24 @@ def sum_tree(mesh, prog, specs, pspec, mask, plane_mat, *operands):
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
-    """BSI Min/Max in ONE dispatch: per-shard plane walks
-    (fragment.go min/max :745-806) -> (flags int32[S, D],
-    counts int32[S]), replicated for the host ValCount reduce."""
+    """BSI Min/Max in ONE dispatch: word-local per-shard walks
+    (fragment.go min/max :745-806 re-founded as bsi.min_valcount — no
+    per-plane reduction barriers, one fused pass over the planes) ->
+    (hi uint32[S], lo uint32[S], counts int32[S]) with
+    value = (hi << 31) | lo, replicated for the host ValCount reduce."""
 
     def body(m, pm, *ops):
         f = _filter(prog, m, ops)
         p = gather_planes(pm, pspec)
         fb = jnp.broadcast_to(f, p.shape[1:])
-        fn = bsi_ops.min_flags if is_min else bsi_ops.max_flags
-        flags, counts = jax.vmap(fn, in_axes=(1, 0))(p, fb)
+        fn = bsi_ops.min_valcount if is_min else bsi_ops.max_valcount
+        hi, lo, counts = jax.vmap(fn, in_axes=(1, 0))(p, fb)
         # Replicated (see topn_tree/replicate_shards): the host ValCount
-        # reduce needs EVERY shard's flags, including remote processes'.
+        # reduce needs EVERY shard's value, including remote processes'.
         n_dev = mesh.shape[SHARD_AXIS]
         return (
-            replicate_shards(flags.astype(jnp.int32), n_dev, axis=0),
+            replicate_shards(hi, n_dev, axis=0),
+            replicate_shards(lo, n_dev, axis=0),
             replicate_shards(counts, n_dev, axis=0),
         )
 
@@ -303,7 +306,7 @@ def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
         body,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS)) + specs,
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()),
     )(mask, plane_mat, *operands)
 
 

@@ -235,3 +235,53 @@ def test_bsi_kernel_edges(edge):
     assert cols_of(bsi.range_gt(planes, pb, False)) == sorted(
         c for c, v in values.items() if v > edge
     )
+
+
+def test_min_max_valcount_oracle():
+    """Word-local Min/Max walk (bsi.min_valcount/max_valcount, the
+    production kernels) vs a per-column oracle — random depths INCLUDING
+    > 31, where the value must split into (hi << 31) | lo halves (a
+    single int32 accumulator overflows; x64 is off on device)."""
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops import bsi
+
+    rng = np.random.default_rng(5)
+    W = 64
+    depths = [1, 3, 8, 31, 33, 40, 63]
+    for trial, depth in enumerate(depths * 2):
+        planes = (
+            rng.integers(0, 1 << 32, size=(depth + 1, W), dtype=np.uint64)
+            .astype(np.uint32)
+        )
+        if trial % 7 == 0:
+            planes[depth] = 0  # nothing considered
+        if trial % 2:
+            filt = np.full(W, 0xFFFFFFFF, dtype=np.uint32)
+        else:
+            filt = rng.integers(0, 1 << 32, size=W, dtype=np.uint64).astype(
+                np.uint32
+            )
+        jp, jf = jnp.asarray(planes), jnp.asarray(filt)
+        vals = {}
+        for w in range(W):
+            for b in range(32):
+                if (planes[depth][w] >> b) & 1 and (filt[w] >> b) & 1:
+                    v = sum(
+                        ((int(planes[i][w]) >> b) & 1) << i
+                        for i in range(depth)
+                    )
+                    vals[v] = vals.get(v, 0) + 1
+        hi, lo, mc = bsi.min_valcount(jp, jf)
+        mn = (int(hi) << 31) | int(lo)
+        xhi, xlo, xc = bsi.max_valcount(jp, jf)
+        mx = (int(xhi) << 31) | int(xlo)
+        if vals:
+            assert mn == min(vals) and int(mc) == vals[min(vals)], (
+                depth, mn, min(vals),
+            )
+            assert mx == max(vals) and int(xc) == vals[max(vals)], (
+                depth, mx, max(vals),
+            )
+        else:
+            assert int(mc) == 0 and int(xc) == 0
