@@ -135,9 +135,11 @@ class API:
         )
         self.mesh_engine = mesh_engine
         # Multi-host collective replay worker (lazy; see
-        # mesh_collective_accept).
+        # mesh_collective_accept).  ``_mesh_pending`` holds accepted-but-
+        # uncommitted two-phase dispatches: did -> (payload, expiry Timer).
         self._mesh_replay_q = None
         self._mesh_replay_lock = threading.Lock()
+        self._mesh_pending: Dict[str, tuple] = {}
         if cluster is not None:
             self.attach_cluster(cluster, node)
 
@@ -694,6 +696,18 @@ class API:
     def get_translate_data(self, offset: int) -> bytes:
         return self.translate_store.reader(offset)
 
+    # Accepted-but-uncommitted dispatches expire after this many seconds:
+    # an initiator that died between accept and commit must not leave a
+    # pending entry (let alone a dispatched collective) behind.  Must
+    # comfortably exceed the initiator's whole accept fan-out (35 s/peer
+    # waits, server._broadcast_dispatch) so a slow-but-successful handoff
+    # can never race its own expiry.
+    MESH_PENDING_TIMEOUT = 120.0
+    # Replay readbacks wait at most this long for the collective to
+    # complete before the worker moves on (a stuck psum is logged, not a
+    # permanent wedge of the replay worker).
+    MESH_REPLAY_TIMEOUT = 120.0
+
     def mesh_collective_accept(self, payload: dict):
         """Accept a multi-host collective dispatch descriptor from a peer
         (route /internal/mesh/dispatch): validate NOW (so a bad dispatch
@@ -702,7 +716,16 @@ class API:
         deterministic lowering over identical holder state yields the
         identical program, so the cross-process rendezvous completes
         (parallel/multihost.py).  Kinds mirror the engine's fused paths:
-        count / sum / minmax / topn / topn_scores / group."""
+        count / sum / minmax / topn / topn_scores / group.
+
+        Handoff is two-phase (server._broadcast_dispatch): ``phase:
+        "accept"`` validates and registers the dispatch under its ``did``
+        without entering it; ``"commit"`` moves it to the replay queue;
+        ``"abort"`` (or expiry) drops it.  A payload with no ``did`` is a
+        direct single-phase dispatch (in-process callers/tests)."""
+        phase = payload.get("phase", "accept")
+        if phase in ("commit", "abort"):
+            return self._mesh_collective_resolve(payload, phase)
         if self.mesh_engine is None:
             raise ApiError("mesh engine not available")
         from . import pql as pql_mod
@@ -724,6 +747,21 @@ class API:
         idx = self.holder.index(payload.get("index", ""))
         if idx is None:
             raise NotFoundError(f"index not found: {payload.get('index')}")
+        # Data-plane parity: the replay recomputes the canonical shard
+        # axis from the LOCAL holder, so a shard created on the initiator
+        # but not yet gossiped here would yield mismatched collective
+        # shapes across processes — a hang instead of an error.  The
+        # initiator ships its canonical list; reject divergence NOW so
+        # its fan-out fails with a clean 400 (same pattern as the pinned
+        # TopN candidate set).
+        canon = payload.get("canon")
+        if canon is not None:
+            mine = self.mesh_engine.canonical_shards(payload["index"])
+            if [int(s) for s in canon] != [int(s) for s in mine]:
+                raise ApiError(
+                    f"canonical shard axis diverged: initiator={canon} "
+                    f"local={mine} (retry after anti-entropy)"
+                )
         # Field existence/type checks: a replay that silently declines to
         # dispatch (e.g. unknown field -> None) would strand the
         # initiator's collective, so reject at accept time.
@@ -752,6 +790,21 @@ class API:
             if len(q.calls) != 1:
                 raise ApiError("collective dispatch carries exactly one call")
             payload["_calls"][key] = q.calls[0]
+        self._ensure_mesh_worker()
+        did = payload.get("did")
+        if did is None:
+            self._mesh_replay_q.put(payload)  # single-phase (in-process)
+            return True
+        timer = threading.Timer(
+            self.MESH_PENDING_TIMEOUT, self._mesh_pending_expire, args=(did,)
+        )
+        timer.daemon = True
+        with self._mesh_replay_lock:
+            self._mesh_pending[did] = (payload, timer)
+        timer.start()
+        return True
+
+    def _ensure_mesh_worker(self):
         with self._mesh_replay_lock:
             if self._mesh_replay_q is None:
                 import queue as queue_mod
@@ -762,8 +815,30 @@ class API:
                     name="mesh-replay",
                 )
                 t.start()
-        self._mesh_replay_q.put(payload)
+
+    def _mesh_collective_resolve(self, payload: dict, phase: str):
+        """Commit or abort a pending two-phase dispatch."""
+        did = payload.get("did")
+        with self._mesh_replay_lock:
+            entry = self._mesh_pending.pop(did, None)
+        if entry is None:
+            if phase == "abort":
+                return True  # abort of an unknown/expired did is a no-op
+            raise ApiError(f"unknown or expired dispatch: {did}")
+        pending, timer = entry
+        timer.cancel()
+        if phase == "commit":
+            self._mesh_replay_q.put(pending)
         return True
+
+    def _mesh_pending_expire(self, did: str):
+        with self._mesh_replay_lock:
+            entry = self._mesh_pending.pop(did, None)
+        if entry is not None:
+            self.logger.printf(
+                "mesh dispatch %s expired uncommitted (initiator died "
+                "mid-handoff?); dropped without dispatching", did
+            )
 
     def _mesh_replay_loop(self):
         """Replays peer dispatches in arrival order (the initiating node
@@ -777,7 +852,37 @@ class API:
                 with self.mesh_engine.collective_lock:
                     dev = self._mesh_replay_dispatch(payload)
                 if dev is not None:
-                    jax.device_get(dev)
+                    # Bounded wait: a collective some process never joins
+                    # (e.g. commit reached us but not a third peer) must
+                    # not wedge the replay worker forever.  device_get is
+                    # uncancellable, so it waits on a side thread; on
+                    # timeout the worker logs and moves on (the leaked
+                    # thread ends if/when the runtime unsticks).  Errors
+                    # inside the thread are captured and logged here — a
+                    # bare thread would route them to excepthook/stderr,
+                    # invisible to the server logger.
+                    err: list = []
+
+                    def _get():
+                        try:
+                            jax.device_get(dev)
+                        except Exception as e:  # noqa: BLE001
+                            err.append(e)
+
+                    waiter = threading.Thread(target=_get, daemon=True)
+                    waiter.start()
+                    waiter.join(self.MESH_REPLAY_TIMEOUT)
+                    if waiter.is_alive():
+                        self.logger.printf(
+                            "mesh replay collective STUCK >%ss (peer "
+                            "missing from rendezvous?): %r",
+                            self.MESH_REPLAY_TIMEOUT,
+                            {k: v for k, v in payload.items() if k != "_calls"},
+                        )
+                    elif err:
+                        self.logger.printf(
+                            "mesh replay readback failed: %s", err[0]
+                        )
                 else:
                     # The initiator dispatched and is blocked in its
                     # collective; a declined replay strands it.  Accept-

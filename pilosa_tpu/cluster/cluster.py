@@ -387,6 +387,8 @@ class Cluster:
                     continue
                 instruction = {
                     "type": "resize-instruction",
+                    "node": node.to_dict(),
+                    "coordinator": self.node.to_dict(),
                     "sources": [
                         {
                             "uri": s.node.uri,
@@ -412,7 +414,12 @@ class Cluster:
         """Schema + per-field available shards (server.go NodeStatus
         :626-674) — exchanged on join and periodically so every node can
         route queries to shards it doesn't hold."""
-        status = {"type": "node-status", "indexes": {}, "tombstones": []}
+        status = {
+            "type": "node-status",
+            "node": self.node.to_dict(),
+            "indexes": {},
+            "tombstones": [],
+        }
         if self.holder is None:
             return status
         # Deleted-schema tombstones travel with the status so a peer that
@@ -425,6 +432,7 @@ class Cluster:
                 fields[fname] = {
                     "options": f.options.to_dict(),
                     "cid": f.creation_id,
+                    "views": sorted(f.views.keys()),
                     "availableShards": [int(s) for s in f.available_shards()],
                 }
             status["indexes"][name] = {

@@ -54,12 +54,19 @@ class InternalClient:
     def _get(self, path: str, raw: bool = False):
         return self._do("GET", path, raw=raw)
 
-    def _post(self, path: str, doc=None, body: Optional[bytes] = None, raw: bool = False):
+    def _post(
+        self,
+        path: str,
+        doc=None,
+        body: Optional[bytes] = None,
+        raw: bool = False,
+        content_type: Optional[str] = None,
+    ):
         if body is None:
             body = json.dumps(doc if doc is not None else {}).encode()
             ctype = "application/json"
         else:
-            ctype = "application/octet-stream"
+            ctype = content_type or "application/octet-stream"
         return self._do("POST", path, body, ctype, raw=raw)
 
     # -- queries (http/client.go Query/QueryNode :217-266) -----------------
@@ -216,6 +223,7 @@ class InternalClient:
         self._post(
             "/internal/cluster/message",
             body=privproto.marshal_cluster_message(msg),
+            content_type="application/x-protobuf",
         )
 
     def nodes(self) -> list:

@@ -378,6 +378,14 @@ def _decode_cluster_status(r: _Reader) -> dict:
 
 def _encode_resize_instruction(msg: dict) -> bytes:
     out = _sint_field(1, int(msg.get("jobId", 0)))
+    # Target node (field 2) and coordinator (field 3) identity, as the
+    # reference's ResizeInstruction carries (private.proto); Schema (5)
+    # and ClusterStatus (6) are NOT emitted — handlers here converge
+    # schema via the NodeStatus exchange instead (see module docstring).
+    if msg.get("node"):
+        out += _len_field(2, _encode_node(msg["node"]))
+    if msg.get("coordinator"):
+        out += _len_field(3, _encode_node(msg["coordinator"]))
     for s in msg.get("sources", []):
         src = b""
         if s.get("uri"):
@@ -396,6 +404,10 @@ def _decode_resize_instruction(r: _Reader) -> dict:
         f, w = r.tag()
         if f == 1:
             msg["jobId"] = _to_int64(r.uvarint())
+        elif f == 2:
+            msg["node"] = _decode_node(r.bytes_())
+        elif f == 3:
+            msg["coordinator"] = _decode_node(r.bytes_())
         elif f == 4:
             sr = _Reader(r.bytes_())
             src = {"uri": "", "index": "", "field": "", "view": "", "shard": 0}
@@ -420,9 +432,10 @@ def _decode_resize_instruction(r: _Reader) -> dict:
 
 
 def _encode_node_status(msg: dict) -> bytes:
-    """NodeStatus (private.proto:116-130): Schema carries names + options
-    (+ our cids at 101), IndexStatus/FieldStatus carry availableShards;
-    tombstones are extension field 100."""
+    """NodeStatus (private.proto:116-130): sender Node at field 1, Schema
+    carries names + options + view names (+ our cids at 101),
+    IndexStatus/FieldStatus carry availableShards; tombstones are
+    extension field 100."""
     schema_b = b""
     statuses = b""
     for iname, info in msg.get("indexes", {}).items():
@@ -435,6 +448,8 @@ def _encode_node_status(msg: dict) -> bytes:
         for fname, finfo in info.get("fields", {}).items():
             f_b = _str_field(1, fname)
             f_b += _len_field(2, _encode_field_options(finfo.get("options", {})))
+            for vname in finfo.get("views", []):
+                f_b += _str_field_always(3, vname)
             f_b += _str_field(101, finfo.get("cid", ""))
             idx_b += _len_field(4, f_b)
             fs_b = _str_field(1, fname)
@@ -442,7 +457,10 @@ def _encode_node_status(msg: dict) -> bytes:
             st_b += _len_field(2, fs_b)
         schema_b += _len_field(1, idx_b)
         statuses += _len_field(4, st_b)
-    out = _len_field(3, schema_b) + statuses
+    out = b""
+    if msg.get("node"):
+        out += _len_field(1, _encode_node(msg["node"]))
+    out += _len_field(3, schema_b) + statuses
     for t in msg.get("tombstones", []):
         out += _str_field(100, t)
     return out
@@ -453,7 +471,9 @@ def _decode_node_status(r: _Reader) -> dict:
     shards_by_index: Dict[str, Dict[str, List[int]]] = {}
     while not r.eof():
         f, w = r.tag()
-        if f == 3:  # Schema
+        if f == 1:  # sender Node
+            msg["node"] = _decode_node(r.bytes_())
+        elif f == 3:  # Schema
             sr = _Reader(r.bytes_())
             while not sr.eof():
                 sf, sw = sr.tag()
@@ -476,6 +496,8 @@ def _decode_node_status(r: _Reader) -> dict:
                                 fname = fr.str_()
                             elif ff == 2:
                                 finfo["options"] = _decode_field_options(fr.bytes_())
+                            elif ff == 3:
+                                finfo.setdefault("views", []).append(fr.str_())
                             elif ff == 101:
                                 finfo["cid"] = fr.str_()
                             else:

@@ -138,7 +138,7 @@ class Handler:
             if m is None:
                 continue
             try:
-                result = route.fn(query, body, **m.groupdict())
+                result = route.fn(query, body, _headers=headers, **m.groupdict())
             except (NotFoundError, IndexNotFoundError, FieldNotFoundError) as e:
                 return 404, "application/json", json.dumps({"error": str(e)}).encode()
             except (ApiError, ExecError, ParseError, TranslateError, ValueError) as e:
@@ -390,8 +390,12 @@ class Handler:
             raise ValueError("a profiler trace is already running")
         try:
             jax.profiler.start_trace(trace_dir)
-            time_mod.sleep(seconds)
-            jax.profiler.stop_trace()
+            try:
+                time_mod.sleep(seconds)
+            finally:
+                # stop unconditionally: a profiler left running would fail
+                # every later trace request with "already started".
+                jax.profiler.stop_trace()
         finally:
             Handler._pprof_trace_lock.release()
         return {"traceDir": trace_dir, "seconds": seconds}
@@ -402,10 +406,28 @@ class Handler:
         byte '{') still accepted."""
         from . import privproto
 
-        if b and b[0] <= 31:
+        # Content-Type is authoritative when present (internal clients
+        # label frames x-protobuf); the byte sniff is the fallback for
+        # unlabeled peers.  Type bytes occupy 0-15 — but \t/\n/\r
+        # (9/10/13) also start whitespace-padded JSON, so the sniff
+        # requires a parseable frame for those ambiguous bytes.
+        ctype = kw.get("_headers", {}).get("Content-Type", "")
+        if "protobuf" in ctype:
             self.api.cluster_message(privproto.unmarshal_cluster_message(b))
-        else:
+        elif "json" in ctype or not b or b[0] >= 16:
             self.api.cluster_message(json.loads(b))
+        elif b[0] in (9, 10, 13):
+            # JSON first: whitespace-padded JSON always parses, while a
+            # genuine type-9/10/13 frame never does (its payload is
+            # protobuf or empty) — the reverse order would let type 13's
+            # permissive empty decoder swallow JSON bodies.
+            try:
+                msg = json.loads(b)
+            except ValueError:
+                msg = privproto.unmarshal_cluster_message(b)
+            self.api.cluster_message(msg)
+        else:
+            self.api.cluster_message(privproto.unmarshal_cluster_message(b))
         return {}
 
     def _fragment_blocks(self, q, b, **kw):

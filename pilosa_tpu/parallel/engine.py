@@ -630,7 +630,8 @@ class MeshEngine:
             raise PeerlessMeshError("multi-process mesh without peer broadcast")
         return self._collective(
             "count",
-            {"index": index, "query": str(c), "shards": list(shards)},
+            {"index": index, "query": str(c), "shards": list(shards),
+             "canon": [int(x) for x in canonical]},
             lambda: self._dispatch_count(index, c, shards, canonical),
             broadcast,
         )
@@ -757,6 +758,7 @@ class MeshEngine:
                 "field": field_name,
                 "filter": None if filter_call is None else str(filter_call),
                 "shards": list(shards),
+                "canon": [int(x) for x in canonical],
             },
             dispatch,
             broadcast,
@@ -826,6 +828,7 @@ class MeshEngine:
                 "filter": None if filter_call is None else str(filter_call),
                 "shards": list(shards),
                 "isMin": bool(is_min),
+                "canon": [int(x) for x in canonical],
             },
             dispatch,
             broadcast,
@@ -919,6 +922,7 @@ class MeshEngine:
                 "rows": [int(r) for r in candidate_rows],
                 "src": str(src_call),
                 "shards": list(shards),
+                "canon": [int(x) for x in stack.shards],
             },
             dispatch,
             broadcast,
@@ -1094,6 +1098,7 @@ class MeshEngine:
                 "minThreshold": int(min_threshold),
                 "rowIds": None if not row_ids else [int(r) for r in row_ids],
                 "cands": [int(c) for c in entry.cands],
+                "canon": [int(x) for x in stack.shards],
             },
             dispatch,
             broadcast,
@@ -1265,6 +1270,7 @@ class MeshEngine:
                 "rows": [[int(r) for r in rows] for rows in row_lists],
                 "filter": None if filter_call is None else str(filter_call),
                 "shards": list(shards),
+                "canon": [int(x) for x in canonical],
             },
             dispatch,
             broadcast,

@@ -174,34 +174,57 @@ class Server:
             return None
 
     def _broadcast_dispatch(self, kind, payload):
-        """Synchronously hand a collective dispatch descriptor to every
-        peer server.  Peers validate + enqueue and answer in one RTT
-        (the replay runs on their worker thread), so waiting here is
-        cheap — and a peer that is down or rejects the dispatch raises
-        NOW, failing the query fast instead of leaving this process
-        blocked forever in a collective no peer will join."""
+        """Two-phase handoff of a collective dispatch descriptor to every
+        peer server.  Phase 1 (accept): peers validate and REGISTER the
+        dispatch but do not enter it — a peer that is down or rejects
+        raises NOW, and the others get an abort, so a partial fan-out can
+        never strand anyone in a collective no peer will join.  Phase 2
+        (commit): sent only after every peer accepted; peers then enqueue
+        the replay.  A peer that accepted but never hears a commit (this
+        process died mid-handoff) expires its pending entry instead of
+        dispatching (api.MESH_PENDING_TIMEOUT)."""
         import urllib.request
 
-        body = json.dumps(dict(payload, kind=kind)).encode()
+        did = uuid.uuid4().hex
 
-        def post(url):
+        def post(url, body):
             req = urllib.request.Request(
                 f"{url}/internal/mesh/dispatch", data=body, method="POST"
             )
             req.add_header("Content-Type", "application/json")
             urllib.request.urlopen(req, timeout=30).read()
 
-        futures = [
-            self._mesh_pool.submit(post, url) for url in self.config.mesh_peers
-        ]
-        errs = []
-        for url, f in zip(self.config.mesh_peers, futures):
-            try:
-                f.result(timeout=35)
-            except Exception as e:
-                errs.append(f"{url}: {e}")
+        def fanout(body):
+            futures = [
+                self._mesh_pool.submit(post, url, body)
+                for url in self.config.mesh_peers
+            ]
+            errs = []
+            for url, f in zip(self.config.mesh_peers, futures):
+                try:
+                    f.result(timeout=35)
+                except Exception as e:
+                    errs.append(f"{url}: {e}")
+            return errs
+
+        accept = json.dumps(
+            dict(payload, kind=kind, did=did, phase="accept")
+        ).encode()
+        errs = fanout(accept)
         if errs:
+            # Release the peers that DID accept; best-effort — a peer the
+            # abort misses expires the pending entry on its own timer.
+            fanout(json.dumps({"did": did, "phase": "abort"}).encode())
             raise RuntimeError(f"mesh peers unavailable: {'; '.join(errs)}")
+        errs = fanout(json.dumps({"did": did, "phase": "commit"}).encode())
+        if errs:
+            # Commits are idempotent-or-expired: peers the commit missed
+            # time out and abort; peers it reached replay a collective
+            # this process must NOT join (it would complete without the
+            # timed-out peer only by luck) — so fail the query loudly.
+            raise RuntimeError(
+                f"mesh commit failed (peers will expire): {'; '.join(errs)}"
+            )
 
     def _setup_cluster(self, host: str, port: int):
         """Wire the cluster when hosts or gossip seeds are configured

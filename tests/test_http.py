@@ -164,6 +164,36 @@ def test_cluster_message_schema_sync(server):
     assert api.holder.index("remote_idx") is not None
 
 
+def test_cluster_message_content_type_routing(server):
+    """JSON bodies that start with whitespace (\\t=9 \\n=10 \\r=13 — all
+    valid privproto type bytes) must not be sniffed as protobuf frames;
+    labeled protobuf frames with those type bytes must still decode
+    (round-4 ADVICE)."""
+    import urllib.request
+
+    api, client = server
+
+    def post(body, ctype=None):
+        req = urllib.request.Request(
+            f"{client.uri}/internal/cluster/message", data=body, method="POST"
+        )
+        if ctype:
+            req.add_header("Content-Type", ctype)
+        return urllib.request.urlopen(req, timeout=10).read()
+
+    # Whitespace-padded JSON, labeled and unlabeled.
+    body = b'\n\t{"type": "create-index", "index": "ws_idx", "meta": {}}'
+    post(body, "application/json")
+    assert api.holder.index("ws_idx") is not None
+    post(b'\r\n{"type": "create-index", "index": "ws2_idx", "meta": {}}')
+    assert api.holder.index("ws2_idx") is not None
+    # A labeled protobuf frame whose type byte is 13 (recalculate-caches
+    # == \r) must go to the privproto decoder, not json.loads.
+    post(b"\x0d", "application/x-protobuf")
+    # And unlabeled type-13 frames still decode via the sniff fallback.
+    post(b"\x0d")
+
+
 def test_cluster_message_delete_redelivery_is_safe(server):
     """Gossip delivery is at-least-once and unordered: a delete-field
     redelivered after the field was recreated must NOT destroy the new

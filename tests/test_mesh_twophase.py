@@ -1,0 +1,196 @@
+"""Two-phase collective handoff (round-4 ADVICE: a failed peer POST must
+never strand peers that already accepted in a psum no one joins).
+
+Peer side: accept registers without dispatching; commit moves the
+dispatch to the replay queue; abort (or expiry) drops it; a commit for an
+unknown/expired did is a clean error.  Accept also validates data-plane
+parity — the initiator's canonical shard axis must match the local one —
+and the initiator fans out accept/commit/abort in the right order
+(exercised against stub HTTP peers)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API, ApiError
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture
+def api(mesh, tmp_path):
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    rows, cols = [], []
+    for s in range(4):
+        for c in range(100):
+            rows.append(1)
+            cols.append(s * SHARD_WIDTH + c)
+    f.import_bulk(rows, cols)
+    return API(holder=h, mesh_engine=MeshEngine(h, mesh))
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+COUNT_PAYLOAD = {
+    "kind": "count",
+    "index": "i",
+    "query": "Row(f=1)",
+    "shards": [0, 1, 2, 3],
+}
+
+
+def test_accept_does_not_dispatch_until_commit(api):
+    assert api.mesh_collective_accept(dict(COUNT_PAYLOAD, did="d1"))
+    time.sleep(0.3)
+    assert api.mesh_engine.fused_dispatches == 0
+    assert "d1" in api._mesh_pending
+    assert api.mesh_collective_accept({"did": "d1", "phase": "commit"})
+    assert _wait(lambda: api.mesh_engine.fused_dispatches == 1)
+    assert "d1" not in api._mesh_pending
+
+
+def test_abort_drops_pending(api):
+    api.mesh_collective_accept(dict(COUNT_PAYLOAD, did="d2"))
+    assert api.mesh_collective_accept({"did": "d2", "phase": "abort"})
+    time.sleep(0.3)
+    assert api.mesh_engine.fused_dispatches == 0
+    assert "d2" not in api._mesh_pending
+    # Abort of an unknown did is a no-op, not an error (retries race).
+    assert api.mesh_collective_accept({"did": "nope", "phase": "abort"})
+
+
+def test_commit_unknown_did_rejected(api):
+    with pytest.raises(ApiError, match="unknown or expired"):
+        api.mesh_collective_accept({"did": "never-accepted", "phase": "commit"})
+
+
+def test_pending_expires_without_commit(api):
+    api.MESH_PENDING_TIMEOUT = 0.2  # instance attr shadows the class
+    api.mesh_collective_accept(dict(COUNT_PAYLOAD, did="d3"))
+    assert _wait(lambda: "d3" not in api._mesh_pending, timeout=5.0)
+    time.sleep(0.2)
+    assert api.mesh_engine.fused_dispatches == 0
+    with pytest.raises(ApiError, match="unknown or expired"):
+        api.mesh_collective_accept({"did": "d3", "phase": "commit"})
+
+
+def test_no_did_is_single_phase(api):
+    """In-process callers (and r3-era peers) skip the handshake."""
+    api.mesh_collective_accept(dict(COUNT_PAYLOAD))
+    assert _wait(lambda: api.mesh_engine.fused_dispatches == 1)
+
+
+def test_accept_validates_canonical_shards(api):
+    ok = dict(COUNT_PAYLOAD, did="d4", canon=[0, 1, 2, 3])
+    assert api.mesh_collective_accept(ok)
+    api.mesh_collective_accept({"did": "d4", "phase": "abort"})
+    # A shard the initiator has but this node hasn't heard of yet ->
+    # mismatched collective shapes; must be a clean 400-class error.
+    bad = dict(COUNT_PAYLOAD, did="d5", canon=[0, 1, 2, 3, 4])
+    with pytest.raises(ApiError, match="canonical shard axis diverged"):
+        api.mesh_collective_accept(bad)
+    assert "d5" not in api._mesh_pending
+
+
+# -- initiator fan-out against stub peers -----------------------------------
+
+
+class _StubPeer:
+    """Records /internal/mesh/dispatch bodies; optionally rejects accepts."""
+
+    def __init__(self, fail_accept=False):
+        self.requests = []
+        self.fail_accept = fail_accept
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                )
+                stub.requests.append(body)
+                phase = body.get("phase", "accept")
+                if phase == "accept" and stub.fail_accept:
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(b'{"error":"nope"}')
+                    return
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b'{"accepted":true}')
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def phases(self):
+        return [r.get("phase", "accept") for r in self.requests]
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def _initiator(tmp_path, peers):
+    """A Server wired to stub peers — just enough for _broadcast_dispatch."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    cfg = Config()
+    cfg.data_dir = str(tmp_path / "srv")
+    cfg.mesh_peers = [p.url for p in peers]
+    srv = Server(cfg)
+    srv._mesh_pool = ThreadPoolExecutor(max_workers=4)
+    return srv
+
+
+def test_initiator_accept_then_commit(tmp_path):
+    peers = [_StubPeer(), _StubPeer()]
+    try:
+        srv = _initiator(tmp_path, peers)
+        srv._broadcast_dispatch("count", dict(COUNT_PAYLOAD))
+        for p in peers:
+            assert p.phases() == ["accept", "commit"], p.requests
+        dids = {r["did"] for p in peers for r in p.requests}
+        assert len(dids) == 1  # one did across both phases and peers
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_initiator_aborts_survivors_on_accept_failure(tmp_path):
+    good, bad = _StubPeer(), _StubPeer(fail_accept=True)
+    try:
+        srv = _initiator(tmp_path, [good, bad])
+        with pytest.raises(RuntimeError, match="mesh peers unavailable"):
+            srv._broadcast_dispatch("count", dict(COUNT_PAYLOAD))
+        # The good peer must be released: accept then abort, never commit.
+        assert good.phases() == ["accept", "abort"], good.requests
+        assert "commit" not in bad.phases()
+    finally:
+        good.close()
+        bad.close()

@@ -21,7 +21,12 @@ MESSAGES = [
     {"type": "delete-view", "index": "i", "field": "t",
      "view": "standard_201801"},
     {"type": "set-state", "state": "RESIZING"},
-    {"type": "resize-instruction", "sources": [
+    {"type": "resize-instruction",
+     "node": {"id": "n3", "uri": "http://n3:10103", "isCoordinator": True,
+              "state": "READY"},
+     "coordinator": {"id": "n1", "uri": "http://n1:10101",
+                     "isCoordinator": True, "state": "READY"},
+     "sources": [
         {"uri": "http://node1:10101", "index": "i", "field": "f",
          "view": "standard", "shard": 7},
         {"uri": "http://node2:10102", "index": "i", "field": "g",
@@ -32,11 +37,15 @@ MESSAGES = [
      "new": {"id": "n1", "uri": "http://n1:10101", "isCoordinator": True}},
     {"type": "node-state", "nodeId": "n2", "state": "READY"},
     {"type": "recalculate-caches"},
-    {"type": "node-status", "tombstones": ["dead1", "dead2"], "indexes": {
+    {"type": "node-status", "tombstones": ["dead1", "dead2"],
+     "node": {"id": "n7", "uri": "http://n7:10107", "isCoordinator": True,
+              "state": "READY"},
+     "indexes": {
         "i": {"keys": True, "cid": "ic", "fields": {
             "f": {"options": {"type": "set", "cacheType": "ranked",
                               "cacheSize": 1000},
-                  "cid": "fc", "availableShards": [0, 5, 960]},
+                  "cid": "fc", "views": ["standard", "standard_2018"],
+                  "availableShards": [0, 5, 960]},
         }},
     }},
 ]
