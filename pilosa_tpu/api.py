@@ -733,6 +733,7 @@ class API:
         kind = payload.get("kind")
         required = {
             "count": ("query",),
+            "count_batch": ("queries", "shardsList"),
             "sum": ("field",),
             "minmax": ("field", "isMin"),
             "topn": ("field", "src", "n", "minThreshold", "cands"),
@@ -790,6 +791,20 @@ class API:
             if len(q.calls) != 1:
                 raise ApiError("collective dispatch carries exactly one call")
             payload["_calls"][key] = q.calls[0]
+        if kind == "count_batch":
+            if len(payload["queries"]) != len(payload["shardsList"]):
+                raise ApiError("count_batch: queries/shardsList length mismatch")
+            if not payload["queries"]:
+                raise ApiError("count_batch: empty batch")
+            batch_calls = []
+            for text in payload["queries"]:
+                q = pql_mod.parse(text)
+                if len(q.calls) != 1:
+                    raise ApiError(
+                        "collective dispatch carries exactly one call"
+                    )
+                batch_calls.append(q.calls[0])
+            payload["_batch_calls"] = batch_calls
         self._ensure_mesh_worker()
         did = payload.get("did")
         if did is None:
@@ -911,6 +926,13 @@ class API:
 
         if kind == "count":
             return eng.count_async(index, call_of("query"), shards, broadcast=False)
+        if kind == "count_batch":
+            return eng.count_many_async(
+                index,
+                payload["_batch_calls"],
+                payload["shardsList"],
+                broadcast=False,
+            )
         if kind == "sum":
             res = eng.sum_async(
                 index, payload["field"], call_of("filter"), shards, broadcast=False

@@ -490,7 +490,42 @@ class Executor:
         if query.calls and all(c.name == "SetRowAttrs" for c in query.calls):
             return self._execute_bulk_set_row_attrs(index, query.calls, opt)
 
-        return [self._execute_call(index, c, shards, opt) for c in query.calls]
+        # Multi-call Count batching: a run of CONSECUTIVE Count() calls
+        # (pql.Query carries Calls [] and the reference executes them per
+        # request, ast.go:27) evaluates as ONE fused device dispatch —
+        # consecutive only, because a write call between two Counts must
+        # be visible to the second.
+        results: list = []
+        i = 0
+        n = len(query.calls)
+        while i < n:
+            c = query.calls[i]
+            if c.name == "Count" and self.mesh_engine is not None:
+                j = i
+                while j < n and query.calls[j].name == "Count":
+                    j += 1
+                if j - i >= 2:
+                    with self.tracer.start_span(
+                        "executor.Count", index=index, batch=j - i
+                    ):
+                        batch = self._mesh_count_many(
+                            index, query.calls[i:j], shards, opt
+                        )
+                    if batch is not None:
+                        results.extend(batch)
+                    else:
+                        # The whole run declined (remote shards, an
+                        # unlowerable tree): execute it per-call ONCE —
+                        # re-screening every suffix would be O(n^2).
+                        results.extend(
+                            self._execute_call(index, cc, shards, opt)
+                            for cc in query.calls[i:j]
+                        )
+                    i = j
+                    continue
+            results.append(self._execute_call(index, c, shards, opt))
+            i += 1
+        return results
 
     # -- dispatch (executor.go executeCall :245-295) -----------------------
 
@@ -918,7 +953,7 @@ class Executor:
         from ..parallel.engine import PeerlessMeshError
 
         try:
-            return set(local), self.mesh_engine.count(index, child, local)
+            return set(local), self.mesh_engine.batched_count(index, child, local)
         except PeerlessMeshError:
             # Multi-process mesh with no peer broadcast configured:
             # the per-shard path is the correct fallback.
@@ -926,6 +961,48 @@ class Executor:
         except ValueError:
             # Unsupported call shape: fall back to the per-shard path.
             return None
+
+    def _mesh_count_many(self, index, calls, shards, opt):
+        """A run of consecutive Count() calls as ONE batched fused
+        dispatch (engine.count_many); per-call O(1) cardinality answers
+        are peeled off first.  Returns the list of counts in call order,
+        or None to fall back to the per-call path (unsupported shapes,
+        remote shards, peerless multi-process mesh)."""
+        if self.mesh_engine is None or opt.remote:
+            return None
+        children = []
+        for c in calls:
+            if len(c.children) != 1 or not self.mesh_engine.lowerable(
+                c.children[0]
+            ):
+                return None
+            children.append(c.children[0])
+        if self.cluster is not None:
+            local = set(self._local_shards(index, shards))
+            if any(s not in local for s in shards):
+                return None  # remote shards: the per-call path splits
+        from ..parallel.engine import PeerlessMeshError
+
+        results: list = [None] * len(children)
+        rem_idx, rem_calls = [], []
+        for k, ch in enumerate(children):
+            fast = self._count_from_cardinalities(index, ch, shards)
+            if fast is not None:
+                results[k] = fast
+            else:
+                rem_idx.append(k)
+                rem_calls.append(ch)
+        if rem_calls:
+            try:
+                counts = self.mesh_engine.count_many(
+                    index, rem_calls, [list(shards)] * len(rem_calls)
+                )
+            except (PeerlessMeshError, ValueError):
+                return None
+            for k, v in zip(rem_idx, counts):
+                results[k] = v
+        self.stats.count("Count", len(calls), tags=[f"index:{index}"])
+        return results
 
     def _bsi_shard_ctx(self, index, c: Call, shard: int):
         """(fragment, bsig, filter_words) for Sum/Min/Max shard kernels."""

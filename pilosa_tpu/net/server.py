@@ -552,7 +552,13 @@ def serve(
     cls = type(
         "_BoundHandler", (_HTTPRequestHandler,), {"handler": handler}
     )
-    srv = ThreadingHTTPServer((host, port), cls)
+    # Serving tier: bursts of concurrent clients (the micro-batcher's
+    # whole point) must not get connection-reset by the stdlib default
+    # listen backlog of 5.
+    srv_cls = type(
+        "_PilosaHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 128}
+    )
+    srv = srv_cls((host, port), cls)
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
     return srv, thread

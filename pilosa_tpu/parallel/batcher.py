@@ -1,0 +1,138 @@
+"""Cross-request Count micro-batcher.
+
+The reference amortizes small queries with goroutines over shared mmap'd
+fragments (executor.go mapReduce :2183) — concurrency is nearly free, so
+100 concurrent Counts cost ~one Count.  On an accelerator the analogous
+amortization must happen BEFORE program launch: each JAX dispatch pays a
+fixed floor (~100-400 us through the dispatch queue), so 100 concurrent
+single-Count HTTP requests executed one dispatch each would serialize
+100 floors.  This batcher drains concurrent arrivals into ONE
+kernels.count_batch_tree dispatch: K answers for one floor + one
+readback.
+
+Policy: pass-through when idle (a lone query runs on its own thread with
+zero added latency — exactly the unbatched path), batch under load (while
+a dispatch is in flight, arrivals queue; the worker drains the whole
+queue into one fused program when the device frees up).  This is
+batching-by-backpressure: no artificial delay window, batch size adapts
+to the actual concurrency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+
+class _Item:
+    __slots__ = ("index", "call", "shards", "event", "result", "error")
+
+    def __init__(self, index, call, shards):
+        self.index = index
+        self.call = call
+        self.shards = shards
+        self.event = threading.Event()
+        self.result: Optional[int] = None
+        self.error: Optional[BaseException] = None
+
+
+class CountBatcher:
+    # Bail out of a wait after this long — the worker catches all
+    # exceptions, so a hit means the engine itself wedged (e.g. a stuck
+    # collective); surface an error instead of blocking the HTTP thread
+    # forever.
+    WAIT_TIMEOUT = 300.0
+
+    def __init__(self, engine, max_batch: int = 256):
+        self.engine = engine
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[_Item] = []
+        self._busy = False
+        self._worker: Optional[threading.Thread] = None
+        # Telemetry the QPS bench and tests assert on.
+        self.batches = 0
+        self.batched_queries = 0
+
+    def submit(self, index: str, call, shards) -> int:
+        """Count one tree; returns the count.  Lone callers run directly
+        (no handoff); callers arriving while a dispatch is in flight are
+        queued and answered from the next fused batch."""
+        with self._lock:
+            if not self._busy and not self._queue:
+                self._busy = True
+                direct = True
+            else:
+                item = _Item(index, call, list(shards))
+                self._queue.append(item)
+                self._ensure_worker()
+                direct = False
+        if direct:
+            try:
+                return self.engine.count(index, call, shards)
+            finally:
+                with self._lock:
+                    self._busy = False
+                    if self._queue:
+                        self._cond.notify_all()
+        if not item.event.wait(self.WAIT_TIMEOUT):
+            raise RuntimeError("batched count timed out (engine wedged?)")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True, name="count-batcher"
+            )
+            self._worker.start()
+
+    def _worker_loop(self):
+        while True:
+            with self._lock:
+                while self._busy or not self._queue:
+                    self._cond.wait(timeout=60.0)
+                batch = self._queue[: self.max_batch]
+                del self._queue[: len(batch)]
+                self._busy = True
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._lock:
+                    self._busy = False
+                    if self._queue:
+                        self._cond.notify_all()
+
+    def _run_batch(self, batch: List[_Item]):
+        # One dispatch per index present in the drain (operand lists are
+        # per-index; mixed-index drains are rare and still amortize).
+        by_index = {}
+        for it in batch:
+            by_index.setdefault(it.index, []).append(it)
+        for index, items in by_index.items():
+            try:
+                res = self.engine.count_many(
+                    index,
+                    [it.call for it in items],
+                    [it.shards for it in items],
+                )
+                self.batches += 1
+                self.batched_queries += len(items)
+                for it, r in zip(items, res):
+                    it.result = int(r)
+            except Exception:
+                # One bad tree (unlowerable shape, unknown field) must
+                # not fail its batchmates: retry each alone, attributing
+                # errors to their own submitters.
+                for it in items:
+                    try:
+                        it.result = self.engine.count(
+                            it.index, it.call, it.shards
+                        )
+                    except BaseException as e:  # noqa: BLE001
+                        it.error = e
+            finally:
+                for it in items:
+                    it.event.set()

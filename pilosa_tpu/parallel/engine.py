@@ -115,6 +115,19 @@ class _Lowering:
         self.specs.append(P())
         return len(self.operands) - 1
 
+    def add_mask(self, mask) -> int:
+        """Requested-shard mask operand (uint32[S, 1], sharded), deduped
+        by identity — _mask_words caches per bitset so batched queries
+        over the same shard subset share one operand."""
+        key = id(mask)
+        i = self._mat_ids.get(key)
+        if i is None:
+            i = len(self.operands)
+            self.operands.append(mask)
+            self.specs.append(P(SHARD_AXIS))
+            self._mat_ids[key] = i
+        return i
+
 
 DEFAULT_RESIDENCY_BYTES = 8 << 30  # HBM budget for resident field stacks
 
@@ -187,6 +200,9 @@ class MeshEngine:
         # cross-node concurrent initiation is not globally ordered.
         self.collective_broadcast = None
         self.collective_lock = threading.Lock()
+        # Lazy cross-request Count micro-batcher (parallel/batcher.py).
+        self._batcher = None
+        self._batcher_lock = threading.Lock()
         # Count/Sum/Min/Max/fused-TopN/TopN-scorer/GroupBy all replay on
         # peers; without a configured broadcast on a multi-process mesh
         # every fused path falls back to the per-shard host path instead
@@ -661,6 +677,87 @@ class MeshEngine:
         self.fused_dispatches += 1
         return kernels.count_tree(
             self.mesh, prog, tuple(lw.specs), mask, *lw.operands
+        )
+
+    # -- batched multi-query dispatch ---------------------------------------
+
+    _LOWERABLE = frozenset(
+        ("Row", "Union", "Intersect", "Difference", "Xor", "Not", "Range")
+    )
+
+    def lowerable(self, c: Call) -> bool:
+        """Static pre-screen: every call name in the tree has a lowering.
+        Argument-shape errors (missing row id, unknown field) still
+        surface at lower time; this keeps obviously-host-path calls
+        (Shift, All, ...) out of batch candidates."""
+        if c.name not in self._LOWERABLE:
+            return False
+        return all(self.lowerable(ch) for ch in c.children)
+
+    def batched_count(self, index: str, c: Call, shards) -> int:
+        """Count(tree) through the cross-request micro-batcher: lone
+        callers run the plain fused path; concurrent callers drain into
+        one count_batch_tree dispatch (parallel/batcher.py)."""
+        if self._batcher is None:
+            with self._batcher_lock:
+                if self._batcher is None:
+                    from .batcher import CountBatcher
+
+                    self._batcher = CountBatcher(self)
+        return self._batcher.submit(index, c, shards)
+
+    def count_many(self, index: str, calls, shards_list) -> List[int]:
+        """K Count(tree) queries in ONE fused dispatch + ONE readback
+        (kernels.count_batch_tree).  ``shards_list[i]`` is query i's
+        requested shard subset.  The K-for-one dispatch amortizes the
+        per-program dispatch floor — the reference gets the same effect
+        from goroutines sharing one mmap'd fragment set; on an
+        accelerator the batching must happen before the program launch."""
+        dev = self.count_many_async(index, calls, shards_list)
+        out = np.asarray(jax.device_get(dev))
+        return [int(out[i]) for i in range(len(calls))]
+
+    def count_many_async(
+        self, index: str, calls, shards_list, broadcast: bool = True
+    ):
+        if not calls:
+            return jnp.zeros(0, jnp.int32)
+        canonical = self.canonical_shards(index)
+        if not canonical:
+            return jnp.zeros(len(calls), jnp.int32)
+        if broadcast and self._peerless_multiproc:
+            raise PeerlessMeshError("multi-process mesh without peer broadcast")
+        return self._collective(
+            "count_batch",
+            {
+                "index": index,
+                "queries": [str(c) for c in calls],
+                "shardsList": [list(s) for s in shards_list],
+                "canon": [int(x) for x in canonical],
+            },
+            lambda: self._dispatch_count_batch(
+                index, calls, shards_list, canonical
+            ),
+            broadcast,
+        )
+
+    def _dispatch_count_batch(self, index, calls, shards_list, canonical):
+        lw = _Lowering(self, canonical)
+        progs = []
+        for c, shards in zip(calls, shards_list):
+            prog = self._lower(index, c, lw)
+            i_mask = lw.add_mask(self._mask_words(shards, canonical))
+            progs.append((prog, i_mask))
+        # Pad the program tuple to the next power of two by repeating the
+        # last pair: XLA CSEs the duplicate subtree (near-free) and the
+        # executable cache sees O(log K) batch sizes per structure
+        # instead of every K.
+        K = len(progs)
+        K_pad = max(1, 1 << (K - 1).bit_length())
+        progs.extend([progs[-1]] * (K_pad - K))
+        self.fused_dispatches += 1
+        return kernels.count_batch_tree(
+            self.mesh, tuple(progs), tuple(lw.specs), *lw.operands
         )
 
     def bitmap_stack(

@@ -163,6 +163,33 @@ def count_tree(mesh, prog, specs, mask, *operands):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def count_batch_tree(mesh, progs, specs, *operands):
+    """K Count(tree) queries in ONE dispatch: each program evaluates +
+    popcounts over the shared operand list (field stacks appear once no
+    matter how many queries touch them; XLA CSEs identical subtrees) and
+    a single psum reduces the stacked int32[K] — K answers for one
+    dispatch-floor cost + one readback.  This is the serving-tier answer
+    to the JAX per-program dispatch floor (~100-400 us): small queries
+    batch K-for-one instead of paying it each (BASELINE config #2).
+
+    ``progs`` is a static tuple of (prog, i_mask) pairs — i_mask the
+    operand index of that query's requested-shard mask (uint32[S, 1]).
+    The engine pads batches to power-of-two sizes by repeating the last
+    pair, which is compile-free (CSE) and bounds executable-cache keys."""
+
+    def body(*ops):
+        outs = [
+            jnp.sum(_pc(jnp.bitwise_and(apply_prog(prog, ops), ops[i_mask])))
+            for prog, i_mask in progs
+        ]
+        return jax.lax.psum(jnp.stack(outs), SHARD_AXIS)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=specs, out_specs=P()
+    )(*operands)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def eval_tree(mesh, prog, specs, mask, *operands):
     """Evaluate a tree to its masked uint32[S, WORDS] row stack."""
 

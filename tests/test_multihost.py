@@ -206,6 +206,18 @@ def test_two_server_collective_count_http(tmp_path):
 
         # 50 overlapping columns x 4 shards = 200.
         assert query("Count(Intersect(Row(f=1), Row(f=2)))") == 200
+        # Multi-call Count: ONE count_batch collective replayed on the
+        # peer (round-4 batched dispatch) — not two count collectives.
+        req = urllib.request.Request(
+            f"http://localhost:{ports[0]}/index/i/query",
+            data=b"Count(Intersect(Row(f=1), Row(f=2)))"
+            b"Count(Union(Row(f=1), Row(f=2)))",
+            method="POST",
+        )
+        both = json.loads(urllib.request.urlopen(req, timeout=120).read())[
+            "results"
+        ]
+        assert both == [200, 600], both
         # Sum: 40 values of ((c % 7) + 1), c = 0..39.
         want_sum = sum((c % 7) + 1 for c in range(40))
         vc = query("Sum(field=v)")
