@@ -61,6 +61,7 @@ TOPN_ROWS = 16
 BSI_DEPTH = 8
 GROUPS_A = 4
 GROUPS_B = 2
+GROUPS_C = 2  # 3-field fused GroupBy (round-4 VERDICT #4)
 ROW_BYTES = 1 << 17  # one 2^20-bit shard row = 128 KiB
 HTTP_REPS = 30
 
@@ -243,6 +244,7 @@ def main():
     tf = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
     ga = idx.create_field("ga")  # config 5
     gb = idx.create_field("gb")
+    gc = idx.create_field("gc")  # 3-field fused GroupBy
 
     host = {}  # (index, field, view) -> {shard: {row: words}}
 
@@ -280,6 +282,9 @@ def main():
         for g in range(GROUPS_B):
             build("bench", gb, "standard", s, g,
                   __rand(rng, W64) & __rand(rng, W64))
+        for g in range(GROUPS_C):
+            build("bench", gc, "standard", s, g,
+                  __rand(rng, W64) & __rand(rng, W64))
     idx10 = holder.create_index("b10m")
     f10 = idx10.create_field("f")
     for s in range(N_SHARDS_10M):
@@ -290,7 +295,7 @@ def main():
     f1 = idx1.create_field("f")
     for r in range(10, 10 + F_ROWS):
         build("b1", f1, "standard", 0, r, __rand(rng, W64), keep=(r == 10))
-    for field in (f, topf, bsi, tf, ga, gb, f10, f1):
+    for field in (f, topf, bsi, tf, ga, gb, gc, f10, f1):
         for v in field.views.values():
             for frag in v.fragments.values():
                 frag.cache.invalidate()
@@ -412,6 +417,17 @@ def main():
         4, 24, rounds=2,
         min_per=floor_per_query((GROUPS_A + GROUPS_B) * N_SHARDS * ROW_BYTES),
     )
+    t_gb3_eng, _ = engine_p50(
+        lambda i: eng.group_counts_async(
+            "bench", ["ga", "gb", "gc"],
+            [list(range(GROUPS_A)), list(range(GROUPS_B)), list(range(GROUPS_C))],
+            None, shards,
+        ),
+        4, 24, rounds=2,
+        min_per=floor_per_query(
+            (GROUPS_A + GROUPS_B + GROUPS_C) * N_SHARDS * ROW_BYTES
+        ),
+    )
     progress("groupby engine timed")
 
     # ---- config 1: executor O(1) cardinality lane (no device work) -------
@@ -452,6 +468,11 @@ def main():
         lambda i: ex.execute("bench", "Max(field=v)").results[0], reps=6
     )
 
+    q5_3 = "GroupBy(Rows(field=ga), Rows(field=gb), Rows(field=gc))"
+    ex.execute("bench", q5_3)
+    t_gb3, gb3_res = sync_p50(
+        lambda i: ex.execute("bench", q5_3).results[0], reps=4
+    )
     q5 = "GroupBy(Rows(field=ga), Rows(field=gb))"
     ex.execute("bench", q5)
     t_gb, gb_res = sync_p50(lambda i: ex.execute("bench", q5).results[0], reps=4)
@@ -549,6 +570,7 @@ def main():
          ("standard_201801", "standard_201802", "standard_201803")}
     GA = host[("bench", "ga", "standard")]
     GB = host[("bench", "gb", "standard")]
+    GC = host[("bench", "gc", "standard")]
     F1 = host[("b1", "f", "standard")]
 
     def pc(x):
@@ -656,6 +678,27 @@ def main():
             assert got_gb.get((i, j), 0) == int(want_gb[i, j]), (i, j)
     c_gb = cpu_time(cpu_gb, reps=1)
 
+    def cpu_gb3():
+        counts = np.zeros((GROUPS_A, GROUPS_B, GROUPS_C), dtype=np.int64)
+        for s in GA:
+            for i in range(GROUPS_A):
+                a = GA[s][i]
+                for j in range(GROUPS_B):
+                    ab = a & GB[s][j]
+                    for k in range(GROUPS_C):
+                        counts[i, j, k] += pc(ab & GC[s][k])
+        return counts
+
+    want_gb3 = cpu_gb3()
+    got_gb3 = {
+        tuple(fr.row_id for fr in g.group): g.count for g in gb3_res
+    }
+    for i in range(GROUPS_A):
+        for j in range(GROUPS_B):
+            for k in range(GROUPS_C):
+                assert got_gb3.get((i, j, k), 0) == int(want_gb3[i, j, k])
+    c_gb3 = cpu_time(cpu_gb3, reps=1)
+
     # ---- emit (north star LAST: the driver parses the final line) --------
     progress("baselines done")
     hbm_gbs_end = remeasure_hbm()
@@ -687,6 +730,9 @@ def main():
     emit("groupby_8way_1B_cols_p50", t_gb_eng, c_gb,
          bytes_read=(GROUPS_A + GROUPS_B) * N_SHARDS * ROW_BYTES)
     emit("groupby_8way_1B_cols_e2e_p50", t_gb, c_gb)
+    emit("groupby_3field_1B_cols_p50", t_gb3_eng, c_gb3,
+         bytes_read=(GROUPS_A + GROUPS_B + GROUPS_C) * N_SHARDS * ROW_BYTES)
+    emit("groupby_3field_1B_cols_e2e_p50", t_gb3, c_gb3)
     emit("http_count_e2e_p50", t_http, c_c2)
     emit_raw("http_count_qps", qps, "qps", qps * c_c2)
     # Mixed workload: CPU baseline = update one numpy row + recount the

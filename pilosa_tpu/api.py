@@ -140,6 +140,10 @@ class API:
         self._mesh_replay_q = None
         self._mesh_replay_lock = threading.Lock()
         self._mesh_pending: Dict[str, tuple] = {}
+        # Sequencer state (mesh_ticket): only consulted on the node the
+        # deployment designates as sequencer.
+        self._mesh_ticket_lock = threading.Lock()
+        self._mesh_ticket_next = 0
         if cluster is not None:
             self.attach_cluster(cluster, node)
 
@@ -708,6 +712,16 @@ class API:
     # permanent wedge of the replay worker).
     MESH_REPLAY_TIMEOUT = 120.0
 
+    def mesh_ticket(self) -> int:
+        """Issue the next dense collective sequence number (this node is
+        the mesh sequencer; route /internal/mesh/ticket).  Tickets give
+        collectives a global order so ANY node can initiate
+        (parallel/seqgate.py)."""
+        with self._mesh_ticket_lock:
+            seq = self._mesh_ticket_next
+            self._mesh_ticket_next += 1
+            return seq
+
     def mesh_collective_accept(self, payload: dict):
         """Accept a multi-host collective dispatch descriptor from a peer
         (route /internal/mesh/dispatch): validate NOW (so a bad dispatch
@@ -733,6 +747,7 @@ class API:
         kind = payload.get("kind")
         required = {
             "count": ("query",),
+            "eval": ("query",),
             "count_batch": ("queries", "shardsList"),
             "sum": ("field",),
             "minmax": ("field", "isMin"),
@@ -832,28 +847,73 @@ class API:
                 t.start()
 
     def _mesh_collective_resolve(self, payload: dict, phase: str):
-        """Commit or abort a pending two-phase dispatch."""
+        """Commit or abort a pending two-phase dispatch.  Sequenced
+        dispatches (symmetric initiation) run on their own thread gated
+        by the engine's SeqGate — ticket order, not commit-arrival
+        order; unsequenced ones keep the FIFO replay worker."""
         did = payload.get("did")
         with self._mesh_replay_lock:
             entry = self._mesh_pending.pop(did, None)
         if entry is None:
             if phase == "abort":
-                return True  # abort of an unknown/expired did is a no-op
+                # Unknown did is a no-op — but an abort that carries a
+                # ticket must still skip it, or the gate stalls there:
+                # accept may have failed HERE while other peers took the
+                # ticket into their streams.
+                seq = payload.get("seq")
+                if seq is not None and self.mesh_engine is not None:
+                    self.mesh_engine.seq_gate.skip(int(seq))
+                return True
             raise ApiError(f"unknown or expired dispatch: {did}")
         pending, timer = entry
         timer.cancel()
+        seq = pending.get("seq")
         if phase == "commit":
-            self._mesh_replay_q.put(pending)
+            if seq is not None:
+                threading.Thread(
+                    target=self._mesh_seq_replay, args=(pending,),
+                    daemon=True, name=f"mesh-seq-{seq}",
+                ).start()
+            else:
+                self._mesh_replay_q.put(pending)
+        elif seq is not None:
+            self.mesh_engine.seq_gate.skip(int(seq))
         return True
 
     def _mesh_pending_expire(self, did: str):
         with self._mesh_replay_lock:
             entry = self._mesh_pending.pop(did, None)
         if entry is not None:
+            pending, _timer = entry
+            seq = pending.get("seq")
+            if seq is not None and self.mesh_engine is not None:
+                self.mesh_engine.seq_gate.skip(int(seq))
             self.logger.printf(
                 "mesh dispatch %s expired uncommitted (initiator died "
                 "mid-handoff?); dropped without dispatching", did
             )
+
+    def _mesh_seq_replay(self, payload: dict):
+        """Execute one committed sequenced dispatch: enter the gate at
+        its ticket, dispatch, exit, then do the bounded readback.  Gate
+        entry — not a FIFO queue — defines cross-process order, so
+        commits may arrive in any order."""
+        seq = int(payload["seq"])
+        gate = self.mesh_engine.seq_gate
+        try:
+            if not gate.enter(seq):
+                self.logger.printf(
+                    "mesh seq %d was force-skipped before replay "
+                    "(initiator may hang)", seq,
+                )
+                return
+            try:
+                dev = self._mesh_replay_dispatch(payload)
+            finally:
+                gate.exit(seq)
+            self._mesh_replay_readback(dev, payload)
+        except Exception as e:  # noqa: BLE001
+            self.logger.printf("mesh seq replay failed: %s", e)
 
     def _mesh_replay_loop(self):
         """Replays peer dispatches in arrival order (the initiating node
@@ -866,49 +926,51 @@ class API:
             try:
                 with self.mesh_engine.collective_lock:
                     dev = self._mesh_replay_dispatch(payload)
-                if dev is not None:
-                    # Bounded wait: a collective some process never joins
-                    # (e.g. commit reached us but not a third peer) must
-                    # not wedge the replay worker forever.  device_get is
-                    # uncancellable, so it waits on a side thread; on
-                    # timeout the worker logs and moves on (the leaked
-                    # thread ends if/when the runtime unsticks).  Errors
-                    # inside the thread are captured and logged here — a
-                    # bare thread would route them to excepthook/stderr,
-                    # invisible to the server logger.
-                    err: list = []
-
-                    def _get():
-                        try:
-                            jax.device_get(dev)
-                        except Exception as e:  # noqa: BLE001
-                            err.append(e)
-
-                    waiter = threading.Thread(target=_get, daemon=True)
-                    waiter.start()
-                    waiter.join(self.MESH_REPLAY_TIMEOUT)
-                    if waiter.is_alive():
-                        self.logger.printf(
-                            "mesh replay collective STUCK >%ss (peer "
-                            "missing from rendezvous?): %r",
-                            self.MESH_REPLAY_TIMEOUT,
-                            {k: v for k, v in payload.items() if k != "_calls"},
-                        )
-                    elif err:
-                        self.logger.printf(
-                            "mesh replay readback failed: %s", err[0]
-                        )
-                else:
-                    # The initiator dispatched and is blocked in its
-                    # collective; a declined replay strands it.  Accept-
-                    # time validation makes this unreachable for known
-                    # schema; scream if it happens anyway.
-                    self.logger.printf(
-                        "mesh replay DID NOT DISPATCH (initiator may hang): %r",
-                        {k: v for k, v in payload.items() if k != "_calls"},
-                    )
+                self._mesh_replay_readback(dev, payload)
             except Exception as e:
                 self.logger.printf("mesh replay failed: %s", e)
+
+    def _mesh_replay_readback(self, dev, payload: dict):
+        """Bounded wait for a replayed collective's result: a collective
+        some process never joins (e.g. commit reached us but not a third
+        peer) must not wedge the worker forever.  device_get is
+        uncancellable, so it waits on a side thread; on timeout we log
+        and move on (the leaked thread ends if/when the runtime
+        unsticks).  Errors inside the thread are captured and logged —
+        a bare thread would route them to excepthook/stderr, invisible
+        to the server logger."""
+        import jax
+
+        if dev is None:
+            # The initiator dispatched and is blocked in its collective;
+            # a declined replay strands it.  Accept-time validation
+            # makes this unreachable for known schema; scream if it
+            # happens anyway.
+            self.logger.printf(
+                "mesh replay DID NOT DISPATCH (initiator may hang): %r",
+                {k: v for k, v in payload.items() if k != "_calls"},
+            )
+            return
+        err: list = []
+
+        def _get():
+            try:
+                jax.device_get(dev)
+            except Exception as e:  # noqa: BLE001
+                err.append(e)
+
+        waiter = threading.Thread(target=_get, daemon=True)
+        waiter.start()
+        waiter.join(self.MESH_REPLAY_TIMEOUT)
+        if waiter.is_alive():
+            self.logger.printf(
+                "mesh replay collective STUCK >%ss (peer missing from "
+                "rendezvous?): %r",
+                self.MESH_REPLAY_TIMEOUT,
+                {k: v for k, v in payload.items() if k != "_calls"},
+            )
+        elif err:
+            self.logger.printf("mesh replay readback failed: %s", err[0])
 
     def _mesh_replay_dispatch(self, payload: dict):
         """Enter the same fused dispatch the initiator described; returns
@@ -926,6 +988,15 @@ class API:
 
         if kind == "count":
             return eng.count_async(index, call_of("query"), shards, broadcast=False)
+        if kind == "eval":
+            stack, _ = eng.bitmap_stack(
+                index, call_of("query"), shards, broadcast=False
+            )
+            # The replay only needs to JOIN the collective, not consume
+            # the bitmap: wait on a 4-byte dependent slice instead of
+            # pulling the whole replicated [S, WORDS] stack to host on
+            # every peer (that's index-sized traffic per query).
+            return None if stack is None else stack[0, 0]
         if kind == "count_batch":
             return eng.count_many_async(
                 index,

@@ -75,6 +75,10 @@ class Config:
         self.jax_num_processes = 0
         self.jax_process_id = 0
         self.mesh_peers: List[str] = []
+        # Symmetric collective initiation: the node that issues dense
+        # sequence tickets.  "self" = this node; a base URL = a peer;
+        # "" = disabled (route collectives through one entry node).
+        self.mesh_sequencer = ""
 
     # -- loading -----------------------------------------------------------
 
@@ -140,6 +144,7 @@ class Config:
         )
         self.jax_process_id = mesh.get("jax-process-id", self.jax_process_id)
         self.mesh_peers = mesh.get("peers", self.mesh_peers)
+        self.mesh_sequencer = mesh.get("sequencer", self.mesh_sequencer)
 
     def load_env(self, environ=None):
         env = environ if environ is not None else os.environ
@@ -176,6 +181,7 @@ class Config:
             ("jax_num_processes", "JAX_NUM_PROCESSES", int),
             ("jax_process_id", "JAX_PROCESS_ID", int),
             ("mesh_peers", "MESH_PEERS", list),
+            ("mesh_sequencer", "MESH_SEQUENCER", str),
         ]:
             v = get(name, cast)
             if v is not None:
@@ -229,6 +235,7 @@ jax-coordinator = "{self.jax_coordinator}"
 jax-num-processes = {self.jax_num_processes}
 jax-process-id = {self.jax_process_id}
 peers = [{", ".join(f'"{u}"' for u in self.mesh_peers)}]
+sequencer = "{self.mesh_sequencer}"
 """
 
     def bind_host_port(self):

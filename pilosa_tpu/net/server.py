@@ -91,6 +91,12 @@ class Handler:
         r("POST", "/internal/fragment/data", self._post_fragment_data)
         r("GET", "/internal/fragment/data", self._get_fragment_data)
         r("POST", "/internal/mesh/dispatch", self._mesh_dispatch)
+        r("POST", "/internal/mesh/ticket", self._mesh_ticket)
+
+    def _mesh_ticket(self, q, body, **kw):
+        """Issue the next collective sequence ticket (this node is the
+        configured mesh sequencer; symmetric initiation)."""
+        return {"seq": self.api.mesh_ticket()}
 
     def _mesh_dispatch(self, q, body, **kw):
         """Accept a collective dispatch from a multi-host peer: validate,

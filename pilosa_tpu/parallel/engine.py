@@ -164,9 +164,16 @@ class PeerlessMeshError(RuntimeError):
 
 
 class MeshEngine:
-    def __init__(self, holder, mesh: Mesh, max_resident_bytes: int = DEFAULT_RESIDENCY_BYTES):
+    def __init__(
+        self,
+        holder,
+        mesh: Mesh,
+        max_resident_bytes: int = DEFAULT_RESIDENCY_BYTES,
+        logger=None,
+    ):
         self.holder = holder
         self.mesh = mesh
+        self.logger = logger
         # LRU residency manager: hot field stacks stay dense in HBM up to
         # the budget, cold ones are dropped back to host truth (the
         # explicit replacement for the reference's mmap paging,
@@ -200,6 +207,18 @@ class MeshEngine:
         # cross-node concurrent initiation is not globally ordered.
         self.collective_broadcast = None
         self.collective_lock = threading.Lock()
+        # Symmetric initiation (round 4): when ``ticket`` is set (a fn
+        # returning the next dense sequence number from the sequencer
+        # node), every broadcast collective carries its ticket and ALL
+        # processes — initiators and replayers alike — enter collectives
+        # through ``seq_gate`` in ticket order, so any node can initiate
+        # concurrently (the reference's any-node mapReduce,
+        # executor.go:2183).  Without a ticket fn, initiation must route
+        # through one entry node (arrival order = initiation order).
+        self.ticket = None
+        from .seqgate import SeqGate
+
+        self.seq_gate = SeqGate(on_stall=self._log_seq_stall)
         # Lazy cross-request Count micro-batcher (parallel/batcher.py).
         self._batcher = None
         self._batcher_lock = threading.Lock()
@@ -216,6 +235,21 @@ class MeshEngine:
         # scatter syncs (tests assert writes do NOT force rebuilds).
         self.stack_rebuilds = 0
         self.stack_updates = 0
+
+    def _log_seq_stall(self, seq: int):
+        """A gate force-skip must leave a trace on THIS node — the
+        initiator-side log never fires when the initiator is the one
+        that died."""
+        import sys
+
+        msg = (
+            f"mesh seq {seq} force-skipped after gate stall "
+            "(initiator died before commit?)"
+        )
+        if self.logger is not None:
+            self.logger.printf("%s", msg)
+        else:
+            print(msg, file=sys.stderr, flush=True)
 
     def _scalar(self, v: int):
         """Cached device int32 scalar (fresh device_puts per query are the
@@ -661,14 +695,37 @@ class MeshEngine:
 
     def _collective(self, kind, payload, dispatch, broadcast=True):
         """Run a fused dispatch; on a peer-replayed mesh, hand the
-        descriptor to every peer first, under the lock (a peer that
-        cannot accept raises HERE, before anything blocks in a psum).
-        ``broadcast=False`` marks a peer replay: dispatch directly."""
-        if broadcast and self.collective_broadcast is not None:
-            with self.collective_lock:
-                self.collective_broadcast(kind, payload)
+        descriptor to every peer first (a peer that cannot accept raises
+        HERE, before anything blocks in a psum).  ``broadcast=False``
+        marks a peer replay: dispatch directly.
+
+        With a ticket fn (symmetric initiation), the dispatch enters the
+        seq gate instead of the collective lock: tickets define the
+        global order, so concurrent initiators on different nodes are
+        safe.  Without one, this process's lock serializes its own
+        stream and deployments route through a single entry node."""
+        if not broadcast or self.collective_broadcast is None:
+            return dispatch()
+        if self.ticket is not None:
+            seq = int(self.ticket())
+            try:
+                self.collective_broadcast(kind, dict(payload, seq=seq))
+            except Exception:
+                # Peers were told to skip this seq (abort carries it);
+                # our own gate must skip it too or we stall ourselves.
+                self.seq_gate.skip(seq)
+                raise
+            if not self.seq_gate.enter(seq):
+                raise RuntimeError(
+                    f"collective seq {seq} was force-skipped (gate stall)"
+                )
+            try:
                 return dispatch()
-        return dispatch()
+            finally:
+                self.seq_gate.exit(seq)
+        with self.collective_lock:
+            self.collective_broadcast(kind, payload)
+            return dispatch()
 
     def _dispatch_count(self, index, c, shards, canonical):
         lw = _Lowering(self, canonical)
@@ -766,17 +823,50 @@ class MeshEngine:
         c: Call,
         shards: List[int],
         canonical: Optional[List[int]] = None,
+        broadcast: bool = True,
     ):
         """Evaluate a tree to its masked uint32[S, WORDS] row stack laid
         out over the canonical shard axis; returns (stack, canonical).
         Pass ``canonical`` when the result joins other operands of one
-        dispatch (shared shard-axis snapshot)."""
-        if self.multiproc:
-            return None, []
+        dispatch (shared shard-axis snapshot).
+
+        Single-process: sharded output (zero-copy into later dispatches).
+        Multi-process: an ``eval`` collective replayed on peers with the
+        result REPLICATED (all-gathered) so this process can read every
+        shard's block — the analogue of remoteExec returning row
+        segments over HTTP (executor.go:2142-2158); round 3 simply
+        bailed here (r3 VERDICT missing #1)."""
         if canonical is None:
             canonical = self.canonical_shards(index)
         if not canonical:
             return None, []
+        if self.multiproc:
+            if broadcast and self._peerless_multiproc:
+                return None, []
+
+            def dispatch():
+                lw = _Lowering(self, canonical)
+                prog = self._lower(index, c, lw)
+                mask = self._mask_words(shards, canonical)
+                self.fused_dispatches += 1
+                return kernels.eval_tree_replicated(
+                    self.mesh, prog, tuple(lw.specs), mask, *lw.operands
+                )
+
+            return (
+                self._collective(
+                    "eval",
+                    {
+                        "index": index,
+                        "query": str(c),
+                        "shards": list(shards),
+                        "canon": [int(x) for x in canonical],
+                    },
+                    dispatch,
+                    broadcast,
+                ),
+                canonical,
+            )
         lw = _Lowering(self, canonical)
         prog = self._lower(index, c, lw)
         mask = self._mask_words(shards, canonical)
@@ -789,12 +879,15 @@ class MeshEngine:
         )
 
     def bitmap_row(self, index: str, c: Call, shards: List[int]):
-        """Evaluate a tree and materialize a core Row (host segments)."""
+        """Evaluate a tree and materialize a core Row (host segments).
+        Returns None when the engine declines (no canonical shards /
+        peerless multi-process mesh) — callers fall back to the host
+        per-shard path; an EMPTY result is a Row with no segments."""
         from ..core.row import Row
 
         stack, canonical = self.bitmap_stack(index, c, shards)
         if stack is None:
-            return Row({})
+            return None
         stack = np.asarray(stack)
         req = set(shards)
         segs = {}
@@ -1288,6 +1381,12 @@ class MeshEngine:
             pairs = pairs[: int(n)]
         return pairs
 
+    # Fused GroupBy combination cap: prod(K_i) above this falls back to
+    # the host iterator.  The [C, S, W] intersection tensor is virtual
+    # under XLA's reduce fusion, but the count OUTPUT (int32[C],
+    # replicated) and compile time grow with C, so bound it.
+    MAX_GROUP_COMBOS = 1024
+
     def group_counts_async(
         self,
         index: str,
@@ -1297,12 +1396,20 @@ class MeshEngine:
         shards: List[int],
         broadcast: bool = True,
     ):
-        """Fused GroupBy dispatch with the int32[Ka(,Kb)] count tensor
-        left on device; returns None when the fused path doesn't apply."""
+        """Fused GroupBy dispatch with the int32[K1, ..., Kn] count
+        tensor left on device; returns None when the fused path doesn't
+        apply (no shards, peerless multi-process mesh, or combination
+        count over MAX_GROUP_COMBOS — the host iterator handles
+        overflow)."""
         if broadcast and self._peerless_multiproc:
             return None
-        if len(fields) not in (1, 2):
-            raise ValueError("fused GroupBy supports 1 or 2 fields")
+        if not fields:
+            raise ValueError("fused GroupBy requires at least one field")
+        combos = 1
+        for rows in row_lists:
+            combos *= max(len(rows), 1)
+        if combos > self.MAX_GROUP_COMBOS:
+            return None
         canonical = self.canonical_shards(index)
         if not canonical:
             return None
@@ -1335,26 +1442,13 @@ class MeshEngine:
 
         def dispatch():
             self.fused_dispatches += 1
-            if len(fields) == 1:
-                return kernels.group1_tree(
-                    self.mesh,
-                    prog,
-                    extra_specs + tuple(lw.specs),
-                    statics[0],
-                    mask,
-                    stacks[0].matrix,
-                    *extra_ops,
-                    *lw.operands,
-                )
-            return kernels.group2_tree(
+            return kernels.groupn_tree(
                 self.mesh,
                 prog,
                 extra_specs + tuple(lw.specs),
-                statics[0],
-                statics[1],
+                tuple(statics),
                 mask,
-                stacks[0].matrix,
-                stacks[1].matrix,
+                *[st.matrix for st in stacks],
                 *extra_ops,
                 *lw.operands,
             )

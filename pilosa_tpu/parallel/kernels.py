@@ -203,6 +203,25 @@ def eval_tree(mesh, prog, specs, mask, *operands):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def eval_tree_replicated(mesh, prog, specs, mask, *operands):
+    """Evaluate a tree to its masked uint32[S, WORDS] row stack,
+    REPLICATED to every process: the multi-process variant of eval_tree
+    (a sharded output's remote blocks are unaddressable to the
+    initiator's device_get, so bitmap materialization on a multi-host
+    mesh all-gathers the result over the interconnect — the analogue of
+    the reference's remoteExec returning row segments over HTTP,
+    executor.go:2142)."""
+
+    def body(m, *ops):
+        out = jnp.bitwise_and(apply_prog(prog, ops), m)
+        return replicate_shards(out, mesh.shape[SHARD_AXIS], axis=0)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(SHARD_AXIS),) + specs, out_specs=P()
+    )(mask, *operands)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def topn_tree(mesh, prog, specs, mask, cand_mat, idxs, *operands):
     """TopN phase-1 in ONE dispatch: evaluate the src tree, gather the
     candidate rows in-body, score every candidate per shard
@@ -338,46 +357,46 @@ def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
-def group1_tree(mesh, prog, specs, idxs_a, mask, mat_a, *operands):
-    """Single-field GroupBy in ONE dispatch -> int32[Ka], replicated."""
+def groupn_tree(mesh, prog, specs, idx_specs, mask, *operands):
+    """N-field GroupBy in ONE dispatch: every (K1 x K2 x ... x Kn) group
+    combination counted via broadcast intersection + one psum
+    (executeGroupByShard's nested iterator, executor.go:1056/2726-2890,
+    re-founded as a flattened combination tensor) ->
+    int32[K1, ..., Kn], replicated.
 
-    def body(m, ma, *ops):
-        if idxs_a is None:
-            ia, *rest = ops
-        else:
-            ia, rest = idxs_a, ops
-        a = jnp.bitwise_and(
-            gather_rows(ma, ia), _filter(prog, m, tuple(rest))[None, :, :]
-        )
-        return jax.lax.psum(jnp.sum(_pc(a), axis=(1, 2)), SHARD_AXIS)
+    ``idx_specs`` is a static tuple with one slot per field: a
+    gather-free index tuple, or None meaning the field's row indices
+    arrive as a traced int32[Ki] operand (client-controlled subsets must
+    not become compile keys).  The first ``n`` operands after ``mask``
+    are the field stacks, then the traced index vectors for the None
+    slots, then the filter-tree operands.
 
-    return shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS)) + specs,
-        out_specs=P(),
-    )(mask, mat_a, *operands)
+    The [K1..Kn, S, W] intersection tensor is VIRTUAL: XLA fuses the
+    elementwise chain into the popcount-reduce, so the working set per
+    tile stays O(W), not O(prod(K) * W) — same fusion the 2-field
+    version relied on.  The engine caps prod(K) (MAX_GROUP_COMBOS) and
+    overflow falls back to the host iterator."""
+    n = len(idx_specs)
 
-
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
-def group2_tree(mesh, prog, specs, idxs_a, idxs_b, mask, mat_a, mat_b, *operands):
-    """Two-field GroupBy in ONE dispatch: all (Ka, Kb) pair intersection
-    counts (executeGroupByShard, executor.go:1056, without the host
-    iterator) -> int32[Ka, Kb], replicated."""
-
-    def body(m, ma, mb, *ops):
-        rest = list(ops)
-        ia = idxs_a if idxs_a is not None else rest.pop(0)
-        ib = idxs_b if idxs_b is not None else rest.pop(0)
+    def body(m, *ops):
+        mats = ops[:n]
+        rest = list(ops[n:])
+        idxs = [
+            spec if spec is not None else rest.pop(0) for spec in idx_specs
+        ]
         f = _filter(prog, m, tuple(rest))
-        a = jnp.bitwise_and(gather_rows(ma, ia), f[None, :, :])
-        b = gather_rows(mb, ib)
-        inter = jnp.bitwise_and(a[:, None, :, :], b[None, :, :, :])
-        return jax.lax.psum(jnp.sum(_pc(inter), axis=(2, 3)), SHARD_AXIS)
+        acc = jnp.bitwise_and(gather_rows(mats[0], idxs[0]), f[None, :, :])
+        for i in range(1, n):
+            g = gather_rows(mats[i], idxs[i])  # [Ki, S, W]
+            acc = jnp.bitwise_and(
+                acc[..., None, :, :],
+                g.reshape((1,) * i + g.shape),
+            )
+        return jax.lax.psum(jnp.sum(_pc(acc), axis=(-2, -1)), SHARD_AXIS)
 
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS)) + specs,
+        in_specs=(P(SHARD_AXIS),) + (P(None, SHARD_AXIS),) * n + specs,
         out_specs=P(),
-    )(mask, mat_a, mat_b, *operands)
+    )(mask, *operands)

@@ -135,6 +135,8 @@ class Server:
             long_query_time=self.config.cluster_long_query_time,
             logger=self.logger,
         )
+        if mesh_engine is not None and self.config.mesh_sequencer:
+            mesh_engine.ticket = self._make_ticket_fn()
         self._http, self._http_thread = serve(
             self.api, host if host not in ("", "0.0.0.0") else "0.0.0.0", port
         )
@@ -159,7 +161,7 @@ class Server:
             from .parallel import MeshEngine, make_mesh
 
             mesh = make_mesh(self.config.mesh_devices or None)
-            engine = MeshEngine(self.holder, mesh)
+            engine = MeshEngine(self.holder, mesh, logger=self.logger)
             if self.config.mesh_peers:
                 from concurrent.futures import ThreadPoolExecutor
 
@@ -172,6 +174,25 @@ class Server:
         except Exception as e:
             self.logger.printf("mesh engine unavailable: %s", e)
             return None
+
+    def _make_ticket_fn(self):
+        """Collective sequence tickets (symmetric initiation): local
+        counter when this node IS the sequencer, one HTTP round-trip to
+        the sequencer node otherwise."""
+        target = self.config.mesh_sequencer
+        if target == "self":
+            return lambda: self.api.mesh_ticket()
+        import urllib.request
+
+        def fetch():
+            req = urllib.request.Request(
+                f"{target}/internal/mesh/ticket", data=b"{}", method="POST"
+            )
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return int(json.loads(resp.read())["seq"])
+
+        return fetch
 
     def _broadcast_dispatch(self, kind, payload):
         """Two-phase handoff of a collective dispatch descriptor to every
@@ -210,13 +231,20 @@ class Server:
         accept = json.dumps(
             dict(payload, kind=kind, did=did, phase="accept")
         ).encode()
+        # The abort/commit resolutions carry the ticket too: a peer that
+        # REJECTED the accept never registered the did, but its seq gate
+        # still has to skip the ticket other peers took into their
+        # streams (api._mesh_collective_resolve).
+        resolution = {"did": did}
+        if payload.get("seq") is not None:
+            resolution["seq"] = payload["seq"]
         errs = fanout(accept)
         if errs:
             # Release the peers that DID accept; best-effort — a peer the
             # abort misses expires the pending entry on its own timer.
-            fanout(json.dumps({"did": did, "phase": "abort"}).encode())
+            fanout(json.dumps(dict(resolution, phase="abort")).encode())
             raise RuntimeError(f"mesh peers unavailable: {'; '.join(errs)}")
-        errs = fanout(json.dumps({"did": did, "phase": "commit"}).encode())
+        errs = fanout(json.dumps(dict(resolution, phase="commit")).encode())
         if errs:
             # Commits are idempotent-or-expired: peers the commit missed
             # time out and abort; peers it reached replay a collective

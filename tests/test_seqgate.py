@@ -1,0 +1,90 @@
+"""SeqGate: dense ticket ordering for symmetric collective initiation."""
+
+import threading
+import time
+
+from pilosa_tpu.parallel.seqgate import SeqGate
+
+
+def test_in_order():
+    g = SeqGate()
+    assert g.enter(0)
+    g.exit(0)
+    assert g.enter(1)
+    g.exit(1)
+    assert g.next_seq == 2
+
+
+def test_out_of_order_threads_serialize():
+    g = SeqGate()
+    order = []
+
+    def run(seq, delay):
+        time.sleep(delay)
+        assert g.enter(seq)
+        order.append(seq)
+        time.sleep(0.01)
+        g.exit(seq)
+
+    # Start in reverse arrival order: 3 arrives first, 0 last.
+    threads = [
+        threading.Thread(target=run, args=(seq, (3 - seq) * 0.05))
+        for seq in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert order == [0, 1, 2, 3]
+
+
+def test_skip_advances():
+    g = SeqGate()
+    g.skip(0)
+    assert g.next_seq == 1
+    g.skip(2)  # future skip buffers...
+    assert g.next_seq == 1
+    assert g.enter(1)
+    g.exit(1)  # ...and is consumed when reached
+    assert g.next_seq == 3
+
+
+def test_enter_passed_seq_returns_false():
+    g = SeqGate()
+    g.skip(0)
+    assert g.enter(0) is False
+
+
+def test_running_head_is_never_skipped():
+    """A seq that ENTERED and is executing (long dispatch, first
+    compile) is progress, not a lost ticket — waiters must keep
+    waiting, however long it runs."""
+    g = SeqGate()
+    g.STALL_TIMEOUT = 0.5
+    assert g.enter(0)  # holds the head, simulating a slow dispatch
+    done = []
+
+    def waiter():
+        done.append(g.enter(1))
+        g.exit(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(2.0)  # well past STALL_TIMEOUT
+    assert not done, "waiter skipped a RUNNING head"
+    g.exit(0)
+    t.join(5)
+    assert done == [True]
+
+
+def test_stall_force_skips():
+    g = SeqGate()
+    g.STALL_TIMEOUT = 0.5
+    stalled = []
+    g._on_stall = stalled.append
+    t0 = time.monotonic()
+    assert g.enter(1)  # ticket 0 never arrives; the gate must unwedge
+    assert time.monotonic() - t0 < 5.0
+    assert stalled == [0]
+    g.exit(1)
+    assert g.next_seq == 2
