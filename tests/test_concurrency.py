@@ -1,12 +1,18 @@
 """Concurrency tests: the coarse per-fragment mutex keeps host truth
 consistent under concurrent writers (the Go race-detector discipline,
-fragment.go:88)."""
+fragment.go:88), and the engine's version/scatter invariants hold under
+a writer thread (modeled on the reference's concurrent fragment
+benchmarks, fragment_internal_test.go:1726-1876)."""
 
 import threading
+import time
+
+import numpy as np
 
 from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import SHARD_WIDTH
 
 
 def test_concurrent_set_bits():
@@ -63,6 +69,138 @@ def test_concurrent_mixed_ops_single_row():
     from pilosa_tpu.ops import bitops
 
     assert frag.row_count(1) == bitops.popcount_np(frag.row_words(1))
+
+
+def test_bulk_import_while_querying_engine():
+    """A writer thread bulk-imports while a reader hammers the fused
+    device path.  Invariants (round-4 VERDICT #6): every observed count
+    is monotonically nondecreasing (imports only ADD bits to rows 0/1),
+    the scatter-sync never misses a write (final fused count == host
+    oracle), and no rebuild happens (no new rows, no new shards)."""
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    n_shards = 4
+    # Pre-create every row/shard the writer will touch, so the stack
+    # shape never changes (rebuilds only happen for shape changes).
+    rows0, cols0 = [], []
+    for s in range(n_shards):
+        for r in range(8):
+            rows0.append(r)
+            cols0.append(s * SHARD_WIDTH + r)
+    f.import_bulk(rows0, cols0)
+
+    eng = MeshEngine(h, make_mesh(8))
+    ex = Executor(h, mesh_engine=eng)
+    q = "Count(Union(Row(f=0), Row(f=1)))"
+    base = ex.execute("i", q).results[0]
+    assert eng.stack_rebuilds == 1
+
+    stop = threading.Event()
+    errors = []
+    seen = []
+
+    def writer():
+        try:
+            n = 0
+            while not stop.is_set() and n < 60:
+                n += 1
+                rows, cols = [], []
+                for s in range(n_shards):
+                    for r in range(8):
+                        rows.append(r)
+                        cols.append(s * SHARD_WIDTH + 100 + (n * 8 + r) % 5000)
+                f.import_bulk(rows, cols)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                seen.append(ex.execute("i", q).results[0])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    w = threading.Thread(target=writer)
+    r = threading.Thread(target=reader)
+    w.start()
+    r.start()
+    w.join(60)
+    time.sleep(0.1)
+    stop.set()
+    r.join(60)
+    # A hung thread IS the failure class these tests exist to catch —
+    # fail loudly instead of racing the assertions below against it.
+    assert not w.is_alive() and not r.is_alive(), "worker deadlocked"
+    assert not errors, errors
+    assert seen and seen[0] >= base
+    # Monotone: a later read can never observe fewer bits than an
+    # earlier one (adds only) — the scatter-sync invariant that a write
+    # marked synced is actually in the served matrix.
+    for a, b in zip(seen, seen[1:]):
+        assert b >= a, (a, b)
+    # Quiesced: the fused path agrees with the host-only executor.
+    plain = Executor(h)
+    assert ex.execute("i", q).results == plain.execute("i", q).results
+    assert eng.stack_rebuilds == 1, "import under query forced a rebuild"
+    assert eng.stack_updates >= 1
+
+
+def test_snapshot_under_write(tmp_path):
+    """Snapshot (compaction to disk) races a writer: the persisted file
+    plus op-log must reopen to exactly the in-memory truth — no lost
+    writes, no torn state (fragment.go:1737's atomic temp-file+rename
+    under the fragment mutex)."""
+    frag = Fragment("i", "f", "standard", 0, path=str(tmp_path / "frag"))
+    for i in range(0, 2000, 2):
+        frag.set_bit(3, i)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            i = 0
+            while not stop.is_set():
+                frag.set_bit(4, i % SHARD_WIDTH)
+                if i % 3 == 0:
+                    frag.set_bit(3, (2 * i + 1) % SHARD_WIDTH)
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def snapshotter():
+        try:
+            while not stop.is_set():
+                frag.snapshot()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=writer) for _ in range(2)] + [
+        threading.Thread(target=snapshotter)
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.7)
+    stop.set()
+    for t in ts:
+        t.join(30)
+    assert not any(t.is_alive() for t in ts), "worker deadlocked"
+    assert not errors, errors
+    want3 = frag.row_words(3).copy()
+    want4 = frag.row_words(4).copy()
+    frag.close()
+
+    re = Fragment("i", "f", "standard", 0, path=str(tmp_path / "frag"))
+    assert np.array_equal(re.row_words(3), want3)
+    assert np.array_equal(re.row_words(4), want4)
+    # The self-check finds nothing wrong with the persisted bytes.
+    from pilosa_tpu.roaring import codec
+
+    with open(tmp_path / "frag", "rb") as fh:
+        assert codec.check_bytes(fh.read()) == []
 
 
 def test_concurrent_schema_creation():
