@@ -56,7 +56,7 @@ import numpy as np
 N_SHARDS = 960  # 960 * 2^20 = ~1.007B columns
 N_SHARDS_10M = 10  # config 2: 10 * 2^20 = ~10.5M columns
 F_ROWS = 24  # rows 10..33 -> 12 disjoint north-star pairs
-F10_ROWS = 64  # rows 100..163 -> 16 disjoint 4-row trees (one full batch)
+F10_ROWS = 128  # rows 100..227 -> 32 disjoint 4-row trees (one full batch)
 TOPN_ROWS = 16
 BSI_DEPTH = 8
 GROUPS_A = 4
@@ -124,9 +124,19 @@ def engine_p50(fn, k1, k2, rounds=4, min_per=0.0):
     run(2)  # warm: compile + readback channel
     per, values = 0.0, None
     for _attempt in range(3):
-        t1, values = min((run(k1) for _ in range(rounds)), key=lambda r: r[0])
-        t2, _ = min((run(k2) for _ in range(rounds)), key=lambda r: r[0])
-        per = max((t2 - t1) / (k2 - k1), 1e-9)
+        # PAIRED slopes: each k1-run is immediately followed by its
+        # k2-run, so both legs see the same relay congestion state; the
+        # median over pairs rejects pairs that straddled a weather
+        # change.  (Independent min-of-rounds per leg — the r3 method —
+        # could pair a congested k1 with a clean k2 and report an
+        # impossibly fast slope: that was the implied-GB/s > measured
+        # ceiling anomaly.)
+        slopes = []
+        for _ in range(rounds):
+            t1, values = run(k1)
+            t2, _ = run(k2)
+            slopes.append((t2 - t1) / (k2 - k1))
+        per = max(statistics.median(slopes), 1e-9)
         if per >= min_per:
             break
         progress(f"  resampling: slope {per * 1e6:.1f} us/q below physical floor")
@@ -345,7 +355,7 @@ def main():
         10, 210,
         min_per=floor_per_query(4 * N_SHARDS_10M * ROW_BYTES),
     )
-    C2_B = 16  # queries per batched dispatch; 16 disjoint trees = 64
+    C2_B = 32  # queries per batched dispatch; 32 disjoint trees = 128
     # DISTINCT rows per batch, so XLA's CSE cannot merge row reads
     # across slots and the per-query byte accounting stays honest.
 
@@ -511,17 +521,34 @@ def main():
         t_http_all.append(time.perf_counter() - t0)
     t_http = statistics.median(t_http_all)
 
-    # QPS: 32 concurrent clients x 8 requests each, varied queries.  The
-    # server-side micro-batcher drains concurrent Counts into one fused
-    # dispatch, so QPS should scale with client count instead of pinning
-    # at clients/readback-RTT (round-3 verdict weak #2).
+    # QPS: 32 concurrent clients x 8 requests each, varied queries, over
+    # PERSISTENT HTTP/1.1 connections (urllib reconnects per request —
+    # that cost is the client's, not the server's).  The server-side
+    # micro-batcher drains concurrent Counts into one fused dispatch, so
+    # QPS should scale with client count instead of pinning at
+    # clients/readback-RTT (round-3 verdict weak #2).
+    import http.client
+
     n_clients, per_client = 32, 8
+
+    def qps_client(c):
+        conn = http.client.HTTPConnection("localhost", port, timeout=120)
+        try:
+            for j in range(per_client):
+                k = c * per_client + j
+                conn.request(
+                    "POST", "/index/b10m/query",
+                    body=c2_texts[k % len(c2_texts)],
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                json.loads(resp.read())
+        finally:
+            conn.close()
+
     with ThreadPoolExecutor(n_clients) as pool:
         t0 = time.perf_counter()
-        list(pool.map(
-            lambda c: [http_once(c * per_client + j) for j in range(per_client)],
-            range(n_clients),
-        ))
+        list(pool.map(qps_client, range(n_clients)))
         qps_wall = time.perf_counter() - t0
     qps = n_clients * per_client / qps_wall
     batcher = eng._batcher

@@ -125,6 +125,15 @@ class Fragment:
         # syncs reaching back past it must rebuild.
         self._mutlog: Dict[int, int] = {}
         self._mut_floor = 0
+        # Word-level dirty tracking: {row: {device_word_index: version}}
+        # lets the engine sync a point write by shipping the CHANGED
+        # 4-byte words instead of the whole 128 KiB row — the
+        # host->device transfer is the dominant cost of incremental sync
+        # through a slow transport.  ``_word_floor[row]`` marks the last
+        # whole-row-dirty version (dense load, clear_row, log overflow):
+        # syncs reaching back past it take the full row.
+        self._word_log: Dict[int, Dict[int, int]] = {}
+        self._word_floor: Dict[int, int] = {}
 
         # Lazily-built mutex occupancy vector: column -> owning row (-1 none).
         self._mutex_owners: Optional[np.ndarray] = None
@@ -258,9 +267,32 @@ class Fragment:
 
     # -- bit mutation ------------------------------------------------------
 
-    def _touch(self, row_id: int):
+    # Dirty words tracked per row before whole-row fallback (2048 words
+    # = 8 KiB of scatter payload vs the row's 128 KiB).
+    WORD_LOG_MAX = 2048
+
+    def _touch(self, row_id: int, cols=None):
+        """Record a mutation.  ``cols``: the in-row column position(s)
+        whose device words changed (int or array), or None for a
+        whole-row change (dense load, drop)."""
         self._version += 1
         self._mutlog[row_id] = self._version
+        v = self._version
+        if cols is None:
+            self._word_floor[row_id] = v
+            self._word_log.pop(row_id, None)
+        else:
+            wlog = self._word_log.setdefault(row_id, {})
+            if isinstance(cols, (int, np.integer)):
+                wlog[int(cols) >> 5] = v
+            else:
+                for w in np.unique(
+                    np.asarray(cols, dtype=np.int64) >> 5
+                ).tolist():
+                    wlog[w] = v
+            if len(wlog) > self.WORD_LOG_MAX:
+                self._word_floor[row_id] = v
+                self._word_log.pop(row_id, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         if self._on_touch is not None:
             self._on_touch()
@@ -274,16 +306,32 @@ class Fragment:
         Returns None when the sync point predates the last
         unattributed version bump (storage load) — only then is a
         rebuild required; ordinary writes and bulk imports of ANY size
-        are covered by the per-row log."""
+        are covered by the per-row log.
+
+        Each dirty row maps to either ``("row", words)`` (full uint32
+        row) or ``("words", widxs, vals)`` — just the changed device
+        words, when the word log covers the span (point writes sync as
+        a few bytes instead of 128 KiB/row)."""
         with self._mu:
             if version >= self._version:
                 return self._version, {}
             if version < self._mut_floor:
                 return None
-            rows = sorted(
-                r for r, v in self._mutlog.items() if v > version
-            )
-            return self._version, {r: self.row_words(r) for r in rows}
+            out = {}
+            for r, rv in self._mutlog.items():
+                if rv <= version:
+                    continue
+                wlog = self._word_log.get(r)
+                if version < self._word_floor.get(r, 0) or wlog is None:
+                    out[r] = ("row", self.row_words(r))
+                    continue
+                widxs = np.asarray(
+                    sorted(w for w, wv in wlog.items() if wv > version),
+                    dtype=np.int32,
+                )
+                words = self.row_words(r)
+                out[r] = ("words", widxs, words[widxs])
+            return self._version, out
 
     @_locked
     def set_bit(self, row_id: int, column_id: int) -> bool:
@@ -323,7 +371,7 @@ class Fragment:
         if self._mutex_owners is not None:
             self._mutex_owners[in_row] = row_id
         self._append_op(codec.OP_TYPE_ADD, p)
-        self._touch(row_id)
+        self._touch(row_id, in_row)
         self.cache.add(row_id, self._store.count(row_id))
         return True
 
@@ -342,7 +390,7 @@ class Fragment:
         ):
             self._mutex_owners[in_row] = -1
         self._append_op(codec.OP_TYPE_REMOVE, p)
-        self._touch(row_id)
+        self._touch(row_id, in_row)
         self.cache.add(row_id, self._store.count(row_id))
         return True
 
@@ -478,7 +526,7 @@ class Fragment:
             before = self._store.count(r)
             after = self._store.union(r, pos)
             changed += after - before
-            self._touch(r)
+            self._touch(r, pos)
             self.cache.bulk_add(r, after)
         self.cache.invalidate()
         self.snapshot()
@@ -499,7 +547,7 @@ class Fragment:
         if stale.any():
             for r, pos in self._group_by_pairs(prev[stale], cols[stale]):
                 self._store.difference(r, pos)
-                self._touch(r)
+                self._touch(r, pos)
                 self.cache.bulk_add(r, self._store.count(r))
         fresh = prev != rws
         if fresh.any():
@@ -507,7 +555,7 @@ class Fragment:
                 before = self._store.count(r)
                 after = self._store.union(r, pos)
                 changed += after - before
-                self._touch(r)
+                self._touch(r, pos)
                 self.cache.bulk_add(r, after)
         own[cols] = rws
         self.cache.invalidate()
@@ -556,10 +604,10 @@ class Fragment:
                 self._store.union(i, set_pos)
             if clr_pos.size:
                 self._store.difference(i, clr_pos)
-            self._touch(i)
+            self._touch(i, pos32)
             self.cache.bulk_add(i, self._store.count(i))
         n = self._store.union(bit_depth, pos32)
-        self._touch(bit_depth)
+        self._touch(bit_depth, pos32)
         self.cache.bulk_add(bit_depth, n)
         self.cache.invalidate()
         self.snapshot()
@@ -597,7 +645,7 @@ class Fragment:
             if r not in self._store:
                 continue
             n = self._store.difference(r, pos)
-            self._touch(r)
+            self._touch(r, pos)
             self.cache.bulk_add(r, n)
         self._mutex_owners = None
         self.cache.invalidate()
@@ -607,7 +655,7 @@ class Fragment:
             return
         for r, pos in self._group_by_row(positions):
             n = self._store.union(r, pos)
-            self._touch(r)
+            self._touch(r, pos)
             self.cache.bulk_add(r, n)
         self._mutex_owners = None
         self.cache.invalidate()

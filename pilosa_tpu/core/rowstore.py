@@ -168,8 +168,14 @@ class RowStore:
             self.counts[row_id] = len(merged)
             return len(merged)
         d = self.dense[row_id]
+        # Count delta from the TOUCHED words only: popcounting all 16K
+        # words for a point write costs more than the write itself
+        # (maintained counts stay exact — before/after on the same
+        # word subset).
+        idx = np.unique((positions >> np.uint32(6)).astype(np.int64))
+        before = bitops.popcount_np(d[idx])
         scatter_or(d, positions)
-        n = bitops.popcount_np(d)
+        n = self.counts[row_id] + bitops.popcount_np(d[idx]) - before
         self.counts[row_id] = n
         return n
 
@@ -185,8 +191,10 @@ class RowStore:
         d = self.dense.get(row_id)
         if d is None:
             return 0
+        idx = np.unique((positions >> np.uint32(6)).astype(np.int64))
+        before = bitops.popcount_np(d[idx])
         scatter_andnot(d, positions)
-        n = bitops.popcount_np(d)
+        n = self.counts[row_id] + bitops.popcount_np(d[idx]) - before
         self.counts[row_id] = n
         return n
 

@@ -21,6 +21,7 @@ to the actual concurrency.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 
@@ -42,6 +43,22 @@ class CountBatcher:
     # collective); surface an error instead of blocking the HTTP thread
     # forever.
     WAIT_TIMEOUT = 300.0
+    # Above this measured device->host readback RTT, the transport
+    # overlaps concurrent per-request syncs far better than a serialized
+    # batch cycle can amortize the dispatch floor (e.g. a ~90 ms relay
+    # tunnel: 32 overlapped RTTs >> 1 RTT per ~10-query batch), so the
+    # batcher runs in OVERLAP mode: every submit executes concurrently
+    # on its own thread, unbatched.  On a real TPU host (RTT ~0.1 ms)
+    # the dispatch floor dominates and fused batching engages.
+    RTT_OVERLAP_THRESHOLD = 0.010
+    # After a real (>=2 query) fused batch, keep routing arrivals through
+    # the queue for this long: under sustained concurrency the direct
+    # path would otherwise steal leadership after every batch and
+    # serialize a 1-answer readback between every K-answer one (halving
+    # throughput when the readback RTT dominates).  A lone caller never
+    # triggers it — size-1 drains don't refresh the window — so idle
+    # latency is untouched.
+    HOT_WINDOW = 0.25
 
     def __init__(self, engine, max_batch: int = 256):
         self.engine = engine
@@ -50,23 +67,56 @@ class CountBatcher:
         self._cond = threading.Condition(self._lock)
         self._queue: List[_Item] = []
         self._busy = False
+        self._inflight = threading.Semaphore(self.MAX_INFLIGHT)
+        self._last_fused = 0.0  # monotonic time of the last >=2 batch
+        self.readback_rtt = self._probe_rtt()
+        self.overlap_mode = self.readback_rtt > self.RTT_OVERLAP_THRESHOLD
         self._worker: Optional[threading.Thread] = None
         # Telemetry the QPS bench and tests assert on.
         self.batches = 0
         self.batched_queries = 0
 
+    def _probe_rtt(self) -> float:
+        """Measure dispatch + readback of a FRESH trivial computation —
+        the per-request sync floor.  It must be freshly computed: some
+        transports (the axon relay) answer committed-buffer reads from a
+        local cache, which would under-report the real round trip."""
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            f = jax.jit(lambda x: x + jnp.int32(1))
+            x = jax.device_put(jnp.int32(1))
+            jax.device_get(f(x))  # compile + warm the channel
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.monotonic()
+                jax.device_get(f(x))
+                best = min(best, time.monotonic() - t0)
+            return best
+        except Exception:  # pragma: no cover — no device: batch mode
+            return 0.0
+
     def submit(self, index: str, call, shards) -> int:
-        """Count one tree; returns the count.  Lone callers run directly
-        (no handoff); callers arriving while a dispatch is in flight are
-        queued and answered from the next fused batch."""
+        """Count one tree; returns the count.  Overlap mode (slow
+        transport): execute concurrently, unbatched.  Batch mode: lone
+        callers run directly (no handoff); callers arriving while a
+        dispatch is in flight — or within the hot window after a fused
+        batch — are queued and answered from the next fused batch."""
+        if self.overlap_mode:
+            return self.engine.count(index, call, shards)
         with self._lock:
-            if not self._busy and not self._queue:
+            hot = time.monotonic() - self._last_fused < self.HOT_WINDOW
+            if not self._busy and not self._queue and not hot:
                 self._busy = True
                 direct = True
             else:
                 item = _Item(index, call, list(shards))
                 self._queue.append(item)
                 self._ensure_worker()
+                # Wake the worker: in the hot-window case nobody is busy,
+                # so no completion notify is coming.
+                self._cond.notify_all()
                 direct = False
         if direct:
             try:
@@ -105,6 +155,12 @@ class CountBatcher:
                     if self._queue:
                         self._cond.notify_all()
 
+    # In-flight readbacks allowed to overlap: the worker dispatches
+    # batch N+1 while N's results are still in transit — otherwise the
+    # readback round-trip floors the batch cycle time.  Bounded small: a
+    # runaway pipeline of unawaited collectives can starve the backend.
+    MAX_INFLIGHT = 4
+
     def _run_batch(self, batch: List[_Item]):
         # One dispatch per index present in the drain (operand lists are
         # per-index; mixed-index drains are rare and still amortize).
@@ -113,15 +169,28 @@ class CountBatcher:
             by_index.setdefault(it.index, []).append(it)
         for index, items in by_index.items():
             try:
-                res = self.engine.count_many(
-                    index,
-                    [it.call for it in items],
-                    [it.shards for it in items],
-                )
+                self._inflight.acquire()
+                try:
+                    dev = self.engine.count_many_async(
+                        index,
+                        [it.call for it in items],
+                        [it.shards for it in items],
+                    )
+                    # Readback on its own thread: the worker is free to
+                    # drain + dispatch the next batch immediately.  The
+                    # slot is released by _complete; a start() failure
+                    # ("can't start new thread" under load) must release
+                    # it here or the pool drains permanently.
+                    threading.Thread(
+                        target=self._complete, args=(dev, items), daemon=True
+                    ).start()
+                except BaseException:
+                    self._inflight.release()
+                    raise
                 self.batches += 1
                 self.batched_queries += len(items)
-                for it, r in zip(items, res):
-                    it.result = int(r)
+                if len(items) >= 2:
+                    self._last_fused = time.monotonic()
             except Exception:
                 # One bad tree (unlowerable shape, unknown field) must
                 # not fail its batchmates: retry each alone, attributing
@@ -133,6 +202,20 @@ class CountBatcher:
                         )
                     except BaseException as e:  # noqa: BLE001
                         it.error = e
-            finally:
-                for it in items:
                     it.event.set()
+
+    def _complete(self, dev, items: List[_Item]):
+        import jax
+        import numpy as np
+
+        try:
+            out = np.asarray(jax.device_get(dev))
+            for i, it in enumerate(items):
+                it.result = int(out[i])
+        except BaseException as e:  # noqa: BLE001
+            for it in items:
+                it.error = e
+        finally:
+            self._inflight.release()
+            for it in items:
+                it.event.set()

@@ -167,6 +167,37 @@ _scatter_rows_donated = functools.partial(
 )(_scatter_rows_impl)
 
 
+def _scatter_words_impl(mesh, matrix, rows, poss, widxs, vals):
+    """Word-level scatter: matrix[rows[i], poss[i], widxs[i]] = vals[i].
+    Point writes ship the CHANGED uint32 words (a few bytes) instead of
+    whole 128 KiB rows — host->device transfer is the dominant
+    incremental-sync cost through a slow transport.  Same donation
+    rules as _scatter_rows_impl."""
+
+    def body(m, r, p, w, v):
+        i = jax.lax.axis_index(SHARD_AXIS)
+        s_local = m.shape[1]
+        lp = p - i * s_local
+        # Positive out-of-bounds sentinel (negative wraps before drop).
+        lp = jnp.where((lp >= 0) & (lp < s_local), lp, s_local)
+        return m.at[r, lp, w].set(v, mode="drop")
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, SHARD_AXIS), P(), P(), P(), P()),
+        out_specs=P(None, SHARD_AXIS),
+    )(matrix, rows, poss, widxs, vals)
+
+
+_scatter_words = functools.partial(jax.jit, static_argnums=(0,))(
+    _scatter_words_impl
+)
+_scatter_words_donated = functools.partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(1,)
+)(_scatter_words_impl)
+
+
 class PeerlessMeshError(RuntimeError):
     """A collective was requested on a multi-process mesh that has no
     peer broadcast configured — entering it would hang forever."""
@@ -389,8 +420,19 @@ class MeshEngine:
         row_index = {r: i for i, r in enumerate(row_ids)}
         S = pad_shards(len(canonical), self.mesh)
         mat = np.zeros((len(row_ids), S, bitops.WORDS), dtype=np.uint32)
+        # Multi-process: materialize row WORDS only for the canonical
+        # positions this process's devices own (multihost.owned_positions)
+        # — put_global's callback never reads the rest, so each host pays
+        # for its own shards only.  The ROW TABLE stays global (cheap ids
+        # walk over all fragments) so every process lowers the identical
+        # program.
+        owned = None
+        if self.multiproc:
+            from . import multihost
+
+            owned = multihost.owned_positions(self.mesh, S)
         for si, f in enumerate(frags):
-            if f is None:
+            if f is None or (owned is not None and si not in owned):
                 continue
             for r in f.row_ids():
                 mat[row_index[r], si] = f.row_words(r)
@@ -436,6 +478,11 @@ class MeshEngine:
         if token[0] != cached.versions[0] or token[1] != cached.versions[1]:
             return None  # shard epoch or view identity changed
         updates: List[Tuple[int, int, np.ndarray]] = []  # (row_idx, pos, words)
+        # Word-level deltas, one ENTRY PER DIRTY ROW (vectors, not
+        # per-word tuples — a near-cap sync can carry ~500k words):
+        # (row_idx, pos, widxs int32[], vals uint32[]).
+        word_updates: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
+        n_words = 0
         new_sync = list(cached.frag_sync)
         for si, s in enumerate(canonical):
             frag = self.holder.fragment(index, field, view, s)
@@ -454,14 +501,19 @@ class MeshEngine:
             if snap is None:
                 return None  # sync point predates storage load
             new_version, dirty = snap
-            for r, words in dirty.items():
+            for r, upd in dirty.items():
                 row_idx = cached.row_index.get(r)
                 if row_idx is None:
                     return None  # brand-new row: shape change
-                updates.append((row_idx, si, words))
+                if upd[0] == "words":
+                    _, widxs, vals = upd
+                    word_updates.append((row_idx, si, widxs, vals))
+                    n_words += len(widxs)
+                else:
+                    updates.append((row_idx, si, upd[1]))
             if dirty:
                 new_sync[si] = (fref, new_version)
-        if updates:
+        if updates or word_updates:
             # Admission: the first (non-donated) scatter transiently
             # doubles this stack's footprint; evict others first like
             # the rebuild path.
@@ -477,6 +529,7 @@ class MeshEngine:
                 )
                 self._evict(victim)
             mat = cached.matrix
+            donated = False  # first dispatch copies; the rest donate
             for ci in range(0, len(updates), self.SCATTER_CHUNK_ROWS):
                 chunk = updates[ci : ci + self.SCATTER_CHUNK_ROWS]
                 D = len(chunk)
@@ -488,10 +541,37 @@ class MeshEngine:
                     r, p, w = chunk[min(i, D - 1)]  # pad repeats the last
                     rows[i], poss[i] = r, p
                     vals[i] = w
-                fn = _scatter_rows if ci == 0 else _scatter_rows_donated
+                fn = _scatter_rows_donated if donated else _scatter_rows
                 mat = fn(
                     self.mesh, mat, jnp.asarray(rows), jnp.asarray(poss),
                     jnp.asarray(vals),
+                )
+                donated = True
+            if word_updates:
+                D_pad = max(8, 1 << (n_words - 1).bit_length())
+                rows_w = np.empty(D_pad, dtype=np.int32)
+                poss_w = np.empty(D_pad, dtype=np.int32)
+                widx_w = np.empty(D_pad, dtype=np.int32)
+                vals_w = np.empty(D_pad, dtype=np.uint32)
+                o = 0
+                for r_i, p_i, widxs, vals in word_updates:
+                    k = len(widxs)
+                    rows_w[o : o + k] = r_i
+                    poss_w[o : o + k] = p_i
+                    widx_w[o : o + k] = widxs
+                    vals_w[o : o + k] = vals
+                    o += k
+                # Pad repeats the last word (idempotent set).
+                rows_w[o:], poss_w[o:] = rows_w[o - 1], poss_w[o - 1]
+                widx_w[o:], vals_w[o:] = widx_w[o - 1], vals_w[o - 1]
+                fn = _scatter_words_donated if donated else _scatter_words
+                mat = fn(
+                    self.mesh,
+                    mat,
+                    jnp.asarray(rows_w),
+                    jnp.asarray(poss_w),
+                    jnp.asarray(widx_w),
+                    jnp.asarray(vals_w),
                 )
             cached.matrix = mat
             self.stack_updates += 1

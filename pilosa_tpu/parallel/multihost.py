@@ -79,3 +79,26 @@ def process_index() -> int:
 
 def process_count() -> int:
     return jax.process_count()
+
+
+def owned_positions(mesh, n_positions: int) -> set:
+    """The per-process shard-ownership map: canonical-axis positions
+    (0..n_positions over the padded shard axis) whose owning device is
+    addressable from THIS process.
+
+    Ownership is derived through mesh.shard_owner — the single source
+    of placement truth — so a layout change there cannot silently
+    diverge from this map.  A multi-host field-stack build materializes
+    row words only for these positions — ``make_array_from_callback``
+    never reads the rest of the host buffer, so each host pays for its
+    own shards only (the analogue of the reference's per-node fragment
+    ownership, cluster.go:840)."""
+    from .mesh import shard_owner
+
+    devices = list(mesh.devices.flat)
+    pid = jax.process_index()
+    return {
+        p
+        for p in range(n_positions)
+        if devices[shard_owner(p, n_positions, mesh)].process_index == pid
+    }

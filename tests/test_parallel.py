@@ -404,6 +404,49 @@ def test_incremental_stack_sync(holder, mesh):
     assert eng.stack_updates == 5
 
 
+def test_word_level_sync_payload(holder, mesh):
+    """Point writes sync as WORD deltas (a few bytes), not whole
+    128 KiB rows; whole-row events (dense load, word-log overflow) fall
+    back to row payloads — and both produce correct counts."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.ops import bitops
+
+    frag = Fragment("i", "f", "standard", 0)
+    frag.set_bit(0, 5)
+    v0 = frag._version
+    # Two point writes in the same device word + one in another word.
+    frag.set_bit(0, 6)
+    frag.set_bit(0, 40)
+    ver, dirty = frag.sync_snapshot(v0)
+    kind, widxs, vals = dirty[0]
+    assert kind == "words"
+    assert widxs.tolist() == [0, 1]  # cols 6 and 40 -> words 0 and 1
+    assert vals.dtype == np.uint32 and len(vals) == 2
+    assert vals[0] == frag.row_words(0)[0]
+    # A dense row load is a whole-row event.
+    frag.load_row_words(1, np.ones(bitops.WORDS64, dtype=np.uint64))
+    _, dirty = frag.sync_snapshot(ver)
+    assert dirty[1][0] == "row"
+    # Word-log overflow on one row falls back to a row payload.
+    v1 = frag._version
+    for c in range(0, (frag.WORD_LOG_MAX + 10) * 32, 32):
+        frag.set_bit(2, c % SHARD_WIDTH)
+    _, dirty = frag.sync_snapshot(v1)
+    assert dirty[2][0] == "row"
+
+    # End-to-end: engine counts stay correct through the word path.
+    build_data(holder)
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder)
+    call = pql.parse("Row(f=10)").calls[0]
+    shards = list(range(8))
+    base = eng.count("i", call, shards)
+    ex.execute("i", f"Set({2 * SHARD_WIDTH + 500}, f=10)")
+    ex.execute("i", f"Set({6 * SHARD_WIDTH + 501}, f=10)")
+    assert eng.count("i", call, shards) == base + 2
+    assert eng.stack_updates == 1 and eng.stack_rebuilds == 1
+
+
 def test_bulk_import_write_through(holder, mesh):
     """A bulk import dirtying MANY rows across every shard (well past
     the old 256-row scatter cap) write-throughs to the resident stack
