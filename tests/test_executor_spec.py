@@ -473,3 +473,146 @@ def test_set_column_attrs_no_field():
     assert [(s.id, s.attrs) for s in resp.column_attr_sets] == [
         (10, {"foo": "bar"})
     ]
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth: the reference's op x key-mode matrix
+# (executor_test.go TestExecutor_Execute_{Row,Difference,Intersect,
+# Union,Xor,Count,Set,Clear} each with RowID/RowKey x ColumnID/ColumnKey
+# subtests) as one parametrized sweep, plus the Empty_* variants.
+# ---------------------------------------------------------------------------
+
+KEY_MODES = [
+    pytest.param(False, False, id="RowIDColumnID"),
+    pytest.param(True, False, id="RowIDColumnKey"),
+    pytest.param(False, True, id="RowKeyColumnID"),
+    pytest.param(True, True, id="RowKeyColumnKey"),
+]
+
+_COL_IDS = [3, SHARD_WIDTH + 1, SHARD_WIDTH + 2]
+_COL_KEYS = ["three", "sw1", "sw2"]
+_ROW_IDS = {"a": 10, "b": 20}
+_ROW_KEYS = {"a": "ten", "b": "twenty"}
+
+
+def _col(ikeys, i):
+    return f'"{_COL_KEYS[i]}"' if ikeys else str(_COL_IDS[i])
+
+
+def _row(fkeys, name):
+    return f'"{_ROW_KEYS[name]}"' if fkeys else str(_ROW_IDS[name])
+
+
+def _got(result, ikeys):
+    return sorted(result.keys) if ikeys else result.columns().tolist()
+
+
+def _want(ikeys, idxs):
+    if ikeys:
+        return sorted(_COL_KEYS[i] for i in idxs)
+    return sorted(_COL_IDS[i] for i in idxs)
+
+
+def _seed(ex, ikeys, fkeys):
+    # Row a: columns {0, 1}; row b: columns {1, 2}.
+    ex.execute(
+        "i",
+        f"Set({_col(ikeys, 0)}, f={_row(fkeys, 'a')})"
+        f"Set({_col(ikeys, 1)}, f={_row(fkeys, 'a')})"
+        f"Set({_col(ikeys, 1)}, f={_row(fkeys, 'b')})"
+        f"Set({_col(ikeys, 2)}, f={_row(fkeys, 'b')})",
+    )
+
+
+@pytest.mark.parametrize("ikeys,fkeys", KEY_MODES)
+def test_matrix_row_and_setops(ikeys, fkeys):
+    h, idx, ex = make_ex(keys=ikeys, field_keys=fkeys)
+    _seed(ex, ikeys, fkeys)
+    a, b = _row(fkeys, "a"), _row(fkeys, "b")
+    (r,) = ex.execute("i", f"Row(f={a})").results
+    assert _got(r, ikeys) == _want(ikeys, [0, 1])
+    (r,) = ex.execute("i", f"Union(Row(f={a}), Row(f={b}))").results
+    assert _got(r, ikeys) == _want(ikeys, [0, 1, 2])
+    (r,) = ex.execute("i", f"Intersect(Row(f={a}), Row(f={b}))").results
+    assert _got(r, ikeys) == _want(ikeys, [1])
+    (r,) = ex.execute("i", f"Difference(Row(f={a}), Row(f={b}))").results
+    assert _got(r, ikeys) == _want(ikeys, [0])
+    (r,) = ex.execute("i", f"Xor(Row(f={a}), Row(f={b}))").results
+    assert _got(r, ikeys) == _want(ikeys, [0, 2])
+    # A row that does not exist is empty, not an error.
+    missing = '"nope"' if fkeys else "999"
+    (r,) = ex.execute("i", f"Row(f={missing})").results
+    assert _got(r, ikeys) == []
+
+
+@pytest.mark.parametrize("ikeys,fkeys", KEY_MODES)
+def test_matrix_count(ikeys, fkeys):
+    h, idx, ex = make_ex(keys=ikeys, field_keys=fkeys)
+    _seed(ex, ikeys, fkeys)
+    a, b = _row(fkeys, "a"), _row(fkeys, "b")
+    assert ex.execute("i", f"Count(Row(f={a}))").results == [2]
+    assert ex.execute(
+        "i", f"Count(Intersect(Row(f={a}), Row(f={b})))"
+    ).results == [1]
+
+
+@pytest.mark.parametrize("ikeys,fkeys", KEY_MODES)
+def test_matrix_set_clear(ikeys, fkeys):
+    h, idx, ex = make_ex(keys=ikeys, field_keys=fkeys)
+    a = _row(fkeys, "a")
+    c0 = _col(ikeys, 0)
+    assert ex.execute("i", f"Set({c0}, f={a})").results == [True]
+    assert ex.execute("i", f"Set({c0}, f={a})").results == [False]  # no-op
+    assert ex.execute("i", f"Clear({c0}, f={a})").results == [True]
+    assert ex.execute("i", f"Clear({c0}, f={a})").results == [False]
+    (r,) = ex.execute("i", f"Row(f={a})").results
+    assert _got(r, ikeys) == []
+
+
+def test_empty_setops():
+    """Empty_Union is an empty row; Empty_Intersect/Difference are
+    errors (executor_test.go:182-358)."""
+    h, idx, ex = make_ex()
+    ex.execute("i", "Set(1, f=10)")
+    (r,) = ex.execute("i", "Union()").results
+    assert r.columns().tolist() == []
+    with pytest.raises(Error):
+        ex.execute("i", "Intersect()")
+    with pytest.raises(Error):
+        ex.execute("i", "Difference()")
+
+
+@pytest.mark.parametrize("ikeys", [False, True], ids=["ColumnID", "ColumnKey"])
+def test_matrix_bool_field(ikeys):
+    """TestExecutor_Execute_SetBool (:655): bool fields use rows
+    true/false; setting one side clears the other."""
+    h = Holder()
+    h.open()
+    idx = h.create_index("i", keys=ikeys)
+    idx.create_field("b", FieldOptions(type="bool"))
+    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
+    col = '"c1"' if ikeys else "100"
+    assert ex.execute("i", f"Set({col}, b=true)").results == [True]
+    (r,) = ex.execute("i", "Row(b=true)").results
+    assert len(_got(r, ikeys)) == 1
+    # Flipping to false must clear the true row (mutex-like semantics).
+    assert ex.execute("i", f"Set({col}, b=false)").results == [True]
+    (r,) = ex.execute("i", "Row(b=true)").results
+    assert _got(r, ikeys) == []
+    (r,) = ex.execute("i", "Row(b=false)").results
+    assert len(_got(r, ikeys)) == 1
+
+
+def test_set_value_and_range_keyed_columns():
+    """TestExecutor_Execute_SetValue (:741) over a keyed index: BSI
+    assignment + Range comparison resolve through column translation."""
+    h = Holder()
+    h.open()
+    idx = h.create_index("i", keys=True)
+    idx.create_field("v", FieldOptions(type="int", min=0, max=100))
+    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
+    ex.execute("i", 'Set("x", v=30) Set("y", v=70)')
+    (r,) = ex.execute("i", "Range(v > 50)").results
+    assert sorted(r.keys) == ["y"]
+    vc = ex.execute("i", "Sum(field=v)").results[0]
+    assert (vc.val, vc.count) == (100, 2)
