@@ -586,31 +586,26 @@ def test_empty_setops():
 def test_matrix_bool_field(ikeys):
     """TestExecutor_Execute_SetBool (:655): bool fields use rows
     true/false; setting one side clears the other."""
-    h = Holder()
-    h.open()
-    idx = h.create_index("i", keys=ikeys)
+    h, idx, ex = make_ex(keys=ikeys)
     idx.create_field("b", FieldOptions(type="bool"))
-    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
     col = '"c1"' if ikeys else "100"
+    want = ["c1"] if ikeys else [100]
     assert ex.execute("i", f"Set({col}, b=true)").results == [True]
     (r,) = ex.execute("i", "Row(b=true)").results
-    assert len(_got(r, ikeys)) == 1
+    assert _got(r, ikeys) == want
     # Flipping to false must clear the true row (mutex-like semantics).
     assert ex.execute("i", f"Set({col}, b=false)").results == [True]
     (r,) = ex.execute("i", "Row(b=true)").results
     assert _got(r, ikeys) == []
     (r,) = ex.execute("i", "Row(b=false)").results
-    assert len(_got(r, ikeys)) == 1
+    assert _got(r, ikeys) == want
 
 
 def test_set_value_and_range_keyed_columns():
     """TestExecutor_Execute_SetValue (:741) over a keyed index: BSI
     assignment + Range comparison resolve through column translation."""
-    h = Holder()
-    h.open()
-    idx = h.create_index("i", keys=True)
+    h, idx, ex = make_ex(keys=True)
     idx.create_field("v", FieldOptions(type="int", min=0, max=100))
-    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
     ex.execute("i", 'Set("x", v=30) Set("y", v=70)')
     (r,) = ex.execute("i", "Range(v > 50)").results
     assert sorted(r.keys) == ["y"]
