@@ -255,10 +255,16 @@ class Server:
             )
 
     def _setup_cluster(self, host: str, port: int):
-        """Wire the cluster when hosts or gossip seeds are configured
-        (server/server.go setupNetworking :302); single-node otherwise."""
+        """Wire the cluster when hosts, gossip seeds, or the coordinator
+        role are configured (server/server.go setupNetworking :302);
+        single-node otherwise.  The coordinator case matters for
+        bootstrap: the FIRST node of a gossip-joined cluster has no
+        seeds and no static host list, but must still start its gossip
+        listener for followers to join."""
         if self.config.cluster_disabled or not (
-            self.config.cluster_hosts or self.config.gossip_seeds
+            self.config.cluster_hosts
+            or self.config.gossip_seeds
+            or self.config.cluster_coordinator
         ):
             return
         from .cluster import Cluster, Node
@@ -271,6 +277,11 @@ class Server:
             path=self.data_dir,
             logger=self.logger,
         )
+        if not self.config.cluster_hosts and not self.config.gossip_seeds:
+            # Lone bootstrap coordinator: serve NORMAL immediately (one
+            # READY node is a healthy cluster of one); followers joining
+            # later re-run the state machine via membership events.
+            self.cluster._determine_state()
         self._setup_gossip(uri)
 
     def _setup_gossip(self, uri: str):
