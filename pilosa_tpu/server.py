@@ -277,10 +277,17 @@ class Server:
             path=self.data_dir,
             logger=self.logger,
         )
-        if not self.config.cluster_hosts and not self.config.gossip_seeds:
+        if (
+            not self.config.cluster_hosts
+            and not self.config.gossip_seeds
+            and len(self.cluster.nodes) <= 1
+        ):
             # Lone bootstrap coordinator: serve NORMAL immediately (one
             # READY node is a healthy cluster of one); followers joining
-            # later re-run the state machine via membership events.
+            # later re-run the state machine via membership events.  The
+            # node-count check matters on RESTART: a persisted .topology
+            # may have restored absent peers, and those must re-form via
+            # membership before the cluster reports healthy.
             self.cluster._determine_state()
         self._setup_gossip(uri)
 

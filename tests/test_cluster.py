@@ -271,3 +271,52 @@ def test_fused_paths_with_remote_peer(cluster3):
     gcs = resp["results"][0]
     assert len(gcs) == 1
     assert gcs[0]["count"] == n_shards * 20
+
+
+def test_jump_hash_reference_golden_vectors():
+    """The exact vectors the reference pins against the original C++
+    jump-consistent-hash paper (cluster_internal_test.go TestHasher
+    :363) — placement is byte-compatible with the reference, so a
+    mixed-version migration computes identical shard owners."""
+    vectors = {
+        0: [0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0],
+        1: [0, 0, 0, 0, 0, 0, 6, 6, 6, 6, 6, 6, 6, 6, 6, 6, 6, 17, 17],
+        0xDEADBEEF: [0, 1, 2, 3, 3, 5, 5, 5, 5, 5, 5, 5, 5, 5, 5, 5, 16, 16, 16],
+        0x0DDC0FFEEBADF00D: [0, 1, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 15, 15, 15, 15],
+    }
+    for key, buckets in vectors.items():
+        for i, want in enumerate(buckets):
+            assert jump_hash(key, i + 1) == want, (hex(key), i + 1)
+
+
+def test_partition_always_in_range():
+    """TestCluster_Partition (:340): partition(index, shard) stays in
+    [0, 256) for arbitrary index names and shards."""
+    nodes = [Node(f"n{i}", f"http://h{i}") for i in range(3)]
+    c = Cluster(node=nodes[0], replica_n=1)
+    c.nodes = nodes
+    import random
+
+    rnd = random.Random(7)
+    for _ in range(500):
+        index = "".join(
+            rnd.choice("abcdefghijklmnop") for _ in range(rnd.randint(0, 12))
+        )
+        shard = rnd.getrandbits(32)
+        p = c.partition(index, shard)
+        assert 0 <= p < 256
+        assert p == c.partition(index, shard)  # deterministic
+
+
+def test_partition_nodes_go_around_ring():
+    """TestCluster_Owners (:317): replica sets walk the node ring and
+    wrap past the end."""
+    nodes = [Node(f"n{i}", f"http://h{i}") for i in range(3)]
+    c = Cluster(node=nodes[0], replica_n=2)
+    c.nodes = nodes
+    for s in range(64):
+        owners = [n.id for n in c.shard_nodes("i", s)]
+        assert len(owners) == 2 and len(set(owners)) == 2
+        # Replicas are ADJACENT on the ring (wrapping).
+        i0 = [n.id for n in nodes].index(owners[0])
+        assert owners[1] == nodes[(i0 + 1) % 3].id
