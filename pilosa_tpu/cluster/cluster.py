@@ -209,7 +209,16 @@ class Cluster:
         """Node join: re-place fragments when data exists (nodeJoin
         :1697)."""
         with self._lock:
-            if any(n.id == node.id for n in self.nodes):
+            existing = next((n for n in self.nodes if n.id == node.id), None)
+            if existing is not None:
+                # A KNOWN node (re)joining — e.g. peers restored from a
+                # persisted topology before they actually came back — is
+                # a recovery signal: refresh its state and re-run the
+                # state machine, or a restarted coordinator would report
+                # STARTING forever while every peer is healthy.
+                existing.state = node.state
+                existing.uri = node.uri
+                self._determine_state()
                 return
             old_nodes = list(self.nodes)
             self.nodes.append(node)

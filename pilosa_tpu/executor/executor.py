@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import ops, pql
+from ..parallel.errors import PeerlessMeshError
 from ..core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_SET, FIELD_TYPE_TIME
 from ..core.fragment import SHARD_WIDTH
 from ..core import cache as cache_mod
@@ -953,8 +954,6 @@ class Executor:
         local = self._local_shards(index, shards)
         if not local:
             return None
-        from ..parallel.engine import PeerlessMeshError
-
         try:
             return set(local), self.mesh_engine.batched_count(index, child, local)
         except PeerlessMeshError:
@@ -985,8 +984,8 @@ class Executor:
                 return None
         try:
             return eng.bitmap_row(index, c, shards)
-        except ValueError:
-            return None  # unsupported argument shape: host path
+        except (ValueError, PeerlessMeshError):
+            return None  # unsupported argument shape / peer outage: host path
 
     def _mesh_count_many(self, index, calls, shards, opt):
         """A run of consecutive Count() calls as ONE batched fused
@@ -1007,8 +1006,6 @@ class Executor:
             local = set(self._local_shards(index, shards))
             if any(s not in local for s in shards):
                 return None  # remote shards: the per-call path splits
-        from ..parallel.engine import PeerlessMeshError
-
         results: list = [None] * len(children)
         rem_idx, rem_calls = [], []
         for k, ch in enumerate(children):
@@ -1109,7 +1106,7 @@ class Executor:
         filter_call = c.children[0] if c.children else None
         try:
             total, n = self.mesh_engine.sum(index, field_name, filter_call, local)
-        except ValueError:
+        except (ValueError, PeerlessMeshError):
             return None
         return set(local), ValCount(total, n)
 
@@ -1166,7 +1163,7 @@ class Executor:
             val, n = self.mesh_engine.min_max(
                 index, field_name, filter_call, local, is_min
             )
-        except ValueError:
+        except (ValueError, PeerlessMeshError):
             return None
         return set(local), ValCount(val, n)
 
@@ -1241,7 +1238,7 @@ class Executor:
                 min_threshold,
                 row_ids or None,
             )
-        except ValueError:
+        except (ValueError, PeerlessMeshError):
             return None
 
     def _execute_topn_shards(self, index, c, shards, opt):
@@ -1323,7 +1320,7 @@ class Executor:
             scored = self.mesh_engine.topn_scores(
                 index, field_name, candidates, c.children[0], shards
             )
-        except ValueError:
+        except (ValueError, PeerlessMeshError):
             return None
         if scored is None:
             return set(shards), []
@@ -1512,7 +1509,7 @@ class Executor:
             counts = self.mesh_engine.group_counts(
                 index, fields, row_lists, filter_call, shards
             )
-        except ValueError:
+        except (ValueError, PeerlessMeshError):
             return None
         if counts is None:
             return None

@@ -198,9 +198,9 @@ _scatter_words_donated = functools.partial(
 )(_scatter_words_impl)
 
 
-class PeerlessMeshError(RuntimeError):
-    """A collective was requested on a multi-process mesh that has no
-    peer broadcast configured — entering it would hang forever."""
+# Re-exported for back-compat: the class lives in errors.py so the
+# executor can import it without pulling in jax.
+from .errors import PeerlessMeshError  # noqa: E402
 
 
 class MeshEngine:
@@ -820,13 +820,16 @@ class MeshEngine:
             seq = int(self.ticket())
             try:
                 self.collective_broadcast(kind, dict(payload, seq=seq))
-            except Exception:
+            except Exception as e:
                 # Peers were told to skip this seq (abort carries it);
                 # our own gate must skip it too or we stall ourselves.
+                # Typed so executor fallbacks degrade to the host path
+                # (peer outage = degraded local service, not a 500).
                 self.seq_gate.skip(seq)
-                raise
+                self._log_degraded(kind, e)
+                raise PeerlessMeshError(f"mesh broadcast failed: {e!r}") from e
             if not self.seq_gate.enter(seq):
-                raise RuntimeError(
+                raise PeerlessMeshError(
                     f"collective seq {seq} was force-skipped (gate stall)"
                 )
             try:
@@ -834,8 +837,38 @@ class MeshEngine:
             finally:
                 self.seq_gate.exit(seq)
         with self.collective_lock:
-            self.collective_broadcast(kind, payload)
+            try:
+                self.collective_broadcast(kind, payload)
+            except Exception as e:
+                self._log_degraded(kind, e)
+                raise PeerlessMeshError(f"mesh broadcast failed: {e!r}") from e
             return dispatch()
+
+    # Seconds between degraded-mode log lines (one per query would spam
+    # during a sustained peer outage).
+    DEGRADED_LOG_INTERVAL = 5.0
+
+    def _log_degraded(self, kind, err):
+        """Broadcast failures silently fall back to the host path at the
+        executor — without a log a permanently-broken broadcast hook
+        (a bug, not an outage) would disable every fused dispatch and be
+        detectable only by latency.  The exception repr keeps bug-class
+        failures (TypeError, ...) distinguishable from peer outages."""
+        import sys
+        import time as time_mod
+
+        now = time_mod.monotonic()
+        if now - getattr(self, "_last_degraded_log", 0.0) < self.DEGRADED_LOG_INTERVAL:
+            return
+        self._last_degraded_log = now
+        msg = (
+            f"mesh broadcast for '{kind}' failed; fused queries degrade "
+            f"to the host path: {err!r}"
+        )
+        if self.logger is not None:
+            self.logger.printf("%s", msg)
+        else:
+            print(msg, file=sys.stderr, flush=True)
 
     def _dispatch_count(self, index, c, shards, canonical):
         lw = _Lowering(self, canonical)

@@ -1,0 +1,16 @@
+"""Mesh-engine error types.
+
+A separate module so the class has a single import-cycle-free home: the
+executor imports it at module scope while the engine (which also
+re-exports it for back-compat) is imported lazily inside functions.
+Note the parallel package __init__ still pulls in the engine (and thus
+jax) — this module does not make the import path jax-free, it just
+keeps the error type independent of engine-module load order."""
+
+
+class PeerlessMeshError(RuntimeError):
+    """A collective cannot proceed on a multi-process mesh — no peer
+    broadcast configured, or the broadcast handoff failed (peer down,
+    rejected, commit lost).  Entering the collective would hang forever,
+    so fused paths fall back to the per-shard host path instead: peer
+    outage degrades to local service, never to a hung psum."""

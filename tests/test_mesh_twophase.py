@@ -194,3 +194,54 @@ def test_initiator_aborts_survivors_on_accept_failure(tmp_path):
     finally:
         good.close()
         bad.close()
+
+
+def test_peer_outage_degrades_to_host_path(mesh, tmp_path):
+    """A failing peer broadcast (peer down mid-handoff) must degrade
+    every fused query kind to the per-shard host path — correct answers
+    from local data, never a 500 or a hung psum."""
+    import numpy as np
+
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.parallel import MeshEngine
+
+    h = Holder(str(tmp_path / "h2"))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    v = idx.create_field("v", FieldOptions(type="int", min=0, max=100))
+    ga = idx.create_field("ga")
+    rows, cols = [], []
+    for s in range(4):
+        for c in range(80):
+            rows.append(1 + (c % 2))
+            cols.append(s * SHARD_WIDTH + c)
+    f.import_bulk(rows, cols)
+    v.import_values([s * SHARD_WIDTH for s in range(4)], [7, 9, 11, 13])
+    ga.import_bulk([0, 1], [0, 1])
+    for field in (f, v, ga):
+        for vw in field.views.values():
+            for frag in vw.fragments.values():
+                frag.cache.recalculate()
+
+    eng = MeshEngine(h, mesh)
+    plain = Executor(h)
+    fused = Executor(h, mesh_engine=eng)
+    queries = [
+        "Count(Intersect(Row(f=1), Row(f=2)))",
+        "Count(Row(f=1))Count(Row(f=2))",  # multi-call batch
+        "Sum(field=v)",
+        "Min(field=v)",
+        "Max(field=v)",
+        "TopN(f, Row(f=1), n=2)",
+        "GroupBy(Rows(field=ga))",
+    ]
+    want = [plain.execute("i", q).results for q in queries]
+
+    def boom(kind, payload):
+        raise ConnectionError("peer down")
+
+    eng.collective_broadcast = boom  # every broadcast now fails
+    for q, w in zip(queries, want):
+        assert fused.execute("i", q).results == w, q
