@@ -218,6 +218,7 @@ class Cluster:
                 # STARTING forever while every peer is healthy.
                 existing.state = node.state
                 existing.uri = node.uri
+                self.save_topology()  # a rejoin may carry a NEW address
                 self._determine_state()
                 return
             old_nodes = list(self.nodes)

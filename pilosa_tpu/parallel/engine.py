@@ -198,8 +198,8 @@ _scatter_words_donated = functools.partial(
 )(_scatter_words_impl)
 
 
-# Re-exported for back-compat: the class lives in errors.py so the
-# executor can import it without pulling in jax.
+# Re-exported for back-compat; the class lives in errors.py so it has an
+# import-cycle-free home (see that module's docstring).
 from .errors import PeerlessMeshError  # noqa: E402
 
 
@@ -281,20 +281,24 @@ class MeshEngine:
         self.stack_rebuilds = 0
         self.stack_updates = 0
 
-    def _log_seq_stall(self, seq: int):
-        """A gate force-skip must leave a trace on THIS node — the
-        initiator-side log never fires when the initiator is the one
-        that died."""
+    def _log(self, msg: str):
+        """Engine-level operational log: the configured server logger,
+        or stderr when running engine-only (tests, notebooks)."""
         import sys
 
-        msg = (
-            f"mesh seq {seq} force-skipped after gate stall "
-            "(initiator died before commit?)"
-        )
         if self.logger is not None:
             self.logger.printf("%s", msg)
         else:
             print(msg, file=sys.stderr, flush=True)
+
+    def _log_seq_stall(self, seq: int):
+        """A gate force-skip must leave a trace on THIS node — the
+        initiator-side log never fires when the initiator is the one
+        that died."""
+        self._log(
+            f"mesh seq {seq} force-skipped after gate stall "
+            "(initiator died before commit?)"
+        )
 
     def _scalar(self, v: int):
         """Cached device int32 scalar (fresh device_puts per query are the
@@ -854,21 +858,16 @@ class MeshEngine:
         (a bug, not an outage) would disable every fused dispatch and be
         detectable only by latency.  The exception repr keeps bug-class
         failures (TypeError, ...) distinguishable from peer outages."""
-        import sys
         import time as time_mod
 
         now = time_mod.monotonic()
         if now - getattr(self, "_last_degraded_log", 0.0) < self.DEGRADED_LOG_INTERVAL:
             return
         self._last_degraded_log = now
-        msg = (
+        self._log(
             f"mesh broadcast for '{kind}' failed; fused queries degrade "
             f"to the host path: {err!r}"
         )
-        if self.logger is not None:
-            self.logger.printf("%s", msg)
-        else:
-            print(msg, file=sys.stderr, flush=True)
 
     def _dispatch_count(self, index, c, shards, canonical):
         lw = _Lowering(self, canonical)

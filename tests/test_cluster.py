@@ -320,3 +320,42 @@ def test_partition_nodes_go_around_ring():
         # Replicas are ADJACENT on the ring (wrapping).
         i0 = [n.id for n in nodes].index(owners[0])
         assert owners[1] == nodes[(i0 + 1) % 3].id
+
+
+def test_holder_cleaner_drops_unowned_fragments():
+    """TestHolderCleaner_CleanHolder (holder_internal_test.go:178): after
+    a topology change, fragments for shards this node no longer owns are
+    dropped; owned (and replicated) shards are retained exactly."""
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    n_shards = 8
+    for s in range(n_shards):
+        f.view_if_not_exists("standard").fragment_if_not_exists(s).set_bit(
+            1, s * SHARD_WIDTH + 3
+        )
+
+    nodes = [Node(f"n{i}", f"http://h{i}") for i in range(2)]
+    c = Cluster(node=nodes[0], replica_n=1)
+    c.nodes = nodes
+    c.holder = h
+    owned = {
+        s for s in range(n_shards) if c.owns_shard("n0", "i", s)
+    }
+    assert 0 < len(owned) < n_shards  # both nodes own something
+    epoch_before = h.shard_epoch("i")
+    c.clean_holder()
+    left = set(f.view("standard").fragments)
+    assert left == owned
+    assert h.shard_epoch("i") != epoch_before  # engines must invalidate
+    # Fully-replicated cluster: cleaner removes nothing.
+    c2 = Cluster(node=nodes[0], replica_n=2)
+    c2.nodes = nodes
+    c2.holder = h
+    epoch2 = h.shard_epoch("i")
+    c2.clean_holder()
+    assert set(f.view("standard").fragments) == left
+    assert h.shard_epoch("i") == epoch2  # no removal, no epoch bump

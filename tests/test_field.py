@@ -213,3 +213,83 @@ def test_schema():
     assert schema[0]["name"] == "i"
     names = [f["name"] for f in schema[0]["fields"]]
     assert names == ["f", "n"]
+
+
+def test_bsi_base_value_reference_table():
+    """The FULL base-value vector table from the reference
+    (field_internal_test.go TestBSIGroup_BaseValue :29-154) — including
+    the negative-min group, every LT/GT/EQ clamping quirk, and the
+    Between clamps.  These exact values are what keep BSI comparisons
+    bit-identical with the reference's plane layouts."""
+    from pilosa_tpu.core.field import BSIGroup
+
+    b0 = BSIGroup("b0", -100, 900)
+    b1 = BSIGroup("b1", 0, 1000)
+    b2 = BSIGroup("b2", 100, 1100)
+
+    vectors = [
+        # (group, op, val, expBase, expOutOfRange)
+        (b0, "<", 5, 105, False),
+        (b0, "<", -8, 92, False),
+        (b0, "<", -108, 0, True),
+        (b0, "<", 1005, 1000, False),
+        (b0, "<", 0, 100, False),
+        (b1, "<", 5, 5, False),
+        (b1, "<", -8, 0, True),
+        (b1, "<", 1005, 1000, False),
+        (b1, "<", 0, 0, False),
+        (b2, "<", 5, 0, True),
+        (b2, "<", -8, 0, True),
+        (b2, "<", 105, 5, False),
+        (b2, "<", 1105, 1000, False),
+        (b0, ">", -105, 0, False),
+        (b0, ">", 5, 105, False),
+        (b0, ">", 905, 0, True),
+        (b0, ">", 0, 100, False),
+        (b1, ">", 5, 5, False),
+        (b1, ">", -8, 0, False),
+        (b1, ">", 1005, 0, True),
+        (b1, ">", 0, 0, False),
+        (b2, ">", 5, 0, False),
+        (b2, ">", -8, 0, False),
+        (b2, ">", 105, 5, False),
+        (b2, ">", 1105, 0, True),
+        (b0, "==", -105, 0, True),
+        (b0, "==", 5, 105, False),
+        (b0, "==", 905, 0, True),
+        (b0, "==", 0, 100, False),
+        (b1, "==", 5, 5, False),
+        (b1, "==", -8, 0, True),
+        (b1, "==", 1005, 0, True),
+        (b1, "==", 0, 0, False),
+        (b2, "==", 5, 0, True),
+        (b2, "==", -8, 0, True),
+        (b2, "==", 105, 5, False),
+        (b2, "==", 1105, 0, True),
+    ]
+    for g, op, val, exp_base, exp_oor in vectors:
+        base, oor = g.base_value(op, val)
+        assert oor == exp_oor, (g.name, op, val)
+        assert base == exp_base, (g.name, op, val, base, exp_base)
+
+    between = [
+        (b0, -205, -105, 0, 0, True),
+        (b0, -105, 80, 0, 180, False),
+        (b0, 5, 20, 105, 120, False),
+        (b0, 20, 1005, 120, 1000, False),
+        (b0, 1005, 2000, 0, 0, True),
+        (b1, -105, -5, 0, 0, True),
+        (b1, -5, 20, 0, 20, False),
+        (b1, 5, 20, 5, 20, False),
+        (b1, 20, 1005, 20, 1000, False),
+        (b1, 1005, 2000, 0, 0, True),
+        (b2, 5, 95, 0, 0, True),
+        (b2, 95, 120, 0, 20, False),
+        (b2, 105, 120, 5, 20, False),
+        (b2, 120, 1105, 20, 1000, False),
+        (b2, 1105, 2000, 0, 0, True),
+    ]
+    for g, lo, hi, exp_lo, exp_hi, exp_oor in between:
+        got_lo, got_hi, oor = g.base_value_between(lo, hi)
+        assert oor == exp_oor, (g.name, lo, hi)
+        assert (got_lo, got_hi) == (exp_lo, exp_hi), (g.name, lo, hi)
