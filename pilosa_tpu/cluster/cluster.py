@@ -216,9 +216,13 @@ class Cluster:
                 # a recovery signal: refresh its state and re-run the
                 # state machine, or a restarted coordinator would report
                 # STARTING forever while every peer is healthy.
+                changed = (
+                    existing.state != node.state or existing.uri != node.uri
+                )
                 existing.state = node.state
                 existing.uri = node.uri
-                self.save_topology()  # a rejoin may carry a NEW address
+                if changed:
+                    self.save_topology()  # a rejoin may carry a NEW address
                 self._determine_state()
                 return
             old_nodes = list(self.nodes)
