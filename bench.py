@@ -26,12 +26,16 @@ Methodology, stated plainly:
   async serving pattern).  **Every rep uses different row ids** so no
   cross-query reuse is possible.
 - Physics audit: each device metric reports the HBM bytes its program
-  must read and the implied bandwidth; the bench FIRST measures the
-  chip's achievable read bandwidth with the same marginal method over a
-  STREAM-style popcount-reduce (`hbm_read_gbs`, lands ~745 GB/s on this
-  v5e — 91% of the 819 GB/s spec) and asserts every implied number is
-  under it (25% slack for noise).  A metric faster than the memory
-  system is a measurement bug, not a result.
+  must read and the implied bandwidth, and emit() asserts every implied
+  number is under the chip's SPEC bandwidth (819 GB/s + 25% slack for
+  noise).  A metric faster than the memory system is a measurement bug,
+  not a result.  The bench also measures achievable read bandwidth with
+  the same marginal method over a STREAM-style popcount-reduce
+  (`hbm_read_gbs`, ~700-770 GB/s here) as telemetry.
+- Metrics STREAM: each line prints as soon as its phase completes (the
+  north star last), so a wall-clock-limited run still reports
+  everything it measured.  A persistent XLA executable cache
+  (.jaxcache/) makes warm reruns skip the ~15 multi-minute compiles.
 - Host-reducing metrics are reported twice: `*_p50` is pipelined
   engine time (results on device, the serving pattern), `*_e2e_p50` is
   per-call synchronous wall clock including the tunnel readback.
@@ -50,6 +54,7 @@ Methodology, stated plainly:
 import json
 import statistics
 import time
+import os
 
 import numpy as np
 
@@ -65,8 +70,6 @@ GROUPS_C = 2  # 3-field fused GroupBy (round-4 VERDICT #4)
 ROW_BYTES = 1 << 17  # one 2^20-bit shard row = 128 KiB
 HTTP_REPS = 30
 
-PHYSICS = []  # (metric, seconds, bytes) for the post-hoc bandwidth check
-
 # v5e HBM spec: the hard physical ceiling for the audit.  The measured
 # STREAM number is reported as telemetry and is usually ~700 GB/s, but
 # relay congestion can depress a single measurement — a depressed
@@ -75,6 +78,13 @@ V5E_HBM_SPEC_GBS = 819.0
 
 
 def emit(metric, seconds, cpu_seconds, bytes_read=None):
+    """Print one metric line NOW (metrics stream as phases finish, so a
+    wall-clock-killed run still reports everything it measured; the
+    north star is emitted last by construction).  The physics audit runs
+    inline: nothing may beat the memory system.  The ceiling is the chip
+    SPEC — a relay-congested STREAM measurement may undershoot the chip
+    and must not fail valid metrics, and a noise-inflated one must not
+    raise the bar above physics."""
     rec = {
         "metric": metric,
         "value": round(seconds * 1e6, 1),
@@ -83,8 +93,12 @@ def emit(metric, seconds, cpu_seconds, bytes_read=None):
     }
     if bytes_read is not None:
         rec["bytes_read"] = bytes_read
-        rec["implied_gbs"] = round(bytes_read / seconds / 1e9, 1)
-        PHYSICS.append((metric, seconds, bytes_read))
+        implied = bytes_read / seconds / 1e9
+        rec["implied_gbs"] = round(implied, 1)
+        assert implied <= V5E_HBM_SPEC_GBS * 1.25, (
+            f"{metric}: implied {implied:.0f} GB/s exceeds ceiling "
+            f"{V5E_HBM_SPEC_GBS:.0f} GB/s — measurement bug, not a result"
+        )
     print(json.dumps(rec), flush=True)
 
 
@@ -192,6 +206,17 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # Persistent XLA executable cache: the bench's ~15 big compiles cost
+    # minutes through the tunneled backend; warm runs skip them all.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jaxcache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the cache knobs
+
     from pilosa_tpu import pql
     from pilosa_tpu.core.field import FieldOptions
     from pilosa_tpu.core.holder import Holder
@@ -227,22 +252,9 @@ def main():
     hbm_gbs = streams[0].nbytes / t_bw / 1e9
     del streams
     progress(f"measured HBM read bandwidth: {hbm_gbs:.0f} GB/s")
-    # Re-measured at the end of the run (remeasure_hbm): a congested
-    # minute at startup must not under-report the ceiling that the
-    # implied-vs-measured reconciliation below compares against.
-
-    def remeasure_hbm():
-        st = [
-            jax.device_put(
-                jnp.full((1 << 14, stream_words >> 14), i + 5, dtype=jnp.uint32)
-            )
-            for i in range(3)
-        ]
-        t, _ = engine_p50(
-            lambda i: stream_fn(st[i % 3]), 3, 12, rounds=6,
-            min_per=floor_per_query(1 << 30),
-        )
-        return st[0].nbytes / t / 1e9
+    # Telemetry only — the audit ceiling is the chip SPEC (see emit()):
+    # a congested measurement must not fail metrics under the chip.
+    emit_raw("hbm_read_gbs", hbm_gbs, "GB/s", 1.0)
 
     # ---- build: one 1B-col index + one 10M-col index + one 1-shard -------
     idx = holder.create_index("bench")
@@ -488,130 +500,6 @@ def main():
     t_gb, gb_res = sync_p50(lambda i: ex.execute("bench", q5).results[0], reps=4)
     progress("sum/min/max/groupby e2e timed")
 
-    # ---- HTTP end-to-end: sequential latency + concurrent QPS -----------
-    import urllib.request
-    from concurrent.futures import ThreadPoolExecutor
-
-    from pilosa_tpu.api import API
-    from pilosa_tpu.net.server import serve
-
-    api = API(holder=holder, mesh_engine=eng)
-    httpd, _ = serve(api, "localhost", 0)
-    port = httpd.server_address[1]
-    c2_texts = [
-        f"Count(Xor(Difference(Union(Row(f={100 + 4 * k}), Row(f={101 + 4 * k})), "
-        f"Row(f={102 + 4 * k})), Row(f={103 + 4 * k})))".encode()
-        for k in range(F10_ROWS // 4)
-    ]
-
-    def http_once(k):
-        req = urllib.request.Request(
-            f"http://localhost:{port}/index/b10m/query",
-            data=c2_texts[k % len(c2_texts)], method="POST",
-        )
-        req.add_header("Content-Type", "application/json")
-        with urllib.request.urlopen(req) as resp:
-            return json.loads(resp.read())["results"][0]
-
-    r_http0 = http_once(0)
-    t_http_all = []
-    for i in range(HTTP_REPS):
-        t0 = time.perf_counter()
-        http_once(i)
-        t_http_all.append(time.perf_counter() - t0)
-    t_http = statistics.median(t_http_all)
-
-    # QPS: 32 concurrent clients x 8 requests each, varied queries, over
-    # PERSISTENT HTTP/1.1 connections (urllib reconnects per request —
-    # that cost is the client's, not the server's).  The server-side
-    # micro-batcher drains concurrent Counts into one fused dispatch, so
-    # QPS should scale with client count instead of pinning at
-    # clients/readback-RTT (round-3 verdict weak #2).
-    import http.client
-
-    n_clients, per_client = 32, 8
-
-    def qps_client(c):
-        conn = http.client.HTTPConnection("localhost", port, timeout=120)
-        try:
-            for j in range(per_client):
-                k = c * per_client + j
-                conn.request(
-                    "POST", "/index/b10m/query",
-                    body=c2_texts[k % len(c2_texts)],
-                    headers={"Content-Type": "application/json"},
-                )
-                resp = conn.getresponse()
-                json.loads(resp.read())
-        finally:
-            conn.close()
-
-    with ThreadPoolExecutor(n_clients) as pool:
-        t0 = time.perf_counter()
-        list(pool.map(qps_client, range(n_clients)))
-        qps_wall = time.perf_counter() - t0
-    qps = n_clients * per_client / qps_wall
-    batcher = eng._batcher
-    if batcher is not None and batcher.batches:
-        progress(
-            f"micro-batcher: {batcher.batched_queries} queries in "
-            f"{batcher.batches} fused batches "
-            f"(avg {batcher.batched_queries / batcher.batches:.1f}/batch)"
-        )
-    httpd.shutdown()
-    progress(f"http timed ({qps:.1f} qps)")
-
-    # ---- mixed workload: write + query cycles (runs LAST among device
-    # metrics: the writes mutate f row 10, so every device-vs-host
-    # correctness assertion below compares values captured BEFORE this
-    # block against the untouched host copies) -----------------------------
-    # Each cycle sets one bit (host truth) and issues a fused count; the
-    # engine scatter-updates only the dirty row of the resident stack
-    # (engine.stack_updates advances, stack_rebuilds must NOT).
-    rebuilds_before = eng.stack_rebuilds
-
-    wr_nonce = iter(range(1, 1 << 30))
-
-    def wr_cycle(i):
-        # Row 12 is device-only: the host-baseline dict shares the numpy
-        # buffers of rows 10/11, so mutating those would corrupt the
-        # CPU-oracle assertions below.  The column comes from a nonce —
-        # NOT from i — because engine_p50 replays the same i values per
-        # round and a repeated set_bit is a no-op (no touch, no scatter).
-        n = next(wr_nonce)
-        frag = holder.fragment("bench", "f", "standard", n % N_SHARDS)
-        frag.set_bit(12, (n % N_SHARDS) * (1 << 20) + (7919 * n) % (1 << 20))
-        return eng.count_async("bench", ns_calls[i % len(ns_calls)], shards)
-
-    t_wr, _ = engine_p50(wr_cycle, 3, 27, rounds=2,
-                         min_per=floor_per_query(2 * N_SHARDS * ROW_BYTES))
-    assert eng.stack_rebuilds == rebuilds_before, "write forced a rebuild"
-    progress("write+query cycle timed")
-
-    # ---- bulk import + query cycle: a 300-shard import (300 dirty
-    # (row, shard) pairs — past round 3's 256-row scatter cap) must
-    # write-through to the resident stack via chunked scatters, zero
-    # rebuilds (round-4 VERDICT #8).  Rows 13+ are device-only; the
-    # host-baseline rows 10/11 stay untouched.
-    IMP_SHARDS = min(300, N_SHARDS)  # never create NEW shards mid-cycle
-    imp_nonce = iter(range(1, 1 << 30))
-
-    def imp_cycle(i):
-        n = next(imp_nonce)
-        row = 13 + (n % (F_ROWS - 4))
-        cols = [
-            s * (1 << 20) + (7919 * n + 131 * s) % (1 << 20)
-            for s in range(IMP_SHARDS)
-        ]
-        f.import_bulk([row] * IMP_SHARDS, cols)
-        return eng.count_async("bench", ns_calls[i % len(ns_calls)], shards)
-
-    rebuilds_before = eng.stack_rebuilds
-    t_imp, _ = engine_p50(imp_cycle, 2, 8, rounds=2,
-                          min_per=floor_per_query(2 * N_SHARDS * ROW_BYTES))
-    assert eng.stack_rebuilds == rebuilds_before, "bulk import forced a rebuild"
-    progress("bulk-import+query cycle timed")
-
     # ---- correctness + CPU baselines -------------------------------------
     F = host[("bench", "f", "standard")]
     F10 = host[("b10m", "f", "standard")]
@@ -645,7 +533,7 @@ def main():
             for rows in F10.values()
         )
 
-    assert cpu_c2() == int(r_c2_all[0]) == r_http0
+    assert cpu_c2() == int(r_c2_all[0])
     c_c2 = cpu_time(cpu_c2, reps=9)
 
     def cpu_c4():
@@ -750,13 +638,7 @@ def main():
                 assert got_gb3.get((i, j, k), 0) == int(want_gb3[i, j, k])
     c_gb3 = cpu_time(cpu_gb3, reps=1)
 
-    # ---- emit (north star LAST: the driver parses the final line) --------
     progress("baselines done")
-    hbm_gbs_end = remeasure_hbm()
-    hbm_gbs = max(hbm_gbs, hbm_gbs_end)
-    progress(f"end-of-run HBM re-measure: {hbm_gbs_end:.0f} GB/s "
-             f"(reporting max: {hbm_gbs:.0f})")
-    emit_raw("hbm_read_gbs", hbm_gbs, "GB/s", 1.0)
     emit("row_count_single_shard_p50", t_c1, c_c1)
     # Config 2 headline = marginal per-query cost in the batched serving
     # steady state (micro-batcher, K=16/dispatch); the single-dispatch
@@ -784,12 +666,138 @@ def main():
     emit("groupby_3field_1B_cols_p50", t_gb3_eng, c_gb3,
          bytes_read=(GROUPS_A + GROUPS_B + GROUPS_C) * N_SHARDS * ROW_BYTES)
     emit("groupby_3field_1B_cols_e2e_p50", t_gb3, c_gb3)
+
+
+    # ---- HTTP end-to-end: sequential latency + concurrent QPS -----------
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.net.server import serve
+
+    api = API(holder=holder, mesh_engine=eng)
+    httpd, _ = serve(api, "localhost", 0)
+    port = httpd.server_address[1]
+    c2_texts = [
+        f"Count(Xor(Difference(Union(Row(f={100 + 4 * k}), Row(f={101 + 4 * k})), "
+        f"Row(f={102 + 4 * k})), Row(f={103 + 4 * k})))".encode()
+        for k in range(F10_ROWS // 4)
+    ]
+
+    def http_once(k):
+        req = urllib.request.Request(
+            f"http://localhost:{port}/index/b10m/query",
+            data=c2_texts[k % len(c2_texts)], method="POST",
+        )
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())["results"][0]
+
+    r_http0 = http_once(0)
+    assert r_http0 == cpu_c2()
+    t_http_all = []
+    for i in range(HTTP_REPS):
+        t0 = time.perf_counter()
+        http_once(i)
+        t_http_all.append(time.perf_counter() - t0)
+    t_http = statistics.median(t_http_all)
+
+    # QPS: 32 concurrent clients x 8 requests each, varied queries, over
+    # PERSISTENT HTTP/1.1 connections (urllib reconnects per request —
+    # that cost is the client's, not the server's).  The server-side
+    # micro-batcher drains concurrent Counts into one fused dispatch, so
+    # QPS should scale with client count instead of pinning at
+    # clients/readback-RTT (round-3 verdict weak #2).
+    import http.client
+
+    n_clients, per_client = 32, 8
+
+    def qps_client(c):
+        conn = http.client.HTTPConnection("localhost", port, timeout=120)
+        try:
+            for j in range(per_client):
+                k = c * per_client + j
+                conn.request(
+                    "POST", "/index/b10m/query",
+                    body=c2_texts[k % len(c2_texts)],
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                json.loads(resp.read())
+        finally:
+            conn.close()
+
+    with ThreadPoolExecutor(n_clients) as pool:
+        t0 = time.perf_counter()
+        list(pool.map(qps_client, range(n_clients)))
+        qps_wall = time.perf_counter() - t0
+    qps = n_clients * per_client / qps_wall
+    batcher = eng._batcher
+    if batcher is not None and batcher.batches:
+        progress(
+            f"micro-batcher: {batcher.batched_queries} queries in "
+            f"{batcher.batches} fused batches "
+            f"(avg {batcher.batched_queries / batcher.batches:.1f}/batch)"
+        )
+    httpd.shutdown()
+    progress(f"http timed ({qps:.1f} qps)")
     emit("http_count_e2e_p50", t_http, c_c2)
     emit_raw("http_count_qps", qps, "qps", qps * c_c2)
+
+    # ---- mixed workload: write + query cycles (runs AFTER the
+    # correctness baselines above: the writes land in device-only rows
+    # (12, 13+) precisely so the host-baseline rows 10/11 — whose numpy
+    # buffers the assertions share — are never touched) --------------------
+    # Each cycle sets one bit (host truth) and issues a fused count; the
+    # engine scatter-updates only the dirty row of the resident stack
+    # (engine.stack_updates advances, stack_rebuilds must NOT).
+    rebuilds_before = eng.stack_rebuilds
+
+    wr_nonce = iter(range(1, 1 << 30))
+
+    def wr_cycle(i):
+        # Row 12 is device-only: the host-baseline dict shares the numpy
+        # buffers of rows 10/11, which later phases (cpu_ns in the
+        # north-star emit, cpu_imp) still read.  The column comes from a nonce —
+        # NOT from i — because engine_p50 replays the same i values per
+        # round and a repeated set_bit is a no-op (no touch, no scatter).
+        n = next(wr_nonce)
+        frag = holder.fragment("bench", "f", "standard", n % N_SHARDS)
+        frag.set_bit(12, (n % N_SHARDS) * (1 << 20) + (7919 * n) % (1 << 20))
+        return eng.count_async("bench", ns_calls[i % len(ns_calls)], shards)
+
+    t_wr, _ = engine_p50(wr_cycle, 3, 27, rounds=2,
+                         min_per=floor_per_query(2 * N_SHARDS * ROW_BYTES))
+    assert eng.stack_rebuilds == rebuilds_before, "write forced a rebuild"
+    progress("write+query cycle timed")
     # Mixed workload: CPU baseline = update one numpy row + recount the
     # north-star pair (what a dense CPU mirror would do per cycle).
     emit("write_query_cycle_1B_cols_p50", t_wr, c_ns,
          bytes_read=2 * N_SHARDS * ROW_BYTES)
+
+    # ---- bulk import + query cycle: a 300-shard import (300 dirty
+    # (row, shard) pairs — past round 3's 256-row scatter cap) must
+    # write-through to the resident stack via chunked scatters, zero
+    # rebuilds (round-4 VERDICT #8).  Rows 13+ are device-only; the
+    # host-baseline rows 10/11 stay untouched.
+    IMP_SHARDS = min(300, N_SHARDS)  # never create NEW shards mid-cycle
+    imp_nonce = iter(range(1, 1 << 30))
+
+    def imp_cycle(i):
+        n = next(imp_nonce)
+        row = 13 + (n % (F_ROWS - 4))
+        cols = [
+            s * (1 << 20) + (7919 * n + 131 * s) % (1 << 20)
+            for s in range(IMP_SHARDS)
+        ]
+        f.import_bulk([row] * IMP_SHARDS, cols)
+        return eng.count_async("bench", ns_calls[i % len(ns_calls)], shards)
+
+    rebuilds_before = eng.stack_rebuilds
+    t_imp, _ = engine_p50(imp_cycle, 2, 8, rounds=2,
+                          min_per=floor_per_query(2 * N_SHARDS * ROW_BYTES))
+    assert eng.stack_rebuilds == rebuilds_before, "bulk import forced a rebuild"
+    progress("bulk-import+query cycle timed")
     # Bulk import cycle: CPU mirror sets one bit in each of IMP_SHARDS
     # rows then recounts the pair.
     mirror = {
@@ -805,22 +813,9 @@ def main():
     emit("bulk_import_query_cycle_1B_cols_p50", t_imp, c_imp,
          bytes_read=2 * N_SHARDS * ROW_BYTES)
 
-    # Physics check: nothing may beat the memory system.  The ceiling is
-    # the chip SPEC: a relay-congested measurement may undershoot the
-    # chip (must not fail valid metrics), and a noise-inflated
-    # measurement must not raise the bar above physics.  The measured
-    # STREAM number is telemetry.
-    ceiling = V5E_HBM_SPEC_GBS
-    ns_bytes = 2 * N_SHARDS * ROW_BYTES
-    for metric, seconds, nbytes in PHYSICS + [
-        ("count_intersect_1B_cols_p50", t_ns, ns_bytes)
-    ]:
-        implied = nbytes / seconds / 1e9
-        assert implied <= ceiling * 1.25, (
-            f"{metric}: implied {implied:.0f} GB/s exceeds ceiling "
-            f"{ceiling:.0f} GB/s — measurement bug, not a result"
-        )
-    emit("count_intersect_1B_cols_p50", t_ns, c_ns, bytes_read=ns_bytes)
+    # ---- north star LAST: the driver parses the final line ---------------
+    emit("count_intersect_1B_cols_p50", t_ns, c_ns,
+         bytes_read=2 * N_SHARDS * ROW_BYTES)
 
 
 def __rand(rng, words64):

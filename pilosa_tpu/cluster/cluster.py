@@ -230,7 +230,14 @@ class Cluster:
             self._sort_nodes()
             self.save_topology()
         self._emit("join", node)
-        if resize and self.is_coordinator() and self.holder is not None:
+        # No data -> instant join, no resize round-trip (cluster.go:1716
+        # "Only change to normal if there is no existing data").
+        if (
+            resize
+            and self.is_coordinator()
+            and self.holder is not None
+            and self.holder.has_data()
+        ):
             self._run_resize(old_nodes)
         self._determine_state()
 
@@ -243,7 +250,12 @@ class Cluster:
             self.nodes = [n for n in self.nodes if n.id != node_id]
             self.save_topology()
         self._emit("leave", node)
-        if resize and self.is_coordinator() and self.holder is not None:
+        if (
+            resize
+            and self.is_coordinator()
+            and self.holder is not None
+            and self.holder.has_data()  # cluster.go:1747
+        ):
             self._run_resize(old_nodes)
         self._determine_state()
         return node

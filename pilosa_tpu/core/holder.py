@@ -150,6 +150,23 @@ class Holder:
                 for v in f.views.values():
                     v.on_create_shard = self._on_create_shard
 
+    def has_data(self) -> bool:
+        """True when the holder contains at least one index — open or
+        merely present as a directory under ``path`` (holder.go:221-234
+        peeks at the directory listing so an unopened holder can answer
+        before ``open()``).  Cluster bootstrap uses this to distinguish
+        an empty joining node (instant join) from one carrying data
+        (needs a resize job), cluster.go:1716,1747,1801."""
+        if self.indexes:
+            return True
+        if self.path is None or not os.path.isdir(self.path):
+            return False
+        return any(
+            not name.startswith(".")
+            and os.path.isdir(os.path.join(self.path, name))
+            for name in os.listdir(self.path)
+        )
+
     def index(self, name: str) -> Optional[Index]:
         return self.indexes.get(name)
 

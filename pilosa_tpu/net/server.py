@@ -548,23 +548,34 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
         self._dispatch("DELETE")
 
 
-def serve(
-    api: API, host: str = "localhost", port: int = 10101
-) -> Tuple[ThreadingHTTPServer, threading.Thread]:
-    """Start the HTTP server on a background thread; returns (server,
-    thread).  port=0 binds an ephemeral port (test harness pattern,
-    test/pilosa.go:38-103)."""
-    handler = Handler(api)
-    cls = type(
-        "_BoundHandler", (_HTTPRequestHandler,), {"handler": handler}
-    )
+def bind_http(host: str = "localhost", port: int = 10101) -> ThreadingHTTPServer:
+    """Bind the listening socket WITHOUT serving yet: callers that must
+    advertise an ephemeral port (server.py Open order: cluster/gossip
+    capture the URI before the API exists) learn the real port from
+    ``.server_address`` first, then pass the instance to serve()."""
+    cls = type("_BoundHandler", (_HTTPRequestHandler,), {"handler": None})
     # Serving tier: bursts of concurrent clients (the micro-batcher's
     # whole point) must not get connection-reset by the stdlib default
     # listen backlog of 5.
     srv_cls = type(
         "_PilosaHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 128}
     )
-    srv = srv_cls((host, port), cls)
+    return srv_cls((host, port), cls)
+
+
+def serve(
+    api: API,
+    host: str = "localhost",
+    port: int = 10101,
+    srv: Optional[ThreadingHTTPServer] = None,
+) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Start the HTTP server on a background thread; returns (server,
+    thread).  port=0 binds an ephemeral port (test harness pattern,
+    test/pilosa.go:38-103).  ``srv`` continues a socket pre-bound with
+    bind_http()."""
+    if srv is None:
+        srv = bind_http(host, port)
+    srv.RequestHandlerClass.handler = Handler(api)
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
     return srv, thread

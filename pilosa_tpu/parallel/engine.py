@@ -479,8 +479,16 @@ class MeshEngine:
         mesh where the local scatter can't reach peer replicas)."""
         if self.multiproc or cached.shards != canonical or not cached.frag_sync:
             return None
-        if token[0] != cached.versions[0] or token[1] != cached.versions[1]:
-            return None  # shard epoch or view identity changed
+        # Note: a shard-EPOCH delta (token[0]) alone does not bail — the
+        # epoch is per-index, so a fragment created in a SIBLING field
+        # (e.g. the auto `exists` field on first write) would otherwise
+        # force a full rebuild of every stack in the index.  This
+        # stack's own invalidations are all caught below: axis changes
+        # by the canonical compare above, fragment create/remove/replace
+        # by the per-shard weakref identity checks, row-set changes by
+        # the row_index lookup.
+        if token[1] != cached.versions[1]:
+            return None  # view identity changed (reopen)
         updates: List[Tuple[int, int, np.ndarray]] = []  # (row_idx, pos, words)
         # Word-level deltas, one ENTRY PER DIRTY ROW (vectors, not
         # per-word tuples — a near-cap sync can carry ~500k words):

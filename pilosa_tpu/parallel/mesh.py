@@ -47,19 +47,46 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def _row_major_format(sh: NamedSharding, ndim: int):
+    """Pin device layout to row-major for ndim>=2 operands.  jax 0.9's
+    device_put otherwise asks the compiler for a 'preferred' layout —
+    for [R, S, W] stacks that is shard-axis-major {2,0,1} — while the
+    row-gather kernels compute in row-major {2,1,0}; the mismatch makes
+    XLA open every dispatch with a full-stack relayout copy (a 2.9 GB
+    stack -> ~9 ms/query where the actual fused count is ~335 us).
+    Pinning the put keeps argument layout == fusion layout, and plain
+    jit adopts the argument's layout, so no copy anywhere."""
+    if ndim < 2:
+        return sh
+    try:
+        from jax.experimental.layout import Format, Layout
+    except ImportError:  # older jax: device_put keeps row-major already
+        return sh
+    return Format(Layout(major_to_minor=tuple(range(ndim))), sh)
+
+
 def put_global(mesh: Mesh, arr, spec: PartitionSpec):
     """Place a host array on the mesh with ``spec``.  Single-process this
     is a plain sharded device_put; in a multi-process runtime
     (jax.distributed) it assembles a GLOBAL array where each process
     contributes only the blocks its addressable devices own — the only
-    legal way to build shard_map operands on a pod."""
+    legal way to build shard_map operands on a pod.  Layout is pinned
+    row-major (see _row_major_format)."""
     import jax.numpy as jnp
 
     sh = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
-        return jax.device_put(jnp.asarray(arr), sh)
+        return jax.device_put(jnp.asarray(arr), _row_major_format(sh, np.ndim(arr)))
     host = np.asarray(arr)
-    return jax.make_array_from_callback(host.shape, sh, lambda idx: host[idx])
+    try:  # pin the layout on the multi-process path too
+        return jax.make_array_from_callback(
+            host.shape, _row_major_format(sh, host.ndim), lambda idx: host[idx]
+        )
+    except (TypeError, ValueError):
+        # jax without Format support in make_array_from_callback: accept
+        # the compiler-preferred layout (a per-dispatch relayout risk on
+        # pods — see _row_major_format).
+        return jax.make_array_from_callback(host.shape, sh, lambda idx: host[idx])
 
 
 def pad_shards(n_shards: int, mesh: Mesh) -> int:
@@ -85,4 +112,6 @@ def stack_sharded(arrays: Sequence[np.ndarray], mesh: Mesh, pad_to: Optional[int
     out = np.zeros((padded,) + base.shape, dtype=base.dtype)
     for i, a in enumerate(arrays):
         out[i] = a
-    return jax.device_put(jnp.asarray(out), shard_sharding(mesh))
+    return jax.device_put(
+        jnp.asarray(out), _row_major_format(shard_sharding(mesh), out.ndim)
+    )

@@ -33,6 +33,16 @@ from .util import (
 )
 
 
+def _advertise_uri(host: str, port: int) -> str:
+    """Dialable URI for the advertised node address.  Wildcard binds
+    ('', '0.0.0.0') are LISTEN addresses, not destinations — advertise
+    'localhost' for them (a multi-host deployment sets an explicit
+    bind host, which is advertised verbatim)."""
+    if host in ("", "0.0.0.0"):
+        host = "localhost"
+    return f"http://{host}:{port}"
+
+
 class Server:
     def __init__(self, config: Optional[Config] = None):
         self.config = config or Config()
@@ -98,6 +108,28 @@ class Server:
         host, port = self.config.bind_host_port()
         if port_override is not None:
             port = port_override
+        # Bind the HTTP socket FIRST (without serving): cluster, gossip,
+        # and the persisted topology all capture the advertised URI
+        # below, so an ephemeral port (port=0, the test-harness pattern)
+        # must be resolved to the real bound port before any of them
+        # run, or peers/restarts would dial ":0".
+        from .net.server import bind_http
+
+        self._http = bind_http(
+            host if host not in ("", "0.0.0.0") else "0.0.0.0", port
+        )
+        port = self._http.server_address[1]
+        try:
+            return self._open_bound(host, port)
+        except Exception:
+            # Release the bound-but-never-served socket, or a retry on
+            # the same port gets EADDRINUSE (close() must not shutdown()
+            # a socket whose serve_forever never ran — deadlock).
+            self._http.server_close()
+            self._http = None
+            raise
+
+    def _open_bound(self, host: str, port: int):
         # jax.distributed must come up before ANY device touch (holder
         # open may place fragments) — the analogue of setupNetworking
         # preceding holder.Open (server/server.go:302-331, server.go:334).
@@ -124,11 +156,19 @@ class Server:
         if self.cluster is not None:
             self.cluster.holder = self.holder
         mesh_engine = self._make_mesh_engine()
+        if self.cluster is not None:
+            local_node = self.cluster.node
+        else:
+            # Single-node (no cluster config): /status must still report
+            # the REAL node id + bound address, not a placeholder.
+            from .cluster import Node
+
+            local_node = Node(self.node_id, _advertise_uri(host, port), True)
         self.api = API(
             holder=self.holder,
             translate_store=self.translate_store,
             cluster=self.cluster,
-            node=self.cluster.node if self.cluster else None,
+            node=local_node,
             stats=self.stats,
             tracer=self.tracer,
             mesh_engine=mesh_engine,
@@ -137,9 +177,7 @@ class Server:
         )
         if mesh_engine is not None and self.config.mesh_sequencer:
             mesh_engine.ticket = self._make_ticket_fn()
-        self._http, self._http_thread = serve(
-            self.api, host if host not in ("", "0.0.0.0") else "0.0.0.0", port
-        )
+        self._http, self._http_thread = serve(self.api, srv=self._http)
         self.logger.printf(
             "pilosa-tpu listening on %s:%d (node %s)", host, port, self.node_id
         )
@@ -269,7 +307,7 @@ class Server:
             return
         from .cluster import Cluster, Node
 
-        uri = f"http://{host or 'localhost'}:{port}"
+        uri = _advertise_uri(host, port)
         self.cluster = Cluster(
             node=Node(self.node_id, uri, self.config.cluster_coordinator),
             replica_n=self.config.cluster_replicas,
@@ -435,6 +473,11 @@ class Server:
         if getattr(self, "gossip", None) is not None:
             self.gossip.close()
         if self._http is not None:
-            self._http.shutdown()
+            if self._http_thread is not None:
+                # shutdown() waits on an event only serve_forever() sets
+                # — calling it on a bound-but-never-served socket (open()
+                # failed mid-way) deadlocks (socketserver.BaseServer).
+                self._http.shutdown()
+            self._http.server_close()
         self.holder.close()
         self.translate_store.close()
