@@ -195,3 +195,18 @@ def test_fast_count_lane():
     idx.delete_field("f")
     idx.create_field("f")
     assert ex.execute("i", q, shards=[0]).results[0] == 0
+
+
+def test_old_pql_rejected_at_execution(ex):
+    """executor_test.go:727 TestExecutor_Execute_OldPQL — legacy v0 call
+    names parse (pqlpeg_test.go:50) but the executor rejects them with
+    'unknown call', matching the reference's error text."""
+    import pytest
+
+    from pilosa_tpu.executor import Error
+
+    ex.holder.create_index("i").create_field("f")
+    with pytest.raises(Error, match="unknown call: SetBit"):
+        ex.execute("i", "SetBit(frame=f, row=11, col=1)")
+    with pytest.raises(Error, match="unknown call: Bitmap"):
+        ex.execute("i", "Bitmap(f=11)")
