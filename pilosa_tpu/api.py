@@ -359,8 +359,12 @@ class API:
     def _import_local(self, idx, f, row_ids, col_ids, timestamps):
         ts = None
         if timestamps:
+            # ImportRequest.Timestamps are epoch-NANOSECONDS, matching the
+            # reference wire format (api.go:874 `time.Unix(0, ts)`).
             ts = [
-                dt.datetime.fromtimestamp(t, dt.timezone.utc).replace(tzinfo=None)
+                dt.datetime.fromtimestamp(
+                    t / 1e9, dt.timezone.utc
+                ).replace(tzinfo=None)
                 if t
                 else None
                 for t in timestamps

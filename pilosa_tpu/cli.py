@@ -61,7 +61,22 @@ def cmd_import(args) -> int:
             opts["max"] = args.field_max
         client.ensure_field(args.index, args.field, opts)
 
-    rows, cols, vals = [], [], []
+    def parse_ts(text: str) -> int:
+        """RFC3339 timestamp column -> epoch NANOS, the import wire unit
+        (ctl/import.go parseRFC3339 -> UnixNano).  Accepts zone
+        designators and fractional seconds via fromisoformat; naive
+        stamps are taken as UTC."""
+        import datetime as dt
+
+        try:
+            t = dt.datetime.fromisoformat(text.replace("Z", "+00:00"))
+        except ValueError:
+            raise SystemExit(f"bad timestamp: {text!r}")
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=dt.timezone.utc)
+        return int(t.timestamp() * 1e6) * 1000
+
+    rows, cols, vals, stamps = [], [], [], []
     is_value = args.create_field_type == "int"
     for path in args.files:
         f = sys.stdin if path == "-" else open(path)
@@ -75,6 +90,9 @@ def cmd_import(args) -> int:
                 else:
                     rows.append(int(rec[0]))
                     cols.append(int(rec[1]))
+                    stamps.append(
+                        parse_ts(rec[2]) if len(rec) > 2 and rec[2] else 0
+                    )
         finally:
             if path != "-":
                 f.close()
@@ -88,11 +106,16 @@ def cmd_import(args) -> int:
         for shard, (cs, vs) in sorted(by_shard.items()):
             client.import_values(args.index, args.field, shard, cs, vs)
     else:
-        for r, c in zip(rows, cols):
-            by_shard.setdefault(c // SHARD_WIDTH, ([], []))[0].append(r)
-            by_shard[c // SHARD_WIDTH][1].append(c)
-        for shard, (rs, cs) in sorted(by_shard.items()):
-            client.import_bits(args.index, args.field, shard, rs, cs)
+        for r, c, t in zip(rows, cols, stamps):
+            b = by_shard.setdefault(c // SHARD_WIDTH, ([], [], []))
+            b[0].append(r)
+            b[1].append(c)
+            b[2].append(t)
+        for shard, (rs, cs, ts) in sorted(by_shard.items()):
+            client.import_bits(
+                args.index, args.field, shard, rs, cs,
+                timestamps=ts if any(ts) else None,
+            )
     print(f"imported {len(cols)} bits into {args.index}/{args.field}")
     return 0
 

@@ -110,7 +110,8 @@ def test_api_timestamped_import():
     api.create_field("i", "t", {"type": "time", "timeQuantum": "YMD"})
     import datetime as dt
 
-    ts = int(dt.datetime(2018, 3, 1, tzinfo=dt.timezone.utc).timestamp())
+    # Epoch-nanos, the reference wire unit (api.go:874 time.Unix(0, ts)).
+    ts = int(dt.datetime(2018, 3, 1, tzinfo=dt.timezone.utc).timestamp()) * 10**9
     api.import_bits(
         ImportRequest("i", "t", row_ids=[1, 1], column_ids=[5, 6], timestamps=[ts, 0])
     )

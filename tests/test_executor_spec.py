@@ -611,3 +611,81 @@ def test_set_value_and_range_keyed_columns():
     assert sorted(r.keys) == ["y"]
     vc = ex.execute("i", "Sum(field=v)").results[0]
     assert (vc.val, vc.count) == (100, 2)
+
+
+# -- Min/Max filter sweep (executor_test.go:1179 TestExecutor_Execute_MinMax)
+
+
+@pytest.fixture
+def minmax_env():
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("x")
+    idx.create_field("f", FieldOptions(type="int", min=-10, max=100))
+    ex = Executor(h)
+    SW = SHARD_WIDTH
+    ex.execute(
+        "i",
+        f"""
+        Set(0, x=0) Set(3, x=0) Set({SW + 1}, x=0)
+        Set(1, x=1)
+        Set({SW + 2}, x=2)
+        Set(0, f=20) Set(1, f=-5) Set(2, f=-5) Set(3, f=10)
+        Set({SW}, f=30) Set({SW + 2}, f=40)
+        Set({5 * SW + 100}, f=50) Set({SW + 1}, f=60)
+        """,
+    )
+    return ex
+
+
+@pytest.mark.parametrize("filt,exp,cnt", [
+    ("", -5, 2),
+    ("Row(x=0)", 10, 1),
+    ("Row(x=1)", -5, 1),
+    ("Row(x=2)", 40, 1),
+])
+def test_min_filters(minmax_env, filt, exp, cnt):
+    q = f"Min({filt}, field=f)" if filt else "Min(field=f)"
+    vc = minmax_env.execute("i", q).results[0]
+    assert (vc.val, vc.count) == (exp, cnt)
+
+
+@pytest.mark.parametrize("filt,exp,cnt", [
+    ("", 60, 1),
+    ("Row(x=0)", 60, 1),
+    ("Row(x=1)", -5, 1),
+    ("Row(x=2)", 40, 1),
+])
+def test_max_filters(minmax_env, filt, exp, cnt):
+    q = f"Max({filt}, field=f)" if filt else "Max(field=f)"
+    vc = minmax_env.execute("i", q).results[0]
+    assert (vc.val, vc.count) == (exp, cnt)
+
+
+def test_minmax_keyed_columns():
+    """executor_test.go:1272 ColumnKey variant: same sweep through a
+    keyed index."""
+    h = Holder()
+    h.open()
+    idx = h.create_index("i", keys=True)
+    idx.create_field("x")
+    idx.create_field("f", FieldOptions(type="int", min=-10, max=100))
+    ex = Executor(h, translator=QueryTranslator(TranslateFile()))
+    ex.execute(
+        "i",
+        """
+        Set("zero", x=0) Set("three", x=0)
+        Set("one", x=1)
+        Set("zero", f=20) Set("one", f=-5) Set("two", f=-5)
+        Set("three", f=10) Set("four", f=60)
+        """,
+    )
+    vc = ex.execute("i", "Min(field=f)").results[0]
+    assert (vc.val, vc.count) == (-5, 2)
+    vc = ex.execute("i", "Max(field=f)").results[0]
+    assert (vc.val, vc.count) == (60, 1)
+    vc = ex.execute("i", "Min(Row(x=0), field=f)").results[0]
+    assert (vc.val, vc.count) == (10, 1)
+    vc = ex.execute("i", "Max(Row(x=1), field=f)").results[0]
+    assert (vc.val, vc.count) == (-5, 1)

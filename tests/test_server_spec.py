@@ -1,0 +1,158 @@
+"""Full-server scenario tests modeled on the reference's
+server/server_test.go: randomized writes through HTTP vs an oracle
+(:39 TestMain_Set_Quick), timestamped imports creating the time-view
+fanout (:572 TestMain_ImportTimestamp), and multi-node cluster queries
+surviving a full-cluster restart (:676 TestClusterQueriesAfterRestart)."""
+
+import os
+import random
+
+import pytest
+
+from tests.harness import run_cluster
+
+
+def test_set_clear_quickcheck(tmp_path):
+    """server_test.go:39 TestMain_Set_Quick — random Set/Clear streams
+    through the real HTTP server match a python-set oracle."""
+    c = run_cluster(tmp_path, 1)
+    try:
+        cli = c.client()
+        cli.create_index("i")
+        cli.create_field("i", "f")
+        rng = random.Random(7)
+        oracle = set()  # (row, col)
+        for _ in range(300):
+            row = rng.randrange(4)
+            col = rng.randrange(3 * 2**20)  # spans 3 shards
+            if rng.random() < 0.7:
+                res = cli.query("i", f"Set({col}, f={row})")["results"][0]
+                assert res == ((row, col) not in oracle)
+                oracle.add((row, col))
+            else:
+                res = cli.query("i", f"Clear({col}, f={row})")["results"][0]
+                assert res == ((row, col) in oracle)
+                oracle.discard((row, col))
+        for row in range(4):
+            want = sorted(c for r, c in oracle if r == row)
+            got = cli.query("i", f"Row(f={row})")["results"][0]["columns"]
+            assert got == want, row
+            cnt = cli.query("i", f"Count(Row(f={row}))")["results"][0]
+            assert cnt == len(want)
+    finally:
+        c.close()
+
+
+def test_import_timestamp_creates_time_views(tmp_path):
+    """server_test.go:572 — a timestamped import materializes the full
+    YMD view fanout on disk."""
+    c = run_cluster(tmp_path, 1)
+    try:
+        cli = c.client()
+        cli.create_index("i")
+        cli.create_field("i", "f", {"type": "time", "timeQuantum": "YMD"})
+        # 2018-01-01T00:00 and 2019-12-31T23:00 as epoch-nanos.
+        cli.import_bits(
+            "i", "f", 0, [1, 2], [1, 2],
+            timestamps=[1514764800000000000, 1577833200000000000],
+        )
+        views_dir = os.path.join(
+            c[0].data_dir, "i", "f", "views"
+        )
+        got = sorted(os.listdir(views_dir))
+        exp = sorted(
+            [
+                "standard", "standard_2018", "standard_201801",
+                "standard_20180101", "standard_2019", "standard_201912",
+                "standard_20191231",
+            ]
+        )
+        assert got == exp, got
+        # And the time-range query sees exactly the 2018 bit.
+        out = cli.query(
+            "i", "Range(f=1, 2018-01-01T00:00, 2018-12-31T00:00)"
+        )
+        assert out["results"][0]["columns"] == [1]
+    finally:
+        c.close()
+
+
+def test_cluster_queries_after_restart(tmp_path):
+    """server_test.go:676 TestClusterQueriesAfterRestart — write through
+    a 3-node cluster, restart every node, queries still answer from the
+    recovered holders."""
+    c = run_cluster(tmp_path, 3)
+    try:
+        cli = c.client()
+        cli.create_index("i")
+        cli.create_field("i", "f")
+        # Columns across several shards so every node owns data.
+        cols = [s * 2**20 + 7 for s in range(6)]
+        for col in cols:
+            cli.query("i", f"Set({col}, f=1)")
+        before = cli.query("i", "Count(Row(f=1))")["results"][0]
+        assert before == len(cols)
+    finally:
+        c.close()
+
+    c2 = run_cluster(tmp_path, 3)
+    try:
+        cli = c2.client()
+        out = cli.query("i", "Count(Row(f=1))")["results"][0]
+        assert out == len(cols)
+        assert cli.query("i", "Row(f=1)")["results"][0]["columns"] == cols
+        # Writes keep working after recovery.
+        cli.query("i", f"Set({6 * 2**20 + 7}, f=1)")
+        assert cli.query("i", "Count(Row(f=1))")["results"][0] == len(cols) + 1
+    finally:
+        c2.close()
+
+
+def test_recalculate_hashes_converges_blocks(tmp_path):
+    """server_test.go:258 TestMain_RecalculateHashes — block checksums
+    agree across nodes holding identical data (the anti-entropy
+    precondition)."""
+    c = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        cli = c.client()
+        cli.create_index("i")
+        cli.create_field("i", "f")
+        for col in (1, 5, 2**20 + 3):
+            cli.query("i", f"Set({col}, f=9)")
+        # With replica_n=2 both nodes hold every shard; their fragment
+        # block checksums must match.
+        for shard in (0, 1):
+            b0 = c.client(0).fragment_blocks("i", "f", "standard", shard)
+            b1 = c.client(1).fragment_blocks("i", "f", "standard", shard)
+            assert b0 == b1, shard
+    finally:
+        c.close()
+
+
+def test_cli_import_with_timestamp_column(tmp_path):
+    """ctl/import.go: the optional third CSV column is an RFC3339
+    timestamp routed into the time-view fanout."""
+    from pilosa_tpu.cli import main as cli_main
+
+    c = run_cluster(tmp_path, 1)
+    try:
+        cli = c.client()
+        cli.create_index("i")
+        cli.create_field("i", "t", {"type": "time", "timeQuantum": "YMD"})
+        csv_path = tmp_path / "bits.csv"
+        # Mixed forms: RFC3339 with Z designator, and a trailing comma
+        # (empty timestamp field = no timestamp).
+        csv_path.write_text("1,5,2018-03-01T00:00:00Z\n1,6,\n")
+        rc = cli_main(
+            [
+                "import",
+                "--host", f"http://localhost:{c[0].port}",
+                "-i", "i", "-f", "t", str(csv_path),
+            ]
+        )
+        assert rc == 0
+        out = cli.query("i", "Range(t=1, 2018-01-01T00:00, 2019-01-01T00:00)")
+        assert out["results"][0]["columns"] == [5]
+        assert cli.query("i", "Row(t=1)")["results"][0]["columns"] == [5, 6]
+    finally:
+        c.close()
