@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
@@ -99,6 +99,21 @@ class _Lowering:
         self.operands: list = []
         self.specs: list = []
         self._mat_ids: Dict[int, int] = {}
+        self._stacks: dict = {}
+
+    def stack_for(self, index, field, view):
+        """ONE field_stack call per (index, field, view) per query.
+        A second fetch could re-run the incremental sync (a concurrent
+        writer bumps fragment versions at any time) and DONATE the
+        matrix an earlier leaf of this same query already captured in
+        ``operands`` — a deleted-buffer crash at enqueue.  Caching also
+        gives the query one consistent stack snapshot."""
+        key = (index, field, view)
+        if key not in self._stacks:
+            self._stacks[key] = self.engine.field_stack(
+                index, field, view, self.canonical
+            )
+        return self._stacks[key]
 
     def add_matrix(self, mat) -> int:
         key = id(mat)
@@ -136,11 +151,10 @@ def _scatter_rows_impl(mesh, matrix, rows, poss, vals):
     """Scatter updated shard rows into a resident [R, S, W] stack:
     matrix[rows[i], poss[i]] = vals[i].  Runs as a shard_map so each
     device writes only its local shard block (out-of-block lanes drop).
-    Jitted twice below: the first chunk of a delta must NOT donate (an
-    in-flight dispatch may still hold the old buffer, so XLA makes an
-    on-device copy — ~4 ms for a 3 GB stack vs seconds re-uploading
-    from host); chunks 2..K donate the private intermediate the
-    previous chunk produced and update in place."""
+    All chunks DONATE (in-place update): the engine's _dispatch_lock
+    guarantees no thread holds a stale handle mid-enqueue, and PJRT's
+    in-order stream protects already-enqueued readers (see the
+    donation contract in _try_incremental_sync)."""
 
     def body(m, r, p, v):
         i = jax.lax.axis_index(SHARD_AXIS)
@@ -159,12 +173,38 @@ def _scatter_rows_impl(mesh, matrix, rows, poss, vals):
     )(matrix, rows, poss, vals)
 
 
-_scatter_rows = functools.partial(jax.jit, static_argnums=(0,))(
-    _scatter_rows_impl
-)
-_scatter_rows_donated = functools.partial(
-    jax.jit, static_argnums=(0,), donate_argnums=(1,)
-)(_scatter_rows_impl)
+@functools.lru_cache(maxsize=None)
+def _scatter_jits(mesh):
+    """Per-mesh scatter executables with the stack's layout PINNED
+    row-major on both sides.  Left unconstrained, XLA returns the
+    scatter output in its preferred shard-axis-major layout — after the
+    first write, the scatter itself and EVERY later fused query over
+    that stack open with a full-stack relayout copy (~2.9 ms/GB,
+    measured: a 107 us count became 2.99 ms).  Pinning keeps the
+    resident stack in the layout every query kernel computes in (see
+    mesh._row_major_format)."""
+    from .mesh import _row_major_format
+
+    fmt = _row_major_format(NamedSharding(mesh, P(None, SHARD_AXIS)), 3)
+
+    def make(impl, n_extra, donate):
+        kw = {
+            "static_argnums": (0,),
+            "in_shardings": (fmt,) + (None,) * n_extra,
+            "out_shardings": fmt,
+        }
+        if donate:
+            kw["donate_argnums"] = (1,)
+        return functools.partial(jax.jit, **kw)(impl)
+
+    return {
+        "rows_donated": make(_scatter_rows_impl, 3, True),
+        "words_donated": make(_scatter_words_impl, 4, True),
+    }
+
+
+def _scatter_rows_donated(mesh, *args):
+    return _scatter_jits(mesh)["rows_donated"](mesh, *args)
 
 
 def _scatter_words_impl(mesh, matrix, rows, poss, widxs, vals):
@@ -190,12 +230,8 @@ def _scatter_words_impl(mesh, matrix, rows, poss, widxs, vals):
     )(matrix, rows, poss, widxs, vals)
 
 
-_scatter_words = functools.partial(jax.jit, static_argnums=(0,))(
-    _scatter_words_impl
-)
-_scatter_words_donated = functools.partial(
-    jax.jit, static_argnums=(0,), donate_argnums=(1,)
-)(_scatter_words_impl)
+def _scatter_words_donated(mesh, *args):
+    return _scatter_jits(mesh)["words_donated"](mesh, *args)
 
 
 # Re-exported for back-compat; the class lives in errors.py so it has an
@@ -227,6 +263,12 @@ class MeshEngine:
         # assignments and mark a write synced that the served matrix
         # doesn't contain (silently lost until the row is next touched).
         self._stacks_lock = threading.RLock()
+        # Serializes [stack lookup -> sync -> enqueue] across ALL fused
+        # dispatch paths (_collective) and field_stack itself: the
+        # invariant that makes donating scatter-sync safe (no thread
+        # holds a stale matrix handle it is about to enqueue while a
+        # sync invalidates it).  Always taken BEFORE _stacks_lock.
+        self._dispatch_lock = threading.RLock()
         self._resident_bytes = 0
         # (weakref to evicted device matrix, nbytes): evicted stacks whose
         # HBM may still be held by an in-flight dispatch.
@@ -373,7 +415,10 @@ class MeshEngine:
         key = (index, field, view)
         if canonical is None:
             canonical = self.canonical_shards(index)
-        with self._stacks_lock:
+        # Lock order: _dispatch_lock before _stacks_lock (dispatch paths
+        # already hold the former via _collective; direct callers take
+        # both here).
+        with self._dispatch_lock, self._stacks_lock:
             return self._field_stack_locked(key, index, field, view, canonical)
 
     def _field_stack_locked(self, key, index, field, view, canonical):
@@ -526,22 +571,21 @@ class MeshEngine:
             if dirty:
                 new_sync[si] = (fref, new_version)
         if updates or word_updates:
-            # Admission: the first (non-donated) scatter transiently
-            # doubles this stack's footprint; evict others first like
-            # the rebuild path.
-            while (
-                self._resident_bytes
-                + self._pending_bytes()
-                + cached.matrix.nbytes
-                > self.max_resident_bytes
-                and len(self._stacks) > 1
-            ):
-                victim = next(
-                    k for k in self._stacks if self._stacks[k] is not cached
-                )
-                self._evict(victim)
             mat = cached.matrix
-            donated = False  # first dispatch copies; the rest donate
+            # EVERY chunk donates — the update runs in place instead of
+            # opening with a full-stack device copy (~9 ms on a 3 GB
+            # stack, formerly the dominant cost of every write+query
+            # cycle; measured 1.6 us after).  Safe because (a) this
+            # runs under _dispatch_lock, and every dispatch captures
+            # its operand handles inside the same lock via
+            # _locked_dispatch, re-reading stack.matrix after any sync
+            # (donation mutates cached.matrix in place, and
+            # _Lowering.stack_for dedups fetches so one query never
+            # syncs twice); (b) executions already enqueued keep their
+            # own buffer reference through PJRT's in-order stream.
+            # CONTRACT for any new caller: never hold a stack.matrix
+            # handle across a field_stack call — re-read it from the
+            # stack object.
             for ci in range(0, len(updates), self.SCATTER_CHUNK_ROWS):
                 chunk = updates[ci : ci + self.SCATTER_CHUNK_ROWS]
                 D = len(chunk)
@@ -553,12 +597,10 @@ class MeshEngine:
                     r, p, w = chunk[min(i, D - 1)]  # pad repeats the last
                     rows[i], poss[i] = r, p
                     vals[i] = w
-                fn = _scatter_rows_donated if donated else _scatter_rows
-                mat = fn(
+                mat = _scatter_rows_donated(
                     self.mesh, mat, jnp.asarray(rows), jnp.asarray(poss),
                     jnp.asarray(vals),
                 )
-                donated = True
             if word_updates:
                 D_pad = max(8, 1 << (n_words - 1).bit_length())
                 rows_w = np.empty(D_pad, dtype=np.int32)
@@ -576,8 +618,7 @@ class MeshEngine:
                 # Pad repeats the last word (idempotent set).
                 rows_w[o:], poss_w[o:] = rows_w[o - 1], poss_w[o - 1]
                 widx_w[o:], vals_w[o:] = widx_w[o - 1], vals_w[o - 1]
-                fn = _scatter_words_donated if donated else _scatter_words
-                mat = fn(
+                mat = _scatter_words_donated(
                     self.mesh,
                     mat,
                     jnp.asarray(rows_w),
@@ -691,7 +732,7 @@ class MeshEngine:
         ):
             if f.view(view_name) is None:
                 continue
-            stack = self.field_stack(index, field_name, view_name, lw.canonical)
+            stack = lw.stack_for(index, field_name, view_name)
             if stack is None or row_id not in stack.row_index:
                 continue
             i_mat = lw.add_matrix(stack.matrix)
@@ -707,7 +748,7 @@ class MeshEngine:
         return ("zero", lw.add_matrix(self._zero_stack(lw.canonical)))
 
     def _lower_row(self, index, field, row_id, lw: _Lowering):
-        stack = self.field_stack(index, field, VIEW_STANDARD, lw.canonical)
+        stack = lw.stack_for(index, field, VIEW_STANDARD)
         if stack is None or row_id not in stack.row_index:
             return self._lower_zero(lw)
         i_mat = lw.add_matrix(stack.matrix)
@@ -735,9 +776,7 @@ class MeshEngine:
         if bsig is None:
             raise ValueError(f"field not found: {field_name}")
         depth = bsig.bit_depth()
-        stack = self.field_stack(
-            index, field_name, view_bsi_name(field_name), lw.canonical
-        )
+        stack = lw.stack_for(index, field_name, view_bsi_name(field_name))
         if stack is None:
             return self._lower_zero(lw)
         i_mat = lw.add_matrix(stack.matrix)
@@ -825,9 +864,17 @@ class MeshEngine:
         seq gate instead of the collective lock: tickets define the
         global order, so concurrent initiators on different nodes are
         safe.  Without one, this process's lock serializes its own
-        stream and deployments route through a single entry node."""
+        stream and deployments route through a single entry node.
+
+        EVERY dispatch() (all branches) runs under ``_dispatch_lock``:
+        it serializes [stack lookup -> incremental sync -> enqueue],
+        which is what makes DONATING scatter-sync safe — no other
+        thread can sit between fetching a stack handle and enqueueing
+        it while a sync invalidates that handle.  Enqueues are cheap
+        and the device executes serially anyway, so the serialization
+        costs nothing in throughput."""
         if not broadcast or self.collective_broadcast is None:
-            return dispatch()
+            return self._locked_dispatch(dispatch)
         if self.ticket is not None:
             seq = int(self.ticket())
             try:
@@ -845,7 +892,7 @@ class MeshEngine:
                     f"collective seq {seq} was force-skipped (gate stall)"
                 )
             try:
-                return dispatch()
+                return self._locked_dispatch(dispatch)
             finally:
                 self.seq_gate.exit(seq)
         with self.collective_lock:
@@ -854,6 +901,15 @@ class MeshEngine:
             except Exception as e:
                 self._log_degraded(kind, e)
                 raise PeerlessMeshError(f"mesh broadcast failed: {e!r}") from e
+            return self._locked_dispatch(dispatch)
+
+    def _locked_dispatch(self, dispatch):
+        """Run a dispatch closure under _dispatch_lock.  Closures build
+        their _Lowering (stack fetches included) INSIDE this section,
+        so every device handle they capture post-dates any donating
+        sync and no concurrent sync can invalidate it before enqueue
+        (the donating-scatter safety contract, _try_incremental_sync)."""
+        with self._dispatch_lock:
             return dispatch()
 
     # Seconds between degraded-mode log lines (one per query would spam
@@ -1017,16 +1073,16 @@ class MeshEngine:
                 ),
                 canonical,
             )
-        lw = _Lowering(self, canonical)
-        prog = self._lower(index, c, lw)
-        mask = self._mask_words(shards, canonical)
-        self.fused_dispatches += 1
-        return (
-            kernels.eval_tree(
+        def sp_dispatch():
+            lw = _Lowering(self, canonical)
+            prog = self._lower(index, c, lw)
+            mask = self._mask_words(shards, canonical)
+            self.fused_dispatches += 1
+            return kernels.eval_tree(
                 self.mesh, prog, tuple(lw.specs), mask, *lw.operands
-            ),
-            canonical,
-        )
+            )
+
+        return self._locked_dispatch(sp_dispatch), canonical
 
     def bitmap_row(self, index: str, c: Call, shards: List[int]):
         """Evaluate a tree and materialize a core Row (host segments).
@@ -1075,11 +1131,11 @@ class MeshEngine:
         if stack is None:
             return None
         canonical = stack.shards
-        lw = _Lowering(self, canonical)
-        prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
 
         def dispatch():
+            lw = _Lowering(self, canonical)
+            prog = self._lower_filter(index, filter_call, lw)
             self.fused_dispatches += 1
             return kernels.sum_tree(
                 self.mesh,
@@ -1143,11 +1199,11 @@ class MeshEngine:
         if stack is None:
             return None
         canonical = stack.shards
-        lw = _Lowering(self, canonical)
-        prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
 
         def dispatch():
+            lw = _Lowering(self, canonical)
+            prog = self._lower_filter(index, filter_call, lw)
             self.fused_dispatches += 1
             return kernels.minmax_tree(
                 self.mesh,
@@ -1238,11 +1294,11 @@ class MeshEngine:
             ),
             P(),
         )
-        lw = _Lowering(self, stack.shards)
-        prog = self._lower(index, src_call, lw)
         mask = self._mask_words(shards, stack.shards)
 
         def dispatch():
+            lw = _Lowering(self, stack.shards)
+            prog = self._lower(index, src_call, lw)
             self.fused_dispatches += 1
             return kernels.topn_tree(
                 self.mesh,
@@ -1405,13 +1461,13 @@ class MeshEngine:
         n_out = None
         if n and not row_ids:
             n_out = min(int(n), K_pad)
-        lw = _Lowering(self, stack.shards)
-        prog = self._lower(index, src_call, lw)
         mask = self._mask_words(shards, stack.shards)
         extra_ops = () if entry.idxs is not None else (entry.dyn_idxs,)
         extra_specs = () if entry.idxs is not None else (P(),)
 
         def dispatch():
+            lw = _Lowering(self, stack.shards)
+            prog = self._lower(index, src_call, lw)
             self.fused_dispatches += 1
             return kernels.topn_full_tree(
                 self.mesh,
@@ -1585,12 +1641,12 @@ class MeshEngine:
                         self.mesh, np.asarray(t, dtype=np.int32), P()
                     )
                 )
-        lw = _Lowering(self, canonical)
-        prog = self._lower_filter(index, filter_call, lw)
         mask = self._mask_words(shards, canonical)
         extra_specs = (P(),) * len(extra_ops)
 
         def dispatch():
+            lw = _Lowering(self, canonical)
+            prog = self._lower_filter(index, filter_call, lw)
             self.fused_dispatches += 1
             return kernels.groupn_tree(
                 self.mesh,
