@@ -76,13 +76,17 @@ class HolderSyncer:
 
         local_blocks = dict(frag.checksum_blocks())
         # Gather remote checksums; any differing or missing block syncs.
+        # A replica MISSING the whole fragment counts as all-empty
+        # blocks and still receives the push (fragment.go:2213 treats
+        # ErrFragmentNotFound as no blocks, not as a failure) — this is
+        # how a replica that never saw an index/shard gets seeded.
         remote_blocks = []
         for node in replicas:
-            blocks = self.cluster.client(node).fragment_blocks(
-                index, field, view, shard
-            )
             remote_blocks.append(
-                {b["id"]: bytes.fromhex(b["checksum"]) for b in blocks}
+                {
+                    b["id"]: bytes.fromhex(b["checksum"])
+                    for b in self._peer_blocks(node, index, field, view, shard)
+                }
             )
         block_ids = set(local_blocks)
         for rb in remote_blocks:
@@ -95,13 +99,35 @@ class HolderSyncer:
                 continue
             self._sync_block(frag, index, field, view, shard, blk, replicas)
 
+    def _peer_blocks(self, node, index, field, view, shard):
+        from ..net.client import ClientError
+
+        try:
+            return self.cluster.client(node).fragment_blocks(
+                index, field, view, shard
+            )
+        except ClientError as e:
+            if e.code == 404:  # fragment not found = all-empty blocks
+                return []
+            raise
+
+    def _peer_block_data(self, node, index, field, view, shard, block):
+        from ..net.client import ClientError
+
+        try:
+            return self.cluster.client(node).block_data(
+                index, field, view, shard, block
+            )
+        except ClientError as e:
+            if e.code == 404:
+                return {"rows": [], "cols": []}
+            raise
+
     def _sync_block(self, frag, index, field, view, shard, block, replicas):
         """fragment.go syncBlock :2262-2360."""
         peer_pairs = []
         for node in replicas:
-            data = self.cluster.client(node).block_data(
-                index, field, view, shard, block
-            )
+            data = self._peer_block_data(node, index, field, view, shard, block)
             peer_pairs.append(
                 (
                     np.asarray(data["rows"], dtype=np.uint64),

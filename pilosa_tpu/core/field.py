@@ -387,7 +387,19 @@ class Field:
     # -- reads -------------------------------------------------------------
 
     def row(self, row_id: int) -> Row:
-        view = self.view(VIEW_STANDARD)
+        return self._view_row(self.view(VIEW_STANDARD), row_id)
+
+    def row_time(self, row_id: int, t: dt.datetime, quantum: str) -> Row:
+        """Row as of the FINEST unit of ``quantum`` at time ``t``
+        (field.go RowTime :666 — picks viewsByTime(...)[0] for the
+        quantum's last unit).  The empty quantum has no unit views, so
+        it is invalid here even though fields may carry it."""
+        if not quantum or not timequantum.valid_quantum(quantum):
+            raise ValueError(f"invalid time quantum: {quantum!r}")
+        names = timequantum.views_by_time(VIEW_STANDARD, t, quantum[-1])
+        return self._view_row(self.view(names[0]) if names else None, row_id)
+
+    def _view_row(self, view, row_id: int) -> Row:
         if view is None:
             return Row()
         out = Row()

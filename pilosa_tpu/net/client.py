@@ -15,7 +15,13 @@ from urllib.request import Request, urlopen
 
 
 class ClientError(Exception):
-    pass
+    """HTTP client failure.  ``code`` carries the response status (None
+    for transport errors) so callers can branch on it instead of
+    string-matching the message."""
+
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
 
 
 class InternalClient:
@@ -44,7 +50,9 @@ class InternalClient:
                 data = resp.read()
         except HTTPError as e:
             detail = e.read().decode(errors="replace")
-            raise ClientError(f"{method} {path}: {e.code}: {detail}") from e
+            raise ClientError(
+                f"{method} {path}: {e.code}: {detail}", code=e.code
+            ) from e
         except URLError as e:
             raise ClientError(f"{method} {path}: {e.reason}") from e
         if raw:

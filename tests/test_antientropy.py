@@ -73,3 +73,98 @@ def test_sync_multi_shard(cluster3):
     syncer.sync_holder()
     frag = h[2].holder.fragment("i", "f", "standard", 2)
     assert frag.bit(5, 2 * SHARD_WIDTH + 9)
+
+
+def test_syncer_reconciles_divergent_holders(tmp_path):
+    """holder_test.go:274 TestHolderSyncer_SyncHolder, ported exactly:
+    two replica-2 nodes with hand-divergent data converge to the UNION
+    per row after both nodes run a sync pass (2/2 replicas: presence on
+    either node wins the majority vote with the owner's copy)."""
+    h = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        client = h.client(0)
+        for idx in ("i", "y"):
+            client.create_index(idx)
+        client.create_field("i", "f")
+        client.create_field("i", "f0")
+        client.create_field("y", "z")
+
+        # Write DIVERGENT local data, bypassing replication (set bits
+        # directly in each node's holder, exactly as the Go test does).
+        def raw(node, index, field, row, col):
+            fld = h[node].holder.index(index).field(field)
+            frag = fld.view_if_not_exists("standard").fragment_if_not_exists(
+                col // SHARD_WIDTH
+            )
+            frag.set_bit(row, col)
+
+        raw(0, "i", "f", 0, 10)
+        raw(0, "i", "f", 2, 20)
+        raw(0, "i", "f", 120, 10)
+        raw(0, "i", "f", 200, 4)
+        raw(0, "i", "f0", 9, SHARD_WIDTH + 5)
+        raw(0, "y", "z", 0, 0)
+
+        raw(1, "i", "f", 0, 4000)
+        raw(1, "i", "f", 3, 10)
+        raw(1, "i", "f", 120, 10)
+        raw(1, "y", "z", 10, 3 * SHARD_WIDTH + 4)
+        raw(1, "y", "z", 10, 3 * SHARD_WIDTH + 5)
+        raw(1, "y", "z", 10, 3 * SHARD_WIDTH + 7)
+
+        for node in (0, 1):
+            HolderSyncer(h[node].holder, h[node].cluster).sync_holder()
+
+        expect = {
+            ("i", "f", 0): [10, 4000],
+            ("i", "f", 2): [20],
+            ("i", "f", 3): [10],
+            ("i", "f", 120): [10],
+            ("i", "f", 200): [4],
+            ("i", "f0", 9): [SHARD_WIDTH + 5],
+            ("y", "z", 10): [
+                3 * SHARD_WIDTH + 4, 3 * SHARD_WIDTH + 5, 3 * SHARD_WIDTH + 7
+            ],
+        }
+        for node in (0, 1):
+            for (index, field, row), cols in expect.items():
+                fld = h[node].holder.index(index).field(field)
+                got = sorted(
+                    int(c) for c in fld.row(row).columns()
+                )
+                assert got == cols, (node, index, field, row, got)
+    finally:
+        h.close()
+
+
+def test_syncer_time_quantum_views(tmp_path):
+    """holder_test.go:368 TestHolderSyncer_TimeQuantum — time views
+    (standard_YYYYMMDD fanout) converge across replicas after one sync
+    pass from the node holding the missing data's peer."""
+    import datetime as dt
+
+    h = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        client = h.client(0)
+        client.create_index("i")
+        client.create_field("i", "f", {"type": "time", "timeQuantum": "D"})
+        t1 = dt.datetime(2018, 8, 1, 12, 30)
+        t2 = dt.datetime(2018, 8, 2, 12, 30)
+
+        f0 = h[0].holder.index("i").field("f")
+        f1 = h[1].holder.index("i").field("f")
+        f0.set_bit(0, 1, timestamp=t1)
+        f0.set_bit(0, 2, timestamp=t2)
+        f1.set_bit(0, 22, timestamp=t2)
+
+        for node in (0, 1):
+            HolderSyncer(h[node].holder, h[node].cluster).sync_holder()
+
+        for node in (0, 1):
+            fld = h[node].holder.index("i").field("f")
+            r1 = fld.row_time(0, t1, "D")
+            r2 = fld.row_time(0, t2, "D")
+            assert sorted(int(c) for c in r1.columns()) == [1], node
+            assert sorted(int(c) for c in r2.columns()) == [2, 22], node
+    finally:
+        h.close()

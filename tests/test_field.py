@@ -293,3 +293,37 @@ def test_bsi_base_value_reference_table():
         got_lo, got_hi, oor = g.base_value_between(lo, hi)
         assert oor == exp_oor, (g.name, lo, hi)
         assert (got_lo, got_hi) == (exp_lo, exp_hi), (g.name, lo, hi)
+
+
+def test_row_time_quantum_granularities():
+    """field_internal_test.go:300 TestField_RowTime — reads at each
+    granularity of a YMDH field pick the right unit view."""
+    import datetime as dt
+
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder()
+    h.open()
+    f = h.create_index("i").create_field(
+        "f", FieldOptions(type="time", time_quantum="YMDH")
+    )
+    f.set_bit(1, 1, timestamp=dt.datetime(2010, 1, 5, 12))
+    f.set_bit(1, 2, timestamp=dt.datetime(2011, 1, 5, 12))
+    f.set_bit(1, 3, timestamp=dt.datetime(2010, 2, 5, 12))
+    f.set_bit(1, 4, timestamp=dt.datetime(2010, 1, 6, 12))
+    f.set_bit(1, 5, timestamp=dt.datetime(2010, 1, 5, 13))
+
+    def cols(t, q):
+        return sorted(int(c) for c in f.row_time(1, t, q).columns())
+
+    assert cols(dt.datetime(2010, 11, 5, 12), "Y") == [1, 3, 4, 5]
+    assert cols(dt.datetime(2010, 2, 7, 13), "YM") == [3]
+    assert cols(dt.datetime(2010, 2, 7, 13), "M") == [3]
+    assert cols(dt.datetime(2010, 1, 5, 12), "MD") == [1, 5]
+    assert cols(dt.datetime(2010, 1, 5, 13), "MDH") == [5]
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        f.row_time(1, dt.datetime(2010, 1, 1), "X")

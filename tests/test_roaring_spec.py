@@ -1,0 +1,189 @@
+"""Public roaring-API sweeps modeled on roaring/roaring_test.go:
+quickcheck ops vs a set oracle at array/bitmap/run densities and large
+values (:965-969), marshal round-trips (:1037-1047), count/slice range
+edge cases (:41, :278-364), flip variants (:796-857), pairwise
+intersection counts (:859-963), offset ranges (:1194), and iteration
+(:1117)."""
+
+import random
+import zlib
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import Bitmap
+
+# (n_values, lo, hi) — array-, bitmap-, and run-shaped densities plus
+# a 63-bit value range, as in testBitmapQuick's parametrization.
+DENSITIES = [
+    ("array-sparse", 1000, 1000, 2000),
+    ("array-low", 1000, 0, 100000),
+    ("bitmap-dense", 10000, 0, 10000),
+    ("bitmap-offset", 10000, 10000, 20000),
+    ("large-values", 5000, 0, 2**63 - 1),
+    ("run-contiguous", 8000, 5000, 9000),
+]
+
+
+def sample(rng, n, lo, hi):
+    if hi - lo <= n * 2:  # dense: mostly-contiguous (run containers)
+        vals = list(range(lo, min(hi, lo + n)))
+    else:
+        vals = [rng.randrange(lo, hi) for _ in range(n)]
+    return vals
+
+
+@pytest.mark.parametrize(
+    "name,n,lo,hi", DENSITIES, ids=[d[0] for d in DENSITIES]
+)
+def test_quick_ops_vs_oracle(name, n, lo, hi):
+    """roaring_test.go:965 testBitmapQuick — add/remove/contains/count
+    track a set oracle exactly."""
+    rng = random.Random(zlib.crc32(name.encode()))
+    bm = Bitmap()
+    oracle = set()
+    for v in sample(rng, n, lo, hi):
+        assert bm.add(v) == (v not in oracle)
+        oracle.add(v)
+    assert bm.count() == len(oracle)
+    assert bm.max() == max(oracle)
+    probes = list(oracle)[:50] + [rng.randrange(lo, hi) for _ in range(50)]
+    for v in probes:
+        assert bm.contains(v) == (v in oracle), v
+    # Remove half.
+    for v in list(oracle)[:: 2]:
+        assert bm.remove(v) is True
+        oracle.discard(v)
+    assert bm.remove(hi + 5) is False
+    assert bm.count() == len(oracle)
+    assert list(bm) == sorted(oracle)
+
+
+@pytest.mark.parametrize(
+    "name,n,lo,hi", DENSITIES, ids=[d[0] for d in DENSITIES]
+)
+def test_marshal_roundtrip(name, n, lo, hi):
+    """roaring_test.go:1037 testBitmapMarshalQuick — serialize and
+    reload at every density; equality and count survive."""
+    rng = random.Random(zlib.crc32(name.encode()) ^ 1)
+    vals = sorted(set(sample(rng, n, lo, hi)))
+    bm = Bitmap(vals)
+    data = bm.to_bytes()
+    back = Bitmap.from_bytes(data)
+    assert back.count() == len(vals)
+    assert list(back) == vals
+    assert back == bm
+    assert not back.check()
+
+
+def test_count_range_container_boundaries():
+    """roaring_test.go:278 BitmapCountRangeEdgeCase — ranges straddling
+    2^16 container boundaries."""
+    C = 1 << 16
+    vals = [0, 1, C - 1, C, C + 1, 2 * C - 1, 2 * C, 5 * C + 7]
+    bm = Bitmap(vals)
+    oracle = set(vals)
+
+    def want(a, b):
+        return sum(1 for v in oracle if a <= v < b)
+
+    cases = [
+        (0, 1), (0, C), (0, C + 1), (C - 1, C), (C, 2 * C),
+        (C + 1, 2 * C), (0, 6 * C), (2 * C, 5 * C + 8),
+        (5 * C + 7, 5 * C + 8), (5 * C + 8, 6 * C), (3 * C, 4 * C),
+    ]
+    for a, b in cases:
+        assert bm.count_range(a, b) == want(a, b), (a, b)
+
+
+def test_slice_range_and_foreach():
+    """roaring_test.go:222-265 Slice/SliceRange/ForEach analogues."""
+    vals = [1, 5, 100, 65535, 65536, 200000]
+    bm = Bitmap(vals)
+    assert list(bm.slice_range(0, 300000)) == vals
+    assert list(bm.slice_range(5, 65536)) == [5, 100, 65535]
+    assert list(bm.slice_range(300000, 400000)) == []
+    assert list(Bitmap().slice_range(0, 100)) == []
+
+
+@pytest.mark.parametrize("base", [0, 1 << 16, 1 << 20])
+def test_flip_variants(base):
+    """roaring_test.go:796-857 Flip over empty/array/bitmap/after-max."""
+    # Empty: flip materializes the range.
+    assert list(Bitmap().flip(base + 3, base + 6)) == [
+        base + 3, base + 4, base + 5, base + 6,
+    ]
+    # Array container: set bits toggle off, clear bits toggle on.
+    bm = Bitmap([base + 2, base + 4])
+    assert list(bm.flip(base + 1, base + 4)) == [base + 1, base + 3]
+    # Dense: flip a range inside a full block.
+    dense = Bitmap(range(base, base + 128))
+    out = dense.flip(base + 10, base + 19)
+    assert out.count() == 128 - 10
+    # After max: pure materialization.
+    bm2 = Bitmap([base + 1])
+    assert list(bm2.flip(base + 100, base + 102)) == [
+        base + 1, base + 100, base + 101, base + 102,
+    ]
+
+
+@pytest.mark.parametrize("da", DENSITIES[:4], ids=[d[0] for d in DENSITIES[:4]])
+@pytest.mark.parametrize("db", DENSITIES[:4], ids=[d[0] for d in DENSITIES[:4]])
+def test_pairwise_setops_vs_oracle(da, db):
+    """roaring_test.go:365-963 — the pairwise density matrix for
+    intersect/union/difference/xor/intersection_count."""
+    rng = random.Random(7)
+    a_vals = set(sample(rng, da[1], da[2], da[3]))
+    b_vals = set(sample(rng, db[1], db[2], db[3]))
+    a, b = Bitmap(sorted(a_vals)), Bitmap(sorted(b_vals))
+    assert list(a.intersect(b)) == sorted(a_vals & b_vals)
+    assert list(a.union(b)) == sorted(a_vals | b_vals)
+    assert list(a.difference(b)) == sorted(a_vals - b_vals)
+    assert list(a.xor(b)) == sorted(a_vals ^ b_vals)
+    assert a.intersection_count(b) == len(a_vals & b_vals)
+
+
+def test_setops_empty_operands():
+    bm = Bitmap([1, 2, 3])
+    empty = Bitmap()
+    assert list(bm.intersect(empty)) == []
+    assert list(empty.intersect(bm)) == []
+    assert list(bm.union(empty)) == [1, 2, 3]
+    assert list(bm.difference(empty)) == [1, 2, 3]
+    assert list(empty.difference(bm)) == []
+    assert list(bm.xor(empty)) == [1, 2, 3]
+    assert bm.intersection_count(empty) == 0
+    assert not empty.contains(5)
+    assert empty.remove(5) is False
+
+
+def test_offset_range():
+    """roaring_test.go:1194 TestBitmapOffsetRange — shift a window of
+    bits by a container-aligned offset."""
+    C = 1 << 16
+    bm = Bitmap([1, 2, C + 5, 3 * C + 9])
+    out = bm.offset_range(10 * C, 0, 4 * C)
+    assert list(out) == [10 * C + 1, 10 * C + 2, 11 * C + 5, 13 * C + 9]
+    # Window excludes out-of-range bits.
+    out2 = bm.offset_range(2 * C, C, 2 * C)
+    assert list(out2) == [2 * C + 5]
+
+
+def test_iteration_order_and_len():
+    """roaring_test.go:1117 TestIterator — ascending order across
+    container transitions."""
+    rng = random.Random(3)
+    vals = sorted({rng.randrange(0, 1 << 22) for _ in range(5000)})
+    bm = Bitmap(vals)
+    assert list(bm) == vals
+    assert len(bm) == len(vals)
+
+
+def test_direct_add_and_shift():
+    """roaring_test.go:335 DirectAdd; shift(1) moves every bit up."""
+    bm = Bitmap()
+    for v in (9, 1, 65535, 65536):
+        bm.direct_add(v)
+    assert list(bm) == [1, 9, 65535, 65536]
+    shifted = bm.shift()
+    assert list(shifted) == [2, 10, 65536, 65537]
