@@ -308,10 +308,14 @@ class API:
 
     # -- imports (api.go Import :787, ImportValue :895, ImportRoaring :290) -
 
-    def import_bits(self, req: ImportRequest, remote: bool = False):
+    def import_bits(
+        self, req: ImportRequest, remote: bool = False, clear: bool = False
+    ):
         """Bulk bit import: translate keys, group bits by shard, forward
         each shard group to every replica of its owner set, apply locally
-        when this node is an owner (api.go Import :787-894)."""
+        when this node is an owner (api.go Import :787-894).  ``clear``
+        removes the given bits instead (the handler's ?clear=true,
+        http/handler.go:1002)."""
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
         col_ids = list(req.column_ids)
@@ -331,7 +335,7 @@ class API:
         timestamps = req.timestamps if any(t for t in req.timestamps) else []
 
         if self.cluster is None or remote:
-            self._import_local(idx, f, row_ids, col_ids, timestamps)
+            self._import_local(idx, f, row_ids, col_ids, timestamps, clear)
             return
 
         # Group by shard, forward to owners (api.go:835-860).
@@ -344,7 +348,7 @@ class API:
             s_ts = [timestamps[i] for i in idxs] if timestamps else []
             for node in self.cluster.shard_nodes(req.index, shard):
                 if node.id == self.cluster.node.id:
-                    self._import_local(idx, f, s_rows, s_cols, s_ts)
+                    self._import_local(idx, f, s_rows, s_cols, s_ts, clear)
                 else:
                     self.cluster.client(node).import_bits(
                         req.index,
@@ -354,9 +358,10 @@ class API:
                         s_cols,
                         timestamps=s_ts or None,
                         remote=True,
+                        clear=clear,
                     )
 
-    def _import_local(self, idx, f, row_ids, col_ids, timestamps):
+    def _import_local(self, idx, f, row_ids, col_ids, timestamps, clear=False):
         ts = None
         if timestamps:
             # ImportRequest.Timestamps are epoch-NANOSECONDS, matching the
@@ -369,12 +374,19 @@ class API:
                 else None
                 for t in timestamps
             ]
+        # Clears do NOT retract existence: other fields may still hold
+        # the column (handler clear semantics affect only this field).
         ef = idx.existence_field()
-        if ef is not None and col_ids:
+        if not clear and ef is not None and col_ids:
             ef.import_bulk([0] * len(col_ids), col_ids)
-        f.import_bulk(row_ids, col_ids, ts)
+        f.import_bulk(row_ids, col_ids, ts, clear=clear)
 
-    def import_values(self, req: ImportValueRequest, remote: bool = False):
+    def import_values(
+        self,
+        req: ImportValueRequest,
+        remote: bool = False,
+        clear: bool = False,
+    ):
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
         col_ids = list(req.column_ids)
@@ -387,9 +399,9 @@ class API:
 
         def apply_local(cols, values):
             ef = idx.existence_field()
-            if ef is not None and cols:
+            if not clear and ef is not None and cols:
                 ef.import_bulk([0] * len(cols), cols)
-            f.import_values(cols, values)
+            f.import_values(cols, values, clear=clear)
 
         if self.cluster is None or remote:
             apply_local(col_ids, req.values)
@@ -405,7 +417,8 @@ class API:
                     apply_local(cols, values)
                 else:
                     self.cluster.client(node).import_values(
-                        req.index, req.field, shard, cols, values, remote=True
+                        req.index, req.field, shard, cols, values,
+                        remote=True, clear=clear,
                     )
 
     def import_roaring(

@@ -414,9 +414,11 @@ class Field:
         row_ids,
         column_ids,
         timestamps: Optional[List[Optional[dt.datetime]]] = None,
+        clear: bool = False,
     ) -> int:
         """field.go Import :1058: group bits by (view, shard) incl. time
-        quantum fanout, then bulk-import per fragment."""
+        quantum fanout, then bulk-import per fragment.  ``clear`` removes
+        the given bits instead (api.go ImportOptions.Clear)."""
         groups: Dict[str, Dict[int, Tuple[list, list]]] = {}
 
         def put(view_name, shard, r, c):
@@ -440,10 +442,10 @@ class Field:
             view = self.view_if_not_exists(view_name)
             for shard, (rows, cols) in shards.items():
                 frag = view.fragment_if_not_exists(shard)
-                changed += frag.bulk_import(rows, cols)
+                changed += frag.bulk_import(rows, cols, clear=clear)
         return changed
 
-    def import_values(self, column_ids, values) -> None:
+    def import_values(self, column_ids, values, clear: bool = False) -> None:
         g = self.bsi_group(self.name)
         if g is None:
             raise ValueError(f"field {self.name} has no int range")
@@ -457,7 +459,7 @@ class Field:
             vals.append(v - g.min)
         for shard, (cols, vals) in by_shard.items():
             frag = view.fragment_if_not_exists(shard)
-            frag.import_values(cols, vals, g.bit_depth())
+            frag.import_values(cols, vals, g.bit_depth(), clear=clear)
 
     def __repr__(self) -> str:
         return f"Field({self.index}/{self.name}, type={self.options.type})"

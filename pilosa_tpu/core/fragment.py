@@ -506,16 +506,23 @@ class Fragment:
     # -- bulk import -------------------------------------------------------
 
     @_locked
-    def bulk_import(self, row_ids: Iterable[int], column_ids: Iterable[int]) -> int:
-        """Set many bits at once, updating caches once per row and taking a
-        single snapshot — bypassing the op-log (fragment.go:1445-1533).
+    def bulk_import(
+        self,
+        row_ids: Iterable[int],
+        column_ids: Iterable[int],
+        clear: bool = False,
+    ) -> int:
+        """Set (or with ``clear`` remove, api.go ImportOptions.Clear
+        :764) many bits at once, updating caches once per row and taking
+        a single snapshot — bypassing the op-log (fragment.go:1445-1533).
         Mutex fragments go through a vectorized clear-previous-owner pass
-        (bulkImportMutex :1538) driven by the occupancy vector."""
+        (bulkImportMutex :1538) driven by the occupancy vector; a CLEAR
+        import bypasses it (fragment.go:1451 `!options.Clear`)."""
         row_ids = np.asarray(list(row_ids), dtype=np.int64)
         column_ids = np.asarray(list(column_ids), dtype=np.int64)
         if row_ids.size == 0:
             return 0
-        if self.mutex:
+        if self.mutex and not clear:
             changed = self._bulk_import_mutex(row_ids, column_ids)
             self.snapshot()
             return changed
@@ -524,8 +531,19 @@ class Fragment:
         packed = (row_ids.astype(np.uint64) << np.uint64(ops.SHARD_WIDTH_EXP)) | in_row
         for r, pos in self._group_by_row(np.unique(packed)):
             before = self._store.count(r)
-            after = self._store.union(r, pos)
-            changed += after - before
+            after = (
+                self._store.difference(r, pos)
+                if clear
+                else self._store.union(r, pos)
+            )
+            changed += abs(after - before)
+            if clear and self._mutex_owners is not None:
+                # Keep the lazily-built occupancy vector honest, like
+                # _clear_bit: a stale owner entry would make a later
+                # mutex re-set of the same (row, col) a silent no-op.
+                idx = pos.astype(np.int64)
+                mine = self._mutex_owners[idx] == r
+                self._mutex_owners[idx[mine]] = -1
             self._touch(r, pos)
             self.cache.bulk_add(r, after)
         self.cache.invalidate()
@@ -582,12 +600,20 @@ class Fragment:
 
     @_locked
     def import_values(
-        self, column_ids: Iterable[int], values: Iterable[int], bit_depth: int
+        self,
+        column_ids: Iterable[int],
+        values: Iterable[int],
+        bit_depth: int,
+        clear: bool = False,
     ):
         """Bulk BSI write, vectorized by bit plane: each plane gets one
         union of its set columns and one difference of its clear columns,
         instead of bit_depth+1 op-logged writes per value
-        (fragment.go importValue :1609-1657).  One snapshot at the end."""
+        (fragment.go importValue :1609-1657).  One snapshot at the end.
+        With ``clear`` the not-null plane is REMOVED for the given
+        columns (fragment.go importSetValue :669 clear branch) — the
+        value planes are still written per the given bits, matching the
+        reference exactly."""
         cols = np.asarray(list(column_ids), dtype=np.int64)
         vals = np.asarray(list(values), dtype=np.int64)
         if cols.size == 0:
@@ -606,7 +632,11 @@ class Fragment:
                 self._store.difference(i, clr_pos)
             self._touch(i, pos32)
             self.cache.bulk_add(i, self._store.count(i))
-        n = self._store.union(bit_depth, pos32)
+        n = (
+            self._store.difference(bit_depth, pos32)
+            if clear
+            else self._store.union(bit_depth, pos32)
+        )
         self._touch(bit_depth, pos32)
         self.cache.bulk_add(bit_depth, n)
         self.cache.invalidate()

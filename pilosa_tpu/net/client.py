@@ -132,11 +132,13 @@ class InternalClient:
         column_ids: List[int],
         timestamps: Optional[List[Optional[int]]] = None,
         remote: bool = False,
+        clear: bool = False,
     ):
         doc = {"shard": shard, "rowIDs": row_ids, "columnIDs": column_ids}
         if timestamps:
             doc["timestamps"] = timestamps
-        suffix = "?remote=true" if remote else ""
+        params = [p for p, on in (("remote=true", remote), ("clear=true", clear)) if on]
+        suffix = "?" + "&".join(params) if params else ""
         self._post(f"/index/{index}/field/{field}/import{suffix}", doc)
 
     def import_keyed_bits(
@@ -155,8 +157,10 @@ class InternalClient:
         column_ids: List[int],
         values: List[int],
         remote: bool = False,
+        clear: bool = False,
     ):
-        suffix = "?remote=true" if remote else ""
+        params = [p for p, on in (("remote=true", remote), ("clear=true", clear)) if on]
+        suffix = "?" + "&".join(params) if params else ""
         self._post(
             f"/index/{index}/field/{field}/import{suffix}",
             {"shard": shard, "columnIDs": column_ids, "values": values},

@@ -293,6 +293,7 @@ class Handler:
     def _post_import(self, q, b, *, index, field, **kw):
         doc = json.loads(b)
         remote = _qbool(q, "remote")
+        clear = _qbool(q, "clear")  # handler.go:1002 doClear
         if "values" in doc:
             self.api.import_values(
                 ImportValueRequest(
@@ -304,6 +305,7 @@ class Handler:
                     values=doc.get("values"),
                 ),
                 remote=remote,
+                clear=clear,
             )
         else:
             self.api.import_bits(
@@ -318,6 +320,7 @@ class Handler:
                     timestamps=doc.get("timestamps"),
                 ),
                 remote=remote,
+                clear=clear,
             )
         return {}
 

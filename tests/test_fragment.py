@@ -557,3 +557,93 @@ def test_snapshot_run_heavy_content(tmp_path):
     f2 = make_frag(tmp_path)
     assert np.array_equal(f2.row_words(8), want)
     assert f2.row_count(8) == 4000
+
+
+# -- clear imports (fragment_internal_test.go:1294 ImportSet, :1545
+# ImportBool; api.go ImportOptions.Clear) -----------------------------------
+
+IMPORT_SET_CASES = [
+    # (set_rows, set_cols, set_exp, clear_rows, clear_cols, clear_exp)
+    (
+        [1, 1, 1, 1], [0, 1, 2, 3], {1: [0, 1, 2, 3]},
+        [], [], {1: [0, 1, 2, 3]},
+    ),
+    (
+        [1, 1, 1, 1, 2, 2, 2, 2], [0, 1, 2, 3, 0, 1, 2, 3],
+        {1: [0, 1, 2, 3], 2: [0, 1, 2, 3]},
+        [1, 1, 2], [1, 2, 3],
+        {1: [0, 3], 2: [0, 1, 2]},
+    ),
+    (
+        [1, 1, 1, 1, 2], [0, 1, 2, 3, 1],
+        {1: [0, 1, 2, 3], 2: [1]},
+        [1, 1, 1, 1, 2], [0, 1, 2, 3, 1],
+        {1: [], 2: []},
+    ),
+]
+
+
+def _cols(frag, row):
+    return frag.row(row).columns().tolist()
+
+
+@pytest.mark.parametrize("case", range(len(IMPORT_SET_CASES)))
+def test_import_set_then_clear(case):
+    set_r, set_c, set_exp, clr_r, clr_c, clr_exp = IMPORT_SET_CASES[case]
+    frag = make_frag()
+    frag.bulk_import(set_r, set_c)
+    for row, cols in set_exp.items():
+        assert _cols(frag, row) == cols, row
+    if clr_r:
+        frag.bulk_import(clr_r, clr_c, clear=True)
+    for row, cols in clr_exp.items():
+        assert _cols(frag, row) == cols, row
+
+
+def test_import_clear_is_idempotent_and_counts():
+    frag = make_frag()
+    assert frag.bulk_import([1, 1], [0, 1]) == 2
+    assert frag.bulk_import([1, 1], [0, 1], clear=True) == 2
+    assert frag.bulk_import([1, 1], [0, 1], clear=True) == 0
+    assert _cols(frag, 1) == []
+
+
+def test_import_bool_clear_bypasses_mutex():
+    """fragment_internal_test.go:1545 ImportBool — a clear-import on a
+    bool/mutex fragment removes exactly the named bits, without the
+    last-write-wins occupancy pass."""
+    frag = make_frag(mutex=True)
+    frag.bulk_import([0, 0, 1, 1], [0, 1, 2, 3])  # false: 0,1; true: 2,3
+    assert _cols(frag, 0) == [0, 1]
+    assert _cols(frag, 1) == [2, 3]
+    frag.bulk_import([1, 1, 0], [2, 3, 0], clear=True)
+    assert _cols(frag, 0) == [1]
+    assert _cols(frag, 1) == []
+
+
+def test_mutex_reset_after_clear_import():
+    """The occupancy vector must not go stale on a clear-import: a later
+    mutex re-set of the same (row, col) has to land (review finding)."""
+    frag = make_frag(mutex=True)
+    frag.bulk_import([1], [5])
+    assert frag.row_containing(5) == 1
+    frag.bulk_import([1], [5], clear=True)
+    assert frag.row_containing(5) is None
+    frag.bulk_import([1], [5])  # re-set must not be dropped
+    assert _cols(frag, 1) == [5]
+    assert frag.row_containing(5) == 1
+
+
+def test_import_values_clear():
+    """fragment.go importSetValue clear branch: the not-null plane is
+    removed for the given columns."""
+    frag = make_frag()
+    frag.import_values([1, 2, 3], [7, 9, 11], 4)
+    for c, v in ((1, 7), (2, 9), (3, 11)):
+        got, ok = frag.value(c, 4)
+        assert ok and got == v
+    frag.import_values([2], [9], 4, clear=True)
+    _, ok = frag.value(2, 4)
+    assert not ok
+    got, ok = frag.value(1, 4)
+    assert ok and got == 7

@@ -162,3 +162,30 @@ def test_max_shards_reflects_imports(pair):
     a.import_bits("i", "f", 2, [0], [2 * SHARD_WIDTH + 1])
     shards = a.max_shards()
     assert shards["i"] == 2
+
+
+def test_import_clear_flag(pair):
+    """handler.go:1002 — ?clear=true on /import removes the given bits
+    and leaves existence intact."""
+    (api, a), _ = pair
+    a.create_index("i")
+    a.create_field("i", "f")
+    a.import_bits("i", "f", 0, [1, 1, 2], [10, 11, 10])
+    assert a.query("i", "Row(f=1)")["results"][0]["columns"] == [10, 11]
+    a.import_bits("i", "f", 0, [1], [10], clear=True)
+    assert a.query("i", "Row(f=1)")["results"][0]["columns"] == [11]
+    assert a.query("i", "Row(f=2)")["results"][0]["columns"] == [10]
+    # Existence unaffected: Not() still sees column 10.
+    out = a.query("i", "Not(Row(f=9))")
+    assert out["results"][0]["columns"] == [10, 11]
+
+
+def test_import_values_clear_flag(pair):
+    """handler.go doClear applies to value imports too."""
+    (api, a), _ = pair
+    a.create_index("i")
+    a.create_field("i", "v", {"type": "int", "min": 0, "max": 100})
+    a.import_values("i", "v", 0, [1, 2], [10, 20])
+    assert a.query("i", "Sum(field=v)")["results"][0] == {"value": 30, "count": 2}
+    a.import_values("i", "v", 0, [1], [10], clear=True)
+    assert a.query("i", "Sum(field=v)")["results"][0] == {"value": 20, "count": 1}
