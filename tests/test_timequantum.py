@@ -81,3 +81,76 @@ def test_views_by_time_range(start, end, quantum, expect):
 
 def test_parse_timestamp():
     assert tq.parse_timestamp("2018-08-21T13:30") == dt.datetime(2018, 8, 21, 13, 30)
+
+
+# -- golden vectors (time_internal_test.go:87 TestViewsByTimeRange) --------
+
+import datetime as dt
+
+import pytest
+
+from pilosa_tpu.core.timequantum import views_by_time_range
+
+
+def T(s):
+    return dt.datetime.strptime(s, "%Y-%m-%d %H:%M")
+
+
+RANGE_GOLDEN = [
+    ("Y", "2000-01-01 00:00", "2002-01-01 00:00", ["F_2000", "F_2001"]),
+    ("YM", "2000-11-01 00:00", "2003-03-01 00:00",
+     ["F_200011", "F_200012", "F_2001", "F_2002", "F_200301", "F_200302"]),
+    ("YM", "2001-10-31 00:00", "2003-04-01 00:00",
+     ["F_200110", "F_200111", "F_200112", "F_2002", "F_200301", "F_200302",
+      "F_200303"]),
+    ("YM", "1999-12-31 00:00", "2000-04-01 00:00",
+     ["F_199912", "F_200001", "F_200002", "F_200003"]),
+    ("YM", "2000-01-31 00:00", "2001-04-01 00:00",
+     ["F_2000", "F_200101", "F_200102", "F_200103"]),
+    ("YMD", "2000-11-28 00:00", "2003-03-02 00:00",
+     ["F_20001128", "F_20001129", "F_20001130", "F_200012", "F_2001",
+      "F_2002", "F_200301", "F_200302", "F_20030301"]),
+    ("YMDH", "2000-11-28 22:00", "2002-03-01 03:00",
+     ["F_2000112822", "F_2000112823", "F_20001129", "F_20001130",
+      "F_200012", "F_2001", "F_200201", "F_200202", "F_2002030100",
+      "F_2002030101", "F_2002030102"]),
+    ("M", "2000-01-01 00:00", "2000-03-01 00:00", ["F_200001", "F_200002"]),
+    ("MD", "2000-11-29 00:00", "2002-02-03 00:00",
+     ["F_20001129", "F_20001130", "F_200012", "F_200101", "F_200102",
+      "F_200103", "F_200104", "F_200105", "F_200106", "F_200107",
+      "F_200108", "F_200109", "F_200110", "F_200111", "F_200112",
+      "F_200201", "F_20020201", "F_20020202"]),
+    ("MDH", "2000-11-29 22:00", "2002-03-02 03:00",
+     ["F_2000112922", "F_2000112923", "F_20001130", "F_200012", "F_200101",
+      "F_200102", "F_200103", "F_200104", "F_200105", "F_200106",
+      "F_200107", "F_200108", "F_200109", "F_200110", "F_200111",
+      "F_200112", "F_200201", "F_200202", "F_20020301", "F_2002030200",
+      "F_2002030201", "F_2002030202"]),
+    ("D", "2000-01-01 00:00", "2000-01-04 00:00",
+     ["F_20000101", "F_20000102", "F_20000103"]),
+    ("H", "2000-01-01 00:00", "2000-01-01 02:00",
+     ["F_2000010100", "F_2000010101"]),
+]
+
+
+@pytest.mark.parametrize(
+    "quantum,start,end,expect",
+    RANGE_GOLDEN,
+    ids=[f"{q}-{s[:10]}" for q, s, _, _ in RANGE_GOLDEN],
+)
+def test_views_by_time_range_golden(quantum, start, end, expect):
+    assert views_by_time_range("F", T(start), T(end), quantum) == expect
+
+
+def test_views_by_time_range_dh_leap_february():
+    """The 62-view DH case (time_internal_test.go:152): hour heads, day
+    middles across a LEAP February, hour tail."""
+    got = views_by_time_range(
+        "F", T("2000-01-01 22:00"), T("2000-03-01 02:00"), "DH"
+    )
+    assert got[:2] == ["F_2000010122", "F_2000010123"]
+    assert got[2] == "F_20000102"
+    assert "F_20000229" in got  # leap day covered
+    assert got[-2:] == ["F_2000030100", "F_2000030101"]
+    # 2 hour heads + 30 Jan days + 29 leap-Feb days + 2 hour tails.
+    assert len(got) == 63
