@@ -516,12 +516,7 @@ class API:
         return {"rows": rows.tolist(), "cols": cols.tolist()}
 
     def delete_available_shard(self, index_name, field_name, shard: int):
-        f = self.field(index_name, field_name)
-        from .roaring import Bitmap
-
-        remaining = set(f.remote_available_shards) - {shard}
-        f.remote_available_shards = Bitmap(remaining)
-        f._save_available_shards()
+        self.field(index_name, field_name).remove_available_shard(shard)
 
     def recalculate_caches(self):
         for idx in self.holder.indexes.values():

@@ -261,6 +261,14 @@ class Field:
         self.remote_available_shards = self.remote_available_shards.union(b)
         self._save_available_shards()
 
+    def remove_available_shard(self, shard: int):
+        """Drop a shard from the REMOTE set (field.go
+        RemoveAvailableShard :305 — local shards, derived from actual
+        fragments, always remain)."""
+        remaining = set(self.remote_available_shards) - {shard}
+        self.remote_available_shards = Bitmap(sorted(remaining))
+        self._save_available_shards()
+
     def _available_shards_path(self) -> str:
         return os.path.join(self.path, ".available.shards")
 

@@ -327,3 +327,44 @@ def test_row_time_quantum_granularities():
 
     with pytest.raises(ValueError):
         f.row_time(1, dt.datetime(2010, 1, 1), "X")
+
+
+def test_available_shards_remove_keeps_local():
+    """field_test.go:192 TestField_AvailableShards — removing available
+    shards drops only the remote ones; local shards always remain."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.roaring import Bitmap
+
+    h = Holder()
+    h.open()
+    f = h.create_index("i").create_field("f")
+    f.set_bit(0, 100)
+    f.set_bit(0, 2 * 2**20)
+    assert list(f.available_shards()) == [0, 2]
+    f.add_remote_available_shards(Bitmap([1, 2, 4]))
+    assert list(f.available_shards()) == [0, 1, 2, 4]
+    for s in range(5):
+        f.remove_available_shard(s)
+    assert list(f.available_shards()) == [0, 2]
+
+
+def test_remote_available_shards_persist(tmp_path):
+    """add_remote_available_shards persists immediately: a node learning
+    remote shards from a cluster message must not lose them on an
+    unclean shutdown (no close())."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.roaring import Bitmap
+
+    h = Holder(path=str(tmp_path / "h"))
+    h.open()
+    f = h.create_index("i").create_field("f")
+    f.add_remote_available_shards(Bitmap([3, 9]))
+    # No h.close(): simulate a crash by reopening from disk directly.
+    h2 = Holder(path=h.path)
+    h2.open()
+    f2 = h2.index("i").field("f")
+    assert list(f2.remote_available_shards) == [3, 9]
+    f2.remove_available_shard(3)
+    h3 = Holder(path=h.path)
+    h3.open()
+    assert list(h3.index("i").field("f").remote_available_shards) == [9]

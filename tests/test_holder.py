@@ -204,3 +204,33 @@ def test_schema_lists_public_fields_sorted(tmp_path):
     for s in schema:
         for fld in s["fields"]:
             assert not fld["name"].startswith("_")
+
+
+# -- name validation (field_test.go:153 TestField_NameValidation,
+# index_test.go:215 TestIndex_InvalidName) ---------------------------------
+
+VALID_NAMES = ["foo", "hyphen-ated", "under_score", "abc123", "trailing_"]
+INVALID_NAMES = [
+    "", "123abc", "x.y", "_foo", "-bar", "abc def", "camelCase",
+    "UPPERCASE", ".meta",
+    "a" + "1234567890" * 6 + "12345",  # 66 chars > 64 cap
+]
+
+
+@pytest.mark.parametrize("name", VALID_NAMES)
+def test_valid_names_accepted(tmp_path, name):
+    h = make_holder(tmp_path, "names-ok-" + name)
+    idx = h.create_index(name)
+    idx.create_field(name)
+    h.close()
+
+
+@pytest.mark.parametrize("name", INVALID_NAMES, ids=repr)
+def test_invalid_names_rejected(tmp_path, name):
+    h = make_holder(tmp_path)
+    with pytest.raises(ValueError):
+        h.create_index(name)
+    idx = h.create_index("ok")
+    with pytest.raises(ValueError):
+        idx.create_field(name)
+    h.close()

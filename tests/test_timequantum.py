@@ -85,17 +85,6 @@ def test_parse_timestamp():
 
 # -- golden vectors (time_internal_test.go:87 TestViewsByTimeRange) --------
 
-import datetime as dt
-
-import pytest
-
-from pilosa_tpu.core.timequantum import views_by_time_range
-
-
-def T(s):
-    return dt.datetime.strptime(s, "%Y-%m-%d %H:%M")
-
-
 RANGE_GOLDEN = [
     ("Y", "2000-01-01 00:00", "2002-01-01 00:00", ["F_2000", "F_2001"]),
     ("YM", "2000-11-01 00:00", "2003-03-01 00:00",
@@ -139,14 +128,14 @@ RANGE_GOLDEN = [
     ids=[f"{q}-{s[:10]}" for q, s, _, _ in RANGE_GOLDEN],
 )
 def test_views_by_time_range_golden(quantum, start, end, expect):
-    assert views_by_time_range("F", T(start), T(end), quantum) == expect
+    assert tq.views_by_time_range("F", t(start), t(end), quantum) == expect
 
 
 def test_views_by_time_range_dh_leap_february():
     """The 62-view DH case (time_internal_test.go:152): hour heads, day
     middles across a LEAP February, hour tail."""
-    got = views_by_time_range(
-        "F", T("2000-01-01 22:00"), T("2000-03-01 02:00"), "DH"
+    got = tq.views_by_time_range(
+        "F", t("2000-01-01 22:00"), t("2000-03-01 02:00"), "DH"
     )
     assert got[:2] == ["F_2000010122", "F_2000010123"]
     assert got[2] == "F_20000102"
