@@ -266,7 +266,7 @@ class Field:
         RemoveAvailableShard :305 — local shards, derived from actual
         fragments, always remain)."""
         remaining = set(self.remote_available_shards) - {shard}
-        self.remote_available_shards = Bitmap(sorted(remaining))
+        self.remote_available_shards = Bitmap(remaining)
         self._save_available_shards()
 
     def _available_shards_path(self) -> str:

@@ -153,6 +153,13 @@ class Index:
         f = self.fields.pop(name, None)
         if f is None:
             raise ValueError(f"field not found: {name}")
+        if name == EXISTENCE_FIELD_NAME:
+            # Deleting the existence field turns tracking OFF, persisted
+            # BEFORE the files go — a crash mid-delete must not leave
+            # trackExistence=true on disk, or reopen silently recreates
+            # the field (index_internal_test.go:54 Existence_Delete).
+            self.track_existence = False
+            self.save_meta()
         f.close()
         if f.path and os.path.isdir(f.path):
             import shutil

@@ -234,3 +234,67 @@ def test_invalid_names_rejected(tmp_path, name):
     with pytest.raises(ValueError):
         idx.create_field(name)
     h.close()
+
+
+def test_existence_field_delete_disables_tracking(tmp_path):
+    """index_internal_test.go:54 TestIndex_Existence_Delete — deleting
+    the exists field turns tracking off, persisted across reopen."""
+    from pilosa_tpu.core.index import EXISTENCE_FIELD_NAME
+
+    h = make_holder(tmp_path)
+    idx = h.create_index("i")
+    assert idx.field(EXISTENCE_FIELD_NAME) is not None
+    assert idx.track_existence
+
+    idx.delete_field(EXISTENCE_FIELD_NAME)
+    assert not idx.track_existence
+    assert idx.field(EXISTENCE_FIELD_NAME) is None
+
+    h2 = reopen(h)
+    idx2 = h2.index("i")
+    assert not idx2.track_existence
+    assert idx2.field(EXISTENCE_FIELD_NAME) is None
+    h2.close()
+
+
+def test_group_by_keyed_previous_translation():
+    """executor_internal_test.go:13 TestExecutor_TranslateGroupByCall —
+    a GroupBy-level previous list mixing row keys and ids is translated
+    per field key-mode (key -> uint64 id, ids untouched).  Like the
+    reference, the list form is translated at the call boundary; SEEK
+    pagination uses the per-child `Rows(previous=...)` args
+    (executor.go:2777), which test_executor_more covers."""
+    from pilosa_tpu import pql
+    from pilosa_tpu.core.translate import TranslateFile
+    from pilosa_tpu.executor.translate import QueryTranslator
+
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("ak", FieldOptions(keys=True))
+    idx.create_field("b")
+    idx.create_field("ck", FieldOptions(keys=True))
+    store = TranslateFile()
+    store.open()
+    tr = QueryTranslator(store)
+    la = store.translate_rows_to_uint64("i", "ak", ["la"])[0]
+    ha = store.translate_rows_to_uint64("i", "ck", ["ha"])[0]
+
+    q = pql.parse(
+        'GroupBy(Rows(field=ak), Rows(field=b), Rows(field=ck), '
+        'previous=["la", 0, "ha"])'
+    )
+    c = q.calls[0]
+    tr.translate_call("i", idx, c)
+    assert c.args["previous"] == [la, 0, ha]
+
+    # A string previous for an unkeyed field is rejected.
+    q2 = pql.parse(
+        'GroupBy(Rows(field=ak), Rows(field=b), previous=["la", "x"])'
+    )
+    import pytest as _pytest
+
+    from pilosa_tpu.executor.translate import TranslateError
+
+    with _pytest.raises(TranslateError):
+        tr.translate_call("i", idx, q2.calls[0])
