@@ -1,0 +1,97 @@
+"""Row algebra across shard segments, ported from the reference's
+row_test.go (:26 Merge, :58 Xor, :80 Union_Segment, :101
+Difference_Segment) plus AttrStore sweeps from attr_test.go."""
+
+import pytest
+
+from pilosa_tpu.core.attrs import AttrStore
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.ops import SHARD_WIDTH
+
+
+def R(*cols):
+    return Row.from_columns(cols)
+
+
+@pytest.mark.parametrize("c1,c2,exp", [
+    ((1, 2, 3, SHARD_WIDTH + 1, 2 * SHARD_WIDTH), (3, 4, 5), 7),
+    ((), (2, 66000, 70000, 70001, 70002, 70003, 70004), 7),
+])
+def test_row_merge(c1, c2, exp):
+    """row_test.go:26 TestRow_Merge."""
+    r1, r2 = R(*c1), R(*c2)
+    r1.merge(r2)
+    assert r1.count() == exp
+    assert len(r1.columns()) == exp
+
+
+def test_row_xor_segments():
+    """row_test.go:58 TestRow_Xor — symmetric across shard segments."""
+    r1 = R(0, 1, SHARD_WIDTH)
+    r2 = R(0, 2 * SHARD_WIDTH)
+    exp = [1, SHARD_WIDTH, 2 * SHARD_WIDTH]
+    for a, b in ((r1, r2), (r2, r1)):
+        res = a.xor(b)
+        assert res.count() == 3
+        assert res.columns().tolist() == exp
+
+
+def test_row_union_segments():
+    """row_test.go:80 TestRow_Union_Segment."""
+    r1 = R(0, 1, SHARD_WIDTH)
+    r2 = R(0, 2 * SHARD_WIDTH)
+    exp = [0, 1, SHARD_WIDTH, 2 * SHARD_WIDTH]
+    for a, b in ((r1, r2), (r2, r1)):
+        res = a.union(b)
+        assert res.count() == 4
+        assert res.columns().tolist() == exp
+
+
+def test_row_difference_segments():
+    """row_test.go:101 TestRow_Difference_Segment — NOT symmetric."""
+    r1 = R(0, 1, SHARD_WIDTH)
+    r2 = R(0, 2 * SHARD_WIDTH)
+    res = r1.difference(r2)
+    assert res.count() == 2
+    assert res.columns().tolist() == [1, SHARD_WIDTH]
+    res = r2.difference(r1)
+    assert res.count() == 1
+    assert res.columns().tolist() == [2 * SHARD_WIDTH]
+
+
+def test_row_intersection_count_segments():
+    r1 = R(0, 1, SHARD_WIDTH, 3 * SHARD_WIDTH + 9)
+    r2 = R(0, SHARD_WIDTH, 2 * SHARD_WIDTH)
+    assert r1.intersection_count(r2) == 2
+    assert r2.intersection_count(r1) == 2
+    assert R().intersection_count(r1) == 0
+
+
+# -- AttrStore (attr_test.go) ----------------------------------------------
+
+
+def test_attrs_set_merge_unset():
+    """attr_test.go:30/:71 — merge semantics; None deletes a key."""
+    s = AttrStore(None)
+    s.set_attrs(1, {"A": 100, "B": "foo"})
+    s.set_attrs(1, {"B": "bar"})
+    s.set_attrs(1, {"C": True})
+    assert s.attrs(1) == {"A": 100, "B": "bar", "C": True}
+    s.set_attrs(1, {"B": None})
+    assert s.attrs(1) == {"A": 100, "C": True}
+    # attr_test.go:59 — unset ids read as empty, not missing.
+    assert s.attrs(999) == {}
+
+
+def test_attrs_blocks_change_with_writes():
+    """attr_test.go:91 TestAttrStore_Blocks — block checksums shift only
+    for the touched 100-id block."""
+    s = AttrStore(None)
+    s.set_attrs(1, {"a": 1})
+    s.set_attrs(250, {"b": 2})
+    before = dict(s.blocks())
+    assert set(before) == {0, 2}
+    s.set_attrs(251, {"c": 3})
+    after = dict(s.blocks())
+    assert after[0] == before[0]
+    assert after[2] != before[2]
