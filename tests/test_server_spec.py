@@ -156,3 +156,35 @@ def test_cli_import_with_timestamp_column(tmp_path):
         assert cli.query("i", "Row(t=1)")["results"][0]["columns"] == [5, 6]
     finally:
         c.close()
+
+
+def test_rows_across_cluster(tmp_path):
+    """executor_test.go:2642 TestExecutor_Execute_Rows — Rows() with
+    limit/previous/column over a 3-node cluster whose shards live on
+    different nodes."""
+    from pilosa_tpu.ops import SHARD_WIDTH
+
+    c = run_cluster(tmp_path, 3)
+    try:
+        cli = c.client()
+        cli.create_index("i")
+        cli.create_field("i", "general")
+        bits = [
+            (10, 0), (10, SHARD_WIDTH + 1), (11, 2), (11, SHARD_WIDTH + 2),
+            (12, 2), (12, SHARD_WIDTH + 2), (13, 3),
+        ]
+        for shard in (0, 1):
+            rows = [r for r, col in bits if col // SHARD_WIDTH == shard]
+            cols = [col for _, col in bits if col // SHARD_WIDTH == shard]
+            if cols:
+                cli.import_bits("i", "general", shard, rows, cols)
+
+        def rows_q(q):
+            return cli.query("i", q)["results"][0]["rows"]
+
+        assert rows_q("Rows(field=general)") == [10, 11, 12, 13]
+        assert rows_q("Rows(field=general, limit=2)") == [10, 11]
+        assert rows_q("Rows(field=general, previous=10, limit=2)") == [11, 12]
+        assert rows_q("Rows(field=general, column=2)") == [11, 12]
+    finally:
+        c.close()
