@@ -333,6 +333,20 @@ class API:
                 req.index, req.field, req.row_keys
             )
         timestamps = req.timestamps if any(t for t in req.timestamps) else []
+        # Validate BEFORE any mutation (field.go Import validation): a
+        # late ValueError from field.import_bulk would land after the
+        # existence field already recorded the columns (phantom
+        # existence bits) and after part of the cluster fan-out applied.
+        if timestamps:
+            if clear:
+                raise ValueError(
+                    "import clear is not supported with timestamps"
+                )
+            if not f.time_quantum():
+                raise ValueError(
+                    f"field {req.field!r} has no time quantum: cannot "
+                    "import with timestamps"
+                )
 
         if self.cluster is None or remote:
             self._import_local(idx, f, row_ids, col_ids, timestamps, clear)

@@ -426,7 +426,21 @@ class Field:
     ) -> int:
         """field.go Import :1058: group bits by (view, shard) incl. time
         quantum fanout, then bulk-import per fragment.  ``clear`` removes
-        the given bits instead (api.go ImportOptions.Clear)."""
+        the given bits instead (api.go ImportOptions.Clear).
+
+        Timestamped imports require a time-quantum field and reject
+        clear (field.go Import validation): a silent drop of the time
+        fanout would leave time views missing bits."""
+        if timestamps is not None and any(t is not None for t in timestamps):
+            if clear:
+                raise ValueError(
+                    "import clear is not supported with timestamps"
+                )
+            if not self.time_quantum():
+                raise ValueError(
+                    f"field {self.name!r} has no time quantum: cannot "
+                    "import with timestamps"
+                )
         groups: Dict[str, Dict[int, Tuple[list, list]]] = {}
 
         def put(view_name, shard, r, c):

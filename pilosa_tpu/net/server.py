@@ -136,7 +136,18 @@ class Handler:
                 return self._query_proto(m.group(1), query, body, ctype, accept)
             m = re.match(r"^/index/([^/]+)/field/([^/]+)/import$", path)
             if m and proto.CONTENT_TYPE in ctype:
-                return self._import_proto(m.group(1), m.group(2), query, body)
+                # Same exception->status mapping as the routed handlers:
+                # an import validation error must answer 400, not drop
+                # the connection.
+                try:
+                    return self._import_proto(m.group(1), m.group(2), query, body)
+                except (NotFoundError, IndexNotFoundError, FieldNotFoundError) as e:
+                    return 404, "application/json", json.dumps({"error": str(e)}).encode()
+                except (ApiError, ExecError, ParseError, TranslateError, ValueError) as e:
+                    return 400, "application/json", json.dumps({"error": str(e)}).encode()
+                except Exception as e:  # panic recovery (http/handler.go)
+                    traceback.print_exc()
+                    return 500, "application/json", json.dumps({"error": str(e)}).encode()
         for route in self.routes:
             if route.method != method:
                 continue
@@ -224,6 +235,7 @@ class Handler:
                     timestamps=doc["timestamps"],
                 ),
                 remote=_qbool(q, "remote"),
+                clear=_qbool(q, "clear"),
             )
         return 200, proto.CONTENT_TYPE, b""
 

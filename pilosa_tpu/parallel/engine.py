@@ -571,66 +571,80 @@ class MeshEngine:
             if dirty:
                 new_sync[si] = (fref, new_version)
         if updates or word_updates:
-            mat = cached.matrix
-            # EVERY chunk donates — the update runs in place instead of
-            # opening with a full-stack device copy (~9 ms on a 3 GB
-            # stack, formerly the dominant cost of every write+query
-            # cycle; measured 1.6 us after).  Safe because (a) this
-            # runs under _dispatch_lock, and every dispatch captures
-            # its operand handles inside the same lock via
-            # _locked_dispatch, re-reading stack.matrix after any sync
-            # (donation mutates cached.matrix in place, and
-            # _Lowering.stack_for dedups fetches so one query never
-            # syncs twice); (b) executions already enqueued keep their
-            # own buffer reference through PJRT's in-order stream.
-            # CONTRACT for any new caller: never hold a stack.matrix
-            # handle across a field_stack call — re-read it from the
-            # stack object.
-            for ci in range(0, len(updates), self.SCATTER_CHUNK_ROWS):
-                chunk = updates[ci : ci + self.SCATTER_CHUNK_ROWS]
-                D = len(chunk)
-                D_pad = max(8, 1 << (D - 1).bit_length())
-                rows = np.empty(D_pad, dtype=np.int32)
-                poss = np.empty(D_pad, dtype=np.int32)
-                vals = np.empty((D_pad, bitops.WORDS), dtype=np.uint32)
-                for i in range(D_pad):
-                    r, p, w = chunk[min(i, D - 1)]  # pad repeats the last
-                    rows[i], poss[i] = r, p
-                    vals[i] = w
-                mat = _scatter_rows_donated(
-                    self.mesh, mat, jnp.asarray(rows), jnp.asarray(poss),
-                    jnp.asarray(vals),
-                )
-            if word_updates:
-                D_pad = max(8, 1 << (n_words - 1).bit_length())
-                rows_w = np.empty(D_pad, dtype=np.int32)
-                poss_w = np.empty(D_pad, dtype=np.int32)
-                widx_w = np.empty(D_pad, dtype=np.int32)
-                vals_w = np.empty(D_pad, dtype=np.uint32)
-                o = 0
-                for r_i, p_i, widxs, vals in word_updates:
-                    k = len(widxs)
-                    rows_w[o : o + k] = r_i
-                    poss_w[o : o + k] = p_i
-                    widx_w[o : o + k] = widxs
-                    vals_w[o : o + k] = vals
-                    o += k
-                # Pad repeats the last word (idempotent set).
-                rows_w[o:], poss_w[o:] = rows_w[o - 1], poss_w[o - 1]
-                widx_w[o:], vals_w[o:] = widx_w[o - 1], vals_w[o - 1]
-                mat = _scatter_words_donated(
-                    self.mesh,
-                    mat,
-                    jnp.asarray(rows_w),
-                    jnp.asarray(poss_w),
-                    jnp.asarray(widx_w),
-                    jnp.asarray(vals_w),
-                )
-            cached.matrix = mat
-            self.stack_updates += 1
+            try:
+                self._scatter_sync_chain(cached, updates, word_updates, n_words)
+            except BaseException:
+                # The first chunk donated cached.matrix: a mid-chain
+                # failure (transient device OOM, ...) leaves the stack
+                # pointing at an invalidated buffer.  Evict it so the
+                # next query rebuilds cleanly instead of crashing on a
+                # donated buffer forever.
+                key = (index, field, view)
+                if self._stacks.get(key) is cached:
+                    self._evict(key)
+                raise
         cached.versions = token
         cached.frag_sync = new_sync
         return cached
+
+    def _scatter_sync_chain(self, cached, updates, word_updates, n_words):
+        mat = cached.matrix
+        # EVERY chunk donates — the update runs in place instead of
+        # opening with a full-stack device copy (~9 ms on a 3 GB
+        # stack, formerly the dominant cost of every write+query
+        # cycle; measured 1.6 us after).  Safe because (a) this
+        # runs under _dispatch_lock, and every dispatch captures
+        # its operand handles inside the same lock via
+        # _locked_dispatch, re-reading stack.matrix after any sync
+        # (donation mutates cached.matrix in place, and
+        # _Lowering.stack_for dedups fetches so one query never
+        # syncs twice); (b) executions already enqueued keep their
+        # own buffer reference through PJRT's in-order stream.
+        # CONTRACT for any new caller: never hold a stack.matrix
+        # handle across a field_stack call — re-read it from the
+        # stack object.
+        for ci in range(0, len(updates), self.SCATTER_CHUNK_ROWS):
+            chunk = updates[ci : ci + self.SCATTER_CHUNK_ROWS]
+            D = len(chunk)
+            D_pad = max(8, 1 << (D - 1).bit_length())
+            rows = np.empty(D_pad, dtype=np.int32)
+            poss = np.empty(D_pad, dtype=np.int32)
+            vals = np.empty((D_pad, bitops.WORDS), dtype=np.uint32)
+            for i in range(D_pad):
+                r, p, w = chunk[min(i, D - 1)]  # pad repeats the last
+                rows[i], poss[i] = r, p
+                vals[i] = w
+            mat = _scatter_rows_donated(
+                self.mesh, mat, jnp.asarray(rows), jnp.asarray(poss),
+                jnp.asarray(vals),
+            )
+        if word_updates:
+            D_pad = max(8, 1 << (n_words - 1).bit_length())
+            rows_w = np.empty(D_pad, dtype=np.int32)
+            poss_w = np.empty(D_pad, dtype=np.int32)
+            widx_w = np.empty(D_pad, dtype=np.int32)
+            vals_w = np.empty(D_pad, dtype=np.uint32)
+            o = 0
+            for r_i, p_i, widxs, vals in word_updates:
+                k = len(widxs)
+                rows_w[o : o + k] = r_i
+                poss_w[o : o + k] = p_i
+                widx_w[o : o + k] = widxs
+                vals_w[o : o + k] = vals
+                o += k
+            # Pad repeats the last word (idempotent set).
+            rows_w[o:], poss_w[o:] = rows_w[o - 1], poss_w[o - 1]
+            widx_w[o:], vals_w[o:] = widx_w[o - 1], vals_w[o - 1]
+            mat = _scatter_words_donated(
+                self.mesh,
+                mat,
+                jnp.asarray(rows_w),
+                jnp.asarray(poss_w),
+                jnp.asarray(widx_w),
+                jnp.asarray(vals_w),
+            )
+        cached.matrix = mat
+        self.stack_updates += 1
 
     def _evict(self, key):
         # Drop the cache reference only — never .delete() the device

@@ -105,6 +105,22 @@ def test_field_import_bulk_with_time():
     assert "standard_201802" in f.views
 
 
+def test_field_import_bulk_time_validation():
+    """field.go Import validation: clear+timestamps is rejected, and
+    timestamps on a field with no time quantum error instead of
+    silently dropping the time fanout (r4 ADVICE)."""
+    f = Field("i", "t", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YM"))
+    ts = [dt.datetime(2018, 1, 1)]
+    with pytest.raises(ValueError, match="clear"):
+        f.import_bulk([1], [10], ts, clear=True)
+    g = Field("i", "s", FieldOptions())
+    with pytest.raises(ValueError, match="time quantum"):
+        g.import_bulk([1], [10], ts)
+    # All-None timestamps are a plain import (no quantum required).
+    g.import_bulk([1], [10], [None])
+    assert g.row(1).columns().tolist() == [10]
+
+
 def test_available_shards_merge():
     from pilosa_tpu.roaring import Bitmap
 

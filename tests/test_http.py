@@ -194,6 +194,54 @@ def test_cluster_message_content_type_routing(server):
     post(b"\x0d")
 
 
+def test_proto_import_clear(server):
+    """The protobuf /import endpoint honors ?clear=true
+    (handler.go:1002 applies doClear to the proto path; r4 ADVICE:
+    this silently SET instead of clearing)."""
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.net import proto
+
+    api, client = server
+    client.create_index("i")
+    client.create_field("i", "f")
+    uri = client.uri
+
+    def post(path, body):
+        req = urllib.request.Request(
+            uri + path, data=body, method="POST",
+            headers={"Content-Type": proto.CONTENT_TYPE},
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+
+    body = proto.encode_import_request(
+        "i", "f", shard=0, row_ids=[7, 7, 7], column_ids=[1, 2, 3]
+    )
+    post("/index/i/field/f/import", body)
+    assert client.query("i", "Row(f=7)")["results"][0]["columns"] == [1, 2, 3]
+    clr = proto.encode_import_request(
+        "i", "f", shard=0, row_ids=[7], column_ids=[2]
+    )
+    post("/index/i/field/f/import?clear=true", clr)
+    assert client.query("i", "Row(f=7)")["results"][0]["columns"] == [1, 3]
+    # Validation errors on the proto path answer 400 (not a dropped
+    # connection), and the existence field records NOTHING from a
+    # rejected import (no phantom columns).
+    bad = proto.encode_import_request(
+        "i", "f", shard=0, row_ids=[7], column_ids=[9], timestamps=[10**18]
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post("/index/i/field/f/import?clear=true", bad)
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post("/index/i/field/f/import", bad)  # no time quantum on f
+    assert ei.value.code == 400
+    out = client.query("i", "Row(f=7)")["results"][0]["columns"]
+    assert out == [1, 3]
+    assert client.query("i", "Count(Not(Row(f=7)))")["results"] == [1]  # just col 2
+
+
 def test_cluster_message_delete_redelivery_is_safe(server):
     """Gossip delivery is at-least-once and unordered: a delete-field
     redelivered after the field was recreated must NOT destroy the new

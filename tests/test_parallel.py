@@ -404,6 +404,48 @@ def test_incremental_stack_sync(holder, mesh):
     assert eng.stack_updates == 5
 
 
+def test_failed_incremental_sync_evicts_stack(holder, mesh, monkeypatch):
+    """A scatter chunk that raises mid-sync leaves cached.matrix
+    donated/invalidated; the stack must be EVICTED so the next query
+    rebuilds cleanly instead of crashing forever (r4 ADVICE)."""
+    from pilosa_tpu.parallel import engine as engine_mod
+
+    build_data(holder)
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder)
+    call = pql.parse("Row(f=10)").calls[0]
+    shards = list(range(8))
+    base = eng.count("i", call, shards)
+    assert eng.stack_rebuilds == 1
+
+    # Dirty one row, then fail the sync AFTER the scatter has really
+    # donated cached.matrix: the wrapper calls through (the donation
+    # consumes the stack's buffer) and raises before the result is
+    # stored back — exactly the mid-chain failure the eviction guards.
+    ex.execute("i", "Set(123456, f=10)")
+    real_words = engine_mod._scatter_words_donated
+    real_rows = engine_mod._scatter_rows_donated
+
+    def boom_words(*a, **kw):
+        real_words(*a, **kw)
+        raise RuntimeError("transient device OOM")
+
+    def boom_rows(*a, **kw):
+        real_rows(*a, **kw)
+        raise RuntimeError("transient device OOM")
+
+    monkeypatch.setattr(engine_mod, "_scatter_words_donated", boom_words)
+    monkeypatch.setattr(engine_mod, "_scatter_rows_donated", boom_rows)
+    with pytest.raises(RuntimeError, match="transient device OOM"):
+        eng.count("i", call, shards)
+
+    # Stack was evicted: the next query (scatters restored) rebuilds
+    # and answers correctly.
+    monkeypatch.undo()
+    assert eng.count("i", call, shards) == base + 1
+    assert eng.stack_rebuilds == 2
+
+
 def test_word_level_sync_payload(holder, mesh):
     """Point writes sync as WORD deltas (a few bytes), not whole
     128 KiB rows; whole-row events (dense load, word-log overflow) fall
