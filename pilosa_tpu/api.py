@@ -308,6 +308,16 @@ class API:
 
     # -- imports (api.go Import :787, ImportValue :895, ImportRoaring :290) -
 
+    def _check_writable(self):
+        """Reject writes while the cluster resizes (api.go validate :93:
+        apiImport/apiImportValue are methodsNormal-only — absent from
+        the RESIZING method set).  A write accepted mid-resize could
+        land on a fragment already copied to its new owner and vanish
+        when the old copy is cleaned; clients retry after the (bounded)
+        resize completes."""
+        if self.cluster is not None and self.cluster.state == "RESIZING":
+            raise ApiError("cluster is resizing: writes are rejected")
+
     def import_bits(
         self, req: ImportRequest, remote: bool = False, clear: bool = False
     ):
@@ -316,6 +326,7 @@ class API:
         when this node is an owner (api.go Import :787-894).  ``clear``
         removes the given bits instead (the handler's ?clear=true,
         http/handler.go:1002)."""
+        self._check_writable()
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
         col_ids = list(req.column_ids)
@@ -401,6 +412,7 @@ class API:
         remote: bool = False,
         clear: bool = False,
     ):
+        self._check_writable()
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
         col_ids = list(req.column_ids)
@@ -446,6 +458,7 @@ class API:
     ) -> int:
         """Union (or clear) a serialized roaring bitmap into a fragment —
         the fast ingest path (api.go:290-349, ImportRoaringRequest.Clear)."""
+        self._check_writable()
         idx = self.index(index_name)
         f = self.field(index_name, field_name)
         v = f.view_if_not_exists(view)
@@ -661,6 +674,19 @@ class API:
                         f.add_remote_available_shards(
                             Bitmap(finfo.get("availableShards", []))
                         )
+            # RESIZING is coordinator-granted: if the coordinator's
+            # periodic status says the resize is over but this node
+            # missed the set-state NORMAL broadcast (one lost POST — or
+            # a coordinator that died mid-job and restarted), adopt its
+            # state instead of staying wedged in RESIZING forever
+            # (mergeClusterStatus parity, cluster.go:1530-1570).
+            if (
+                self.cluster is not None
+                and self.cluster.state == "RESIZING"
+                and msg.get("node", {}).get("isCoordinator")
+                and msg.get("state") not in (None, "", "RESIZING")
+            ):
+                self.cluster.set_state(msg["state"])
         elif typ == "recalculate-caches":
             for idx in self.holder.indexes.values():
                 for f in idx.fields.values():

@@ -537,6 +537,19 @@ class Executor:
     def _dispatch_call(self, index: str, c: Call, shards, opt):
         self._validate_call_args(c)
         name = c.name
+        # Writes are rejected while the cluster resizes (api.go validate
+        # :93: apiQuery/apiImport live in methodsNormal, absent from
+        # ClusterStateResizing's set): a write accepted mid-resize could
+        # land on a fragment already point-in-time copied to its new
+        # owner and vanish when the old copy is cleaned.  Reads keep
+        # serving — they route on the pre-resize topology, which is
+        # correct until the job completes.
+        if (
+            name in _WRITE_CALLS
+            and self.cluster is not None
+            and self.cluster.state == "RESIZING"
+        ):
+            raise Error("cluster is resizing: writes are rejected")
         self.stats.count(name, 1, tags=[f"index:{index}"])
         if name == "Sum":
             return self._execute_sum(index, c, shards, opt)
@@ -879,7 +892,9 @@ class Executor:
         if len(c.children) != 1:
             raise Error("Count() requires a single bitmap input")
 
-        fast = self._count_from_cardinalities(index, c.children[0], shards)
+        fast = self._count_from_cardinalities(
+            index, c.children[0], shards, opt.remote
+        )
         if fast is not None:
             return fast
 
@@ -915,7 +930,7 @@ class Executor:
         )
         return result or 0
 
-    def _count_from_cardinalities(self, index, child: Call, shards):
+    def _count_from_cardinalities(self, index, child: Call, shards, remote=False):
         """O(1)-per-shard Count of an unfiltered Row: sum the maintained
         per-row cardinalities (rowstore counts) with ZERO device work —
         the analogue of the reference summing roaring container ``n``
@@ -932,7 +947,7 @@ class Executor:
         if f is None or f.options.type == FIELD_TYPE_INT:
             return None
         if self.cluster is not None:
-            local = set(self._local_shards(index, shards))
+            local = set(self._local_shards(index, shards, remote))
             if any(s not in local for s in shards):
                 return None
         view = f.view(VIEW_STANDARD)
@@ -951,7 +966,7 @@ class Executor:
         returns (local_shards, count) or None when unsupported."""
         if self.mesh_engine is None:
             return None
-        local = self._local_shards(index, shards)
+        local = self._local_shards(index, shards, opt.remote)
         if not local:
             return None
         try:
@@ -1100,7 +1115,7 @@ class Executor:
         field_name = c.args.get("field")
         if not field_name or len(c.children) > 1:
             return None
-        local = self._local_shards(index, shards)
+        local = self._local_shards(index, shards, opt.remote)
         if not local:
             return None
         filter_call = c.children[0] if c.children else None
@@ -1155,7 +1170,7 @@ class Executor:
         field_name = c.args.get("field")
         if not field_name or len(c.children) > 1:
             return None
-        local = self._local_shards(index, shards)
+        local = self._local_shards(index, shards, opt.remote)
         if not local:
             return None
         filter_call = c.children[0] if c.children else None
@@ -1215,7 +1230,7 @@ class Executor:
             return None
         if len(c.children) > 1:
             raise Error("TopN() can only have one input bitmap")
-        local = set(self._local_shards(index, shards))
+        local = set(self._local_shards(index, shards, opt.remote))
         if any(s not in local for s in shards):
             return None
         field_name = c.args.get("_field") or DEFAULT_FIELD
@@ -1265,10 +1280,15 @@ class Executor:
         pairs.sort(key=cache_mod.pair_sort_key)
         return pairs
 
-    def _local_shards(self, index, shards):
+    def _local_shards(self, index, shards, remote: bool = False):
         """The locally-owned subset of ``shards`` (all of them when there
-        is no cluster)."""
-        if self.cluster is None:
+        is no cluster).  ``remote=True`` — a peer re-entry — returns ALL
+        requested shards: the initiator already routed them here, and
+        re-filtering against this node's possibly NEWER topology (a
+        resize admitting a node mid-query) would wrongly drop shards the
+        old placement assigned to us (executor.go mapper: Remote=true
+        executes the given shards verbatim)."""
+        if self.cluster is None or remote:
             return list(shards)
         return [
             s
@@ -1285,7 +1305,7 @@ class Executor:
         Returns (local_shard_set, pairs) or None."""
         if self.mesh_engine is None or len(c.children) != 1:
             return None
-        shards = self._local_shards(index, shards)
+        shards = self._local_shards(index, shards, opt.remote)
         if not shards:
             return None
         field_name = c.args.get("_field") or DEFAULT_FIELD
@@ -1491,7 +1511,7 @@ class Executor:
             extra = set(child.args) - {"field"}
             if child.name != "Rows" or extra:
                 return None
-        shards = self._local_shards(index, shards)
+        shards = self._local_shards(index, shards, opt.remote)
         if not shards:
             return None
         fields = [child.args["field"] for child in c.children]
