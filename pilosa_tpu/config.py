@@ -66,6 +66,14 @@ class Config:
         self.tracing_sampler_param = 0.001
         # translation
         self.translation_primary_url = ""
+        # TLS (server/config.go:25-33,61): certificate/key paths enable
+        # HTTPS serving; skip-verify lets cluster-internal clients accept
+        # self-signed certs.
+        self.tls_certificate = ""
+        self.tls_key = ""
+        self.tls_skip_verify = False
+        # HTTP handler options (server/config.go:54-58): CORS origins.
+        self.handler_allowed_origins: List[str] = []
         # mesh (TPU-native: devices for the shard mesh; 0 = all)
         self.mesh_devices = 0
         # multi-host JAX runtime (jax.distributed): coordinator address
@@ -136,6 +144,14 @@ class Config:
         self.translation_primary_url = tr.get(
             "primary-url", self.translation_primary_url
         )
+        tls = doc.get("tls", {})
+        self.tls_certificate = tls.get("certificate", self.tls_certificate)
+        self.tls_key = tls.get("key", self.tls_key)
+        self.tls_skip_verify = tls.get("skip-verify", self.tls_skip_verify)
+        h = doc.get("handler", {})
+        self.handler_allowed_origins = h.get(
+            "allowed-origins", self.handler_allowed_origins
+        )
         mesh = doc.get("mesh", {})
         self.mesh_devices = mesh.get("devices", self.mesh_devices)
         self.jax_coordinator = mesh.get("jax-coordinator", self.jax_coordinator)
@@ -176,6 +192,10 @@ class Config:
             ("metric_host", "METRIC_HOST", str),
             ("tracing_sampler_type", "TRACING_SAMPLER_TYPE", str),
             ("translation_primary_url", "TRANSLATION_PRIMARY_URL", str),
+            ("tls_certificate", "TLS_CERTIFICATE", str),
+            ("tls_key", "TLS_KEY", str),
+            ("tls_skip_verify", "TLS_SKIP_VERIFY", bool),
+            ("handler_allowed_origins", "HANDLER_ALLOWED_ORIGINS", list),
             ("mesh_devices", "MESH_DEVICES", int),
             ("jax_coordinator", "JAX_COORDINATOR", str),
             ("jax_num_processes", "JAX_NUM_PROCESSES", int),
@@ -225,6 +245,14 @@ diagnostics = {str(self.metric_diagnostics).lower()}
 [tracing]
 sampler-type = "{self.tracing_sampler_type}"
 sampler-param = {self.tracing_sampler_param}
+
+[tls]
+certificate = "{self.tls_certificate}"
+key = "{self.tls_key}"
+skip-verify = {str(self.tls_skip_verify).lower()}
+
+[handler]
+allowed-origins = [{", ".join(f'"{o}"' for o in self.handler_allowed_origins)}]
 
 [translation]
 primary-url = "{self.translation_primary_url}"

@@ -25,9 +25,23 @@ class ClientError(Exception):
 
 
 class InternalClient:
-    def __init__(self, uri: str, timeout: float = 30.0):
+    def __init__(
+        self, uri: str, timeout: float = 30.0, tls_skip_verify: bool = False
+    ):
+        """Scheme-aware: an ``https://`` uri speaks TLS;
+        ``tls_skip_verify`` accepts self-signed certs for
+        cluster-internal traffic (server/config.go TLSConfig.SkipVerify
+        :31-32, http/client.go GetHTTPClient)."""
         self.uri = uri.rstrip("/")
         self.timeout = timeout
+        self._ssl_ctx = None
+        if self.uri.startswith("https://") and tls_skip_verify:
+            import ssl
+
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_ctx = ctx
 
     # -- low level ---------------------------------------------------------
 
@@ -46,7 +60,9 @@ class InternalClient:
             headers={"Content-Type": content_type} if body is not None else {},
         )
         try:
-            with urlopen(req, timeout=self.timeout) as resp:
+            with urlopen(
+                req, timeout=self.timeout, context=self._ssl_ctx
+            ) as resp:
                 data = resp.read()
         except HTTPError as e:
             detail = e.read().decode(errors="replace")

@@ -34,11 +34,18 @@ class Route:
 
 
 class Handler:
-    """Dispatches requests to the API (http/handler.go Handler)."""
+    """Dispatches requests to the API (http/handler.go Handler).
 
-    def __init__(self, api: API, logger=None):
+    ``allowed_origins`` enables CORS (http/handler.go:80-90
+    OptHandlerAllowedOrigins wrapping gorilla's CORS middleware):
+    matching Origins get ``Access-Control-Allow-Origin`` on responses
+    and OPTIONS preflights are answered with the allowed methods and
+    the Content-Type header, mirroring handlers.CORS defaults."""
+
+    def __init__(self, api: API, logger=None, allowed_origins=None):
         self.api = api
         self.logger = logger
+        self.allowed_origins = list(allowed_origins or [])
         self.routes: List[Route] = []
         r = self._route
         # Public routes (http/handler.go:237-259).
@@ -539,6 +546,17 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
+    def _cors_origin(self):
+        """The request Origin when it matches the configured allowlist
+        ('*' allows any), else None."""
+        origins = self.handler.allowed_origins
+        origin = self.headers.get("Origin")
+        if not origins or not origin:
+            return None
+        if "*" in origins or origin in origins:
+            return origin
+        return None
+
     def _dispatch(self, method):
         parsed = urlparse(self.path)
         query = parse_qs(parsed.query)
@@ -550,6 +568,12 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        if self.handler.allowed_origins:
+            # Per-Origin responses must not be cached across origins.
+            self.send_header("Vary", "Origin")
+            origin = self._cors_origin()
+            if origin is not None:
+                self.send_header("Access-Control-Allow-Origin", origin)
         self.end_headers()
         self.wfile.write(payload)
 
@@ -562,12 +586,44 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._dispatch("DELETE")
 
+    def do_OPTIONS(self):
+        """CORS preflight (http/handler.go:83 handlers.CORS: allowed
+        methods + the Content-Type header).  Without a matching Origin
+        the preflight answers 200 with no allow headers — the browser
+        then blocks, same as gorilla's middleware."""
+        origin = self._cors_origin()
+        self.send_response(200)
+        if self.handler.allowed_origins:
+            self.send_header("Vary", "Origin")
+        if origin is not None:
+            self.send_header("Access-Control-Allow-Origin", origin)
+            self.send_header(
+                "Access-Control-Allow-Methods", "GET, POST, DELETE, OPTIONS"
+            )
+            self.send_header("Access-Control-Allow-Headers", "Content-Type")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
-def bind_http(host: str = "localhost", port: int = 10101) -> ThreadingHTTPServer:
+
+def make_server_ssl_context(certfile: str, keyfile: str):
+    """Server-side TLS context from cert/key paths (server/config.go
+    TLSConfig :25-33; server.go GetTLSConfig)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile=certfile, keyfile=keyfile or None)
+    return ctx
+
+
+def bind_http(
+    host: str = "localhost", port: int = 10101, ssl_context=None
+) -> ThreadingHTTPServer:
     """Bind the listening socket WITHOUT serving yet: callers that must
     advertise an ephemeral port (server.py Open order: cluster/gossip
     capture the URI before the API exists) learn the real port from
-    ``.server_address`` first, then pass the instance to serve()."""
+    ``.server_address`` first, then pass the instance to serve().
+    ``ssl_context`` serves HTTPS (reference: scheme https when
+    TLS.CertificatePath is set, server/server.go:204-214)."""
     cls = type("_BoundHandler", (_HTTPRequestHandler,), {"handler": None})
     # Serving tier: bursts of concurrent clients (the micro-batcher's
     # whole point) must not get connection-reset by the stdlib default
@@ -575,7 +631,16 @@ def bind_http(host: str = "localhost", port: int = 10101) -> ThreadingHTTPServer
     srv_cls = type(
         "_PilosaHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 128}
     )
-    return srv_cls((host, port), cls)
+    srv = srv_cls((host, port), cls)
+    if ssl_context is not None:
+        # Handshake on first read in the PER-REQUEST thread, not in the
+        # single accept loop: with do_handshake_on_connect=True a client
+        # that connects and stalls would block get_request() — and every
+        # other connection — for as long as it likes.
+        srv.socket = ssl_context.wrap_socket(
+            srv.socket, server_side=True, do_handshake_on_connect=False
+        )
+    return srv
 
 
 def serve(
@@ -583,14 +648,19 @@ def serve(
     host: str = "localhost",
     port: int = 10101,
     srv: Optional[ThreadingHTTPServer] = None,
+    ssl_context=None,
+    allowed_origins=None,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
     """Start the HTTP server on a background thread; returns (server,
     thread).  port=0 binds an ephemeral port (test harness pattern,
     test/pilosa.go:38-103).  ``srv`` continues a socket pre-bound with
-    bind_http()."""
+    bind_http().  ``ssl_context`` serves HTTPS; ``allowed_origins``
+    enables CORS."""
     if srv is None:
-        srv = bind_http(host, port)
-    srv.RequestHandlerClass.handler = Handler(api)
+        srv = bind_http(host, port, ssl_context=ssl_context)
+    srv.RequestHandlerClass.handler = Handler(
+        api, allowed_origins=allowed_origins
+    )
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
     return srv, thread

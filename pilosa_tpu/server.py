@@ -33,14 +33,14 @@ from .util import (
 )
 
 
-def _advertise_uri(host: str, port: int) -> str:
+def _advertise_uri(host: str, port: int, scheme: str = "http") -> str:
     """Dialable URI for the advertised node address.  Wildcard binds
     ('', '0.0.0.0') are LISTEN addresses, not destinations — advertise
     'localhost' for them (a multi-host deployment sets an explicit
     bind host, which is advertised verbatim)."""
     if host in ("", "0.0.0.0"):
         host = "localhost"
-    return f"http://{host}:{port}"
+    return f"{scheme}://{host}:{port}"
 
 
 class Server:
@@ -113,10 +113,19 @@ class Server:
         # below, so an ephemeral port (port=0, the test-harness pattern)
         # must be resolved to the real bound port before any of them
         # run, or peers/restarts would dial ":0".
-        from .net.server import bind_http
+        from .net.server import bind_http, make_server_ssl_context
 
+        ssl_ctx = None
+        if self.config.tls_certificate:
+            # HTTPS serving + https-scheme advertisement
+            # (server/config.go:25-33; server/server.go:204-214).
+            ssl_ctx = make_server_ssl_context(
+                self.config.tls_certificate, self.config.tls_key
+            )
+        self._ssl_ctx = ssl_ctx
         self._http = bind_http(
-            host if host not in ("", "0.0.0.0") else "0.0.0.0", port
+            host if host not in ("", "0.0.0.0") else "0.0.0.0", port,
+            ssl_context=ssl_ctx,
         )
         port = self._http.server_address[1]
         try:
@@ -163,7 +172,9 @@ class Server:
             # the REAL node id + bound address, not a placeholder.
             from .cluster import Node
 
-            local_node = Node(self.node_id, _advertise_uri(host, port), True)
+            local_node = Node(
+                self.node_id, _advertise_uri(host, port, self.scheme), True
+            )
         self.api = API(
             holder=self.holder,
             translate_store=self.translate_store,
@@ -177,7 +188,11 @@ class Server:
         )
         if mesh_engine is not None and self.config.mesh_sequencer:
             mesh_engine.ticket = self._make_ticket_fn()
-        self._http, self._http_thread = serve(self.api, srv=self._http)
+        self._http, self._http_thread = serve(
+            self.api,
+            srv=self._http,
+            allowed_origins=self.config.handler_allowed_origins,
+        )
         self.logger.printf(
             "pilosa-tpu listening on %s:%d (node %s)", host, port, self.node_id
         )
@@ -223,12 +238,11 @@ class Server:
         import urllib.request
 
         def fetch():
-            req = urllib.request.Request(
-                f"{target}/internal/mesh/ticket", data=b"{}", method="POST"
+            # _make_client: honors tls.skip-verify on https meshes.
+            doc = self._make_client(target)._post(
+                "/internal/mesh/ticket", {}
             )
-            req.add_header("Content-Type", "application/json")
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                return int(json.loads(resp.read())["seq"])
+            return int(doc["seq"])
 
         return fetch
 
@@ -247,11 +261,10 @@ class Server:
         did = uuid.uuid4().hex
 
         def post(url, body):
-            req = urllib.request.Request(
-                f"{url}/internal/mesh/dispatch", data=body, method="POST"
+            self._make_client(url)._do(
+                "POST", "/internal/mesh/dispatch", body,
+                content_type="application/json",
             )
-            req.add_header("Content-Type", "application/json")
-            urllib.request.urlopen(req, timeout=30).read()
 
         def fanout(body):
             futures = [
@@ -307,12 +320,13 @@ class Server:
             return
         from .cluster import Cluster, Node
 
-        uri = _advertise_uri(host, port)
+        uri = _advertise_uri(host, port, self.scheme)
         self.cluster = Cluster(
             node=Node(self.node_id, uri, self.config.cluster_coordinator),
             replica_n=self.config.cluster_replicas,
             hosts=self.config.cluster_hosts,
             path=self.data_dir,
+            client_factory=self._make_client,
             logger=self.logger,
         )
         if (
@@ -374,6 +388,21 @@ class Server:
             self.gossip.join((h or "127.0.0.1", int(p)))
 
     @property
+    def scheme(self) -> str:
+        """'https' when TLS serving is configured, else 'http' — the
+        scheme every advertised URI carries (server/server.go:204-214)."""
+        return "https" if self.config.tls_certificate else "http"
+
+    def _make_client(self, uri: str):
+        """Cluster-internal client honoring tls.skip-verify for
+        self-signed deployments (http/client.go GetHTTPClient)."""
+        from .net import InternalClient
+
+        return InternalClient(
+            uri, tls_skip_verify=self.config.tls_skip_verify
+        )
+
+    @property
     def port(self) -> int:
         return self._http.server_address[1]
 
@@ -400,9 +429,7 @@ class Server:
             self._spawn(self._replicate_translate, 1.0)
 
     def _replicate_translate(self):
-        from .net import InternalClient
-
-        client = InternalClient(self.config.translation_primary_url)
+        client = self._make_client(self.config.translation_primary_url)
         data = client.translate_data(self.translate_store.size())
         if data:
             self.translate_store.apply_log(data)

@@ -28,26 +28,39 @@ class ClusterHarness:
         return len(self.servers)
 
     def client(self, i: int = 0) -> InternalClient:
-        return InternalClient(f"http://localhost:{self.servers[i].port}")
+        s = self.servers[i]
+        return InternalClient(
+            f"{s.scheme}://localhost:{s.port}",
+            tls_skip_verify=s.config.tls_skip_verify,
+        )
 
     def close(self):
         for s in self.servers:
             s.close()
 
 
-def run_cluster(tmp_path, n: int, replica_n: int = 1) -> ClusterHarness:
+def run_cluster(tmp_path, n: int, replica_n: int = 1, tls=None) -> ClusterHarness:
+    """``tls=(certfile, keyfile)`` boots an HTTPS cluster with
+    skip-verify internal clients (self-signed deployment)."""
     servers: List[Server] = []
     for i in range(n):
         cfg = Config()
         cfg.data_dir = str(tmp_path / f"node{i}")
         cfg.bind = "localhost:0"
+        if tls is not None:
+            cfg.tls_certificate, cfg.tls_key = tls
+            cfg.tls_skip_verify = True
         srv = Server(cfg)
         srv.node_id = f"node{i}"
         srv.open(port_override=0)
         servers.append(srv)
 
     nodes = [
-        Node(s.node_id, f"http://localhost:{s.port}", is_coordinator=(i == 0))
+        Node(
+            s.node_id,
+            f"{s.scheme}://localhost:{s.port}",
+            is_coordinator=(i == 0),
+        )
         for i, s in enumerate(servers)
     ]
     for i, srv in enumerate(servers):
@@ -55,6 +68,7 @@ def run_cluster(tmp_path, n: int, replica_n: int = 1) -> ClusterHarness:
             node=nodes[i],
             replica_n=replica_n,
             path=srv.data_dir,
+            client_factory=srv._make_client,
             logger=srv.logger,
         )
         cluster.nodes = sorted(

@@ -1,0 +1,152 @@
+"""TLS serving + CORS middleware (r4 VERDICT missing #2/#3:
+server/config.go:25-61 TLSConfig, http/handler.go:83 CORS)."""
+
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.net.server import serve
+
+from harness import run_cluster
+
+
+@pytest.fixture(scope="module")
+def certpair(tmp_path_factory):
+    """Self-signed localhost cert via the cryptography package."""
+    import datetime as dt
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")]
+    )
+    now = dt.datetime.now(dt.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - dt.timedelta(days=1))
+        .not_valid_after(now + dt.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    certfile = d / "node.crt"
+    keyfile = d / "node.key"
+    certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    keyfile.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(certfile), str(keyfile)
+
+
+def test_tls_cluster_end_to_end(tmp_path, certpair):
+    """A 2-node cluster serving HTTPS with a self-signed cert: schema
+    broadcast, cross-node import routing, and queries all ride TLS
+    (scheme-aware InternalClient with skip-verify)."""
+    from pilosa_tpu.ops import SHARD_WIDTH
+
+    h = run_cluster(tmp_path, 2, tls=certpair)
+    try:
+        assert h[0].scheme == "https"
+        assert h[0].cluster.node.uri.startswith("https://")
+        client = h.client(0)
+        client.create_index("i")
+        client.create_field("i", "f")
+        cols = [s * SHARD_WIDTH + 7 for s in range(6)]
+        client.import_bits("i", "f", 0, [10] * len(cols), cols)
+        # Both nodes answer over TLS, incl. remote shard fan-out.
+        for i in range(2):
+            out = h.client(i).query("i", "Count(Row(f=10))")
+            assert out["results"] == [len(cols)], f"node {i}"
+        # The plain-HTTP scheme is refused by the TLS listener.
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://localhost:{h[0].port}/status", timeout=5
+            )
+    finally:
+        h.close()
+
+
+def test_tls_client_verifies_by_default(tmp_path, certpair):
+    """Without skip-verify, a self-signed server cert is REJECTED —
+    verification is on unless explicitly disabled (config skip-verify)."""
+    from pilosa_tpu.net import InternalClient
+    from pilosa_tpu.net.client import ClientError
+
+    h = run_cluster(tmp_path, 1, tls=certpair)
+    try:
+        strict = InternalClient(f"https://localhost:{h[0].port}")
+        with pytest.raises(ClientError, match="certificate|CERTIFICATE"):
+            strict.status()
+    finally:
+        h.close()
+
+
+@pytest.fixture
+def cors_server():
+    api = API()
+    srv, _ = serve(
+        api, "localhost", 0, allowed_origins=["https://app.example.com"]
+    )
+    yield f"http://localhost:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _req(uri, method="GET", origin=None, timeout=10):
+    req = urllib.request.Request(uri, method=method)
+    if origin:
+        req.add_header("Origin", origin)
+    if method == "OPTIONS":
+        req.add_header("Access-Control-Request-Method", "POST")
+        req.add_header("Access-Control-Request-Headers", "Content-Type")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_cors_preflight_and_headers(cors_server):
+    """OPTIONS preflight from an allowed Origin answers the CORS allow
+    headers (http/handler.go:83, handlers.CORS with AllowedHeaders
+    Content-Type); a disallowed Origin gets none; plain responses to
+    allowed Origins carry Access-Control-Allow-Origin."""
+    ok = "https://app.example.com"
+    with _req(cors_server + "/status", "OPTIONS", origin=ok) as resp:
+        assert resp.headers["Access-Control-Allow-Origin"] == ok
+        assert "POST" in resp.headers["Access-Control-Allow-Methods"]
+        assert "Content-Type" in resp.headers["Access-Control-Allow-Headers"]
+    with _req(cors_server + "/status", "OPTIONS", origin="https://evil.example") as resp:
+        assert resp.headers["Access-Control-Allow-Origin"] is None
+    with _req(cors_server + "/status", origin=ok) as resp:
+        assert resp.headers["Access-Control-Allow-Origin"] == ok
+        assert json.loads(resp.read())["state"] == "NORMAL"
+    # No Origin header: no CORS headers (same-origin requests).
+    with _req(cors_server + "/status") as resp:
+        assert resp.headers["Access-Control-Allow-Origin"] is None
+
+
+def test_cors_disabled_by_default():
+    """Without allowed-origins config there is no CORS handling at all
+    (the reference only wraps the mux when origins are configured)."""
+    api = API()
+    srv, _ = serve(api, "localhost", 0)
+    try:
+        uri = f"http://localhost:{srv.server_address[1]}"
+        with _req(uri + "/status", origin="https://app.example.com") as resp:
+            assert resp.headers["Access-Control-Allow-Origin"] is None
+    finally:
+        srv.shutdown()
