@@ -61,6 +61,9 @@ class Config:
         self.metric_host = ""
         self.metric_poll_interval = 0.0
         self.metric_diagnostics = True
+        # Latest-release source for the diagnostics version check
+        # (diagnostics.go:102: defaultVersionCheckURL); empty disables.
+        self.diagnostics_version_url = ""
         # tracing
         self.tracing_sampler_type = "none"  # profiler | span | none
         self.tracing_sampler_param = 0.001
@@ -135,6 +138,9 @@ class Config:
         if "poll-interval" in m:
             self.metric_poll_interval = _parse_duration(m["poll-interval"])
         self.metric_diagnostics = m.get("diagnostics", self.metric_diagnostics)
+        self.diagnostics_version_url = m.get(
+            "version-check-url", self.diagnostics_version_url
+        )
         t = doc.get("tracing", {})
         self.tracing_sampler_type = t.get("sampler-type", self.tracing_sampler_type)
         self.tracing_sampler_param = t.get(
@@ -190,6 +196,7 @@ class Config:
             ("anti_entropy_interval", "ANTI_ENTROPY_INTERVAL", _parse_duration),
             ("metric_service", "METRIC_SERVICE", str),
             ("metric_host", "METRIC_HOST", str),
+            ("diagnostics_version_url", "DIAGNOSTICS_VERSION_URL", str),
             ("tracing_sampler_type", "TRACING_SAMPLER_TYPE", str),
             ("translation_primary_url", "TRANSLATION_PRIMARY_URL", str),
             ("tls_certificate", "TLS_CERTIFICATE", str),

@@ -74,6 +74,9 @@ class Handler:
         r("POST", "/cluster/resize/set-coordinator", self._set_coordinator)
         r("GET", "/debug/vars", self._debug_vars)
         r("GET", "/debug/pprof", self._debug_pprof)
+        r("GET", "/debug/pprof/goroutine", self._debug_pprof)
+        r("GET", "/debug/pprof/profile", self._debug_pprof_profile)
+        r("GET", "/debug/pprof/heap", self._debug_pprof_heap)
         r("POST", "/debug/pprof/trace", self._debug_pprof_trace)
         # Internal routes (http/handler.go:262-272).
         r("POST", "/internal/cluster/message", self._cluster_message)
@@ -398,6 +401,85 @@ class Handler:
             out[threads.get(ident, str(ident))] = traceback.format_stack(frame)
         return {"threads": out, "count": len(out)}
 
+    def _debug_pprof_profile(self, q, b, **kw):
+        """/debug/pprof/profile (http/handler.go:241 mounts the full
+        pprof mux; Go's profile endpoint samples CPU for ?seconds=N).
+        Python analogue: a wall-clock sampling profiler over ALL threads
+        via sys._current_frames() — returns folded-stack lines
+        ("fnA;fnB;fnC count", the flamegraph interchange format) plus a
+        top-functions table.  Pure stdlib, no tracing overhead between
+        samples, and it sees every serving thread (cProfile cannot)."""
+        import sys
+        import time as time_mod
+
+        seconds = min(float(q.get("seconds", ["1"])[0]), 30.0)
+        hz = min(int(q.get("hz", ["100"])[0]), 1000)
+        period = 1.0 / max(hz, 1)
+        me = threading.get_ident()
+        folded: dict = {}
+        leaf_counts: dict = {}
+        n_samples = 0
+        deadline = time_mod.monotonic() + seconds
+        while time_mod.monotonic() < deadline:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue  # not the profiler's own sampling loop
+                stack = []
+                f = frame
+                while f is not None:
+                    code = f.f_code
+                    stack.append(f"{code.co_name} ({code.co_filename}:{code.co_firstlineno})")
+                    f = f.f_back
+                stack.reverse()
+                key = ";".join(stack)
+                folded[key] = folded.get(key, 0) + 1
+                leaf_counts[stack[-1]] = leaf_counts.get(stack[-1], 0) + 1
+            n_samples += 1
+            time_mod.sleep(period)
+        top = sorted(leaf_counts.items(), key=lambda kv: -kv[1])[:50]
+        return {
+            "seconds": seconds,
+            "hz": hz,
+            "samples": n_samples,
+            "top": [{"func": f, "count": c} for f, c in top],
+            "folded": [f"{k} {v}" for k, v in sorted(folded.items(), key=lambda kv: -kv[1])],
+        }
+
+    def _debug_pprof_heap(self, q, b, **kw):
+        """/debug/pprof/heap: tracemalloc-backed allocation profile.
+        The first call starts tracing (Go's heap profile is always-on
+        via the runtime; Python's tracer costs ~2x alloc overhead, so
+        it arms on demand); subsequent calls return the top allocation
+        sites by live bytes.  ?reset=true stops tracing."""
+        import tracemalloc
+
+        if _qbool(q, "reset"):
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
+            return {"tracing": False}
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(25)
+            return {
+                "tracing": True,
+                "note": "tracing armed; call again for a snapshot",
+            }
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("lineno")[:50]
+        current, peak = tracemalloc.get_traced_memory()
+        return {
+            "tracing": True,
+            "tracedBytes": current,
+            "peakBytes": peak,
+            "top": [
+                {
+                    "site": str(s.traceback),
+                    "bytes": s.size,
+                    "count": s.count,
+                }
+                for s in stats
+            ],
+        }
+
     _pprof_trace_lock = threading.Lock()
 
     def _debug_pprof_trace(self, q, b, **kw):
@@ -628,8 +710,26 @@ def bind_http(
     # Serving tier: bursts of concurrent clients (the micro-batcher's
     # whole point) must not get connection-reset by the stdlib default
     # listen backlog of 5.
+    def handle_error(self, request, client_address):
+        # TLS handshake failures (plain-HTTP probes, scanners, version
+        # mismatch) are a ONE-LINE log, not a per-connection traceback
+        # spam (the reference logs "TLS handshake error" once).  Other
+        # errors keep socketserver's traceback behavior.
+        import ssl
+        import sys
+
+        exc = sys.exception()
+        if isinstance(exc, (ssl.SSLError, ConnectionResetError)):
+            sys.stderr.write(
+                f"tls/conn error from {client_address}: {exc!r}\n"
+            )
+            return
+        ThreadingHTTPServer.handle_error(self, request, client_address)
+
     srv_cls = type(
-        "_PilosaHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 128}
+        "_PilosaHTTPServer",
+        (ThreadingHTTPServer,),
+        {"request_queue_size": 128, "handle_error": handle_error},
     )
     srv = srv_cls((host, port), cls)
     if ssl_context is not None:

@@ -61,6 +61,7 @@ class Server:
         self._http_thread = None
         self._closing = threading.Event()
         self._monitors = []
+        self._client_cache = {}
 
     # -- assembly ----------------------------------------------------------
 
@@ -239,7 +240,9 @@ class Server:
 
         def fetch():
             # _make_client: honors tls.skip-verify on https meshes.
-            doc = self._make_client(target)._post(
+            # 10s cap: a dead sequencer must not stall dispatchers for
+            # the full default client timeout.
+            doc = self._make_client(target, timeout=10.0)._post(
                 "/internal/mesh/ticket", {}
             )
             return int(doc["seq"])
@@ -393,14 +396,23 @@ class Server:
         scheme every advertised URI carries (server/server.go:204-214)."""
         return "https" if self.config.tls_certificate else "http"
 
-    def _make_client(self, uri: str):
+    def _make_client(self, uri: str, timeout: float = 30.0):
         """Cluster-internal client honoring tls.skip-verify for
-        self-signed deployments (http/client.go GetHTTPClient)."""
+        self-signed deployments (http/client.go GetHTTPClient).  Cached
+        per (uri, timeout): on https the skip-verify SSLContext loads
+        the system CA bundle from disk, far too expensive to rebuild on
+        the per-second replication poll or per-dispatch ticket fetch."""
         from .net import InternalClient
 
-        return InternalClient(
-            uri, tls_skip_verify=self.config.tls_skip_verify
-        )
+        key = (uri, timeout)
+        c = self._client_cache.get(key)
+        if c is None:
+            c = InternalClient(
+                uri, timeout=timeout,
+                tls_skip_verify=self.config.tls_skip_verify,
+            )
+            self._client_cache[key] = c
+        return c
 
     @property
     def port(self) -> int:
@@ -420,7 +432,9 @@ class Server:
             from .util.diagnostics import Diagnostics
 
             self.diagnostics = Diagnostics(
-                api=self.api, logger=self.logger
+                api=self.api,
+                logger=self.logger,
+                version_url=self.config.diagnostics_version_url,
             ).start()
         # Translate-store replication from the primary (translate.go
         # monitorReplication :358-432).

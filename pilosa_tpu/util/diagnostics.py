@@ -26,6 +26,7 @@ class Diagnostics:
         endpoint: str = "",
         interval: float = DEFAULT_INTERVAL,
         logger=None,
+        version_url: str = "",
     ):
         self.api = api
         self.endpoint = endpoint
@@ -36,6 +37,11 @@ class Diagnostics:
         self._closing = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_report: Optional[dict] = None  # inspectable for tests
+        # Upstream version check (diagnostics.go:102-150): version_url
+        # serves {"version": "vX.Y.Z"}; a newer release logs a warning.
+        self.version_url = version_url
+        self.last_version = ""
+        self.last_version_warning = ""
 
     # -- payload (diagnostics.go:180-249) ----------------------------------
 
@@ -96,12 +102,75 @@ class Diagnostics:
             if self.logger:
                 self.logger.debugf("diagnostics flush failed: %s", e)
 
+    # -- version check (diagnostics.go CheckVersion :102-150) --------------
+
+    @staticmethod
+    def _version_segments(v: str):
+        """'v1.2.3[-suffix]' -> [1, 2, 3] (diagnostics.go
+        versionSegments); malformed strings yield [] (no comparison)."""
+        v = v.lstrip("v").split("-")[0]
+        parts = v.split(".")
+        try:
+            segs = [int(p) for p in parts]
+        except ValueError:
+            return []
+        return (segs + [0, 0, 0])[:3]
+
+    def check_version(self) -> str:
+        """Fetch the latest released version and compare against the
+        local one; returns (and logs) a warning string when upstream is
+        newer, "" otherwise.  Never raises (best-effort, like the
+        diagnostics flush)."""
+        if not self.version_url:
+            return ""
+        try:
+            from urllib.request import urlopen
+
+            with urlopen(self.version_url, timeout=10) as resp:
+                latest = json.loads(resp.read()).get("version", "")
+        except Exception as e:
+            if self.logger:
+                self.logger.debugf("version check failed: %s", e)
+            return ""
+        if not latest or latest == self.last_version:
+            return self.last_version_warning if latest else ""
+        self.last_version = latest
+        local = self.api.version() if self.api else ""
+        warning = self._compare_version(local, latest)
+        self.last_version_warning = warning
+        if warning and self.logger:
+            self.logger.printf("%s", warning)
+        return warning
+
+    @staticmethod
+    def _compare_version(local: str, latest: str) -> str:
+        """diagnostics.go compareVersion :135-150: major/minor/patch
+        messages when upstream is ahead."""
+        lv = Diagnostics._version_segments(local)
+        rv = Diagnostics._version_segments(latest)
+        if not lv or not rv:
+            return ""
+        if lv[0] < rv[0]:
+            return (
+                f"Warning: You are running version {local}. "
+                f"A newer version ({latest}) is available"
+            )
+        if lv[1] < rv[1] and lv[0] == rv[0]:
+            return (
+                f"Warning: You are running version {local}. "
+                f"The latest minor release is {latest}"
+            )
+        if lv[2] < rv[2] and lv[0] == rv[0] and lv[1] == rv[1]:
+            return f"There is a new patch release available: {latest}"
+        return ""
+
     # -- loop (server.go monitorDiagnostics :675) --------------------------
 
     def start(self):
         def loop():
             while not self._closing.wait(self.interval):
                 self.flush()
+                self.check_version()
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -359,3 +359,48 @@ def test_debug_pprof_thread_dump(tmp_path):
         assert all(isinstance(v, list) for v in doc["threads"].values())
     finally:
         httpd.shutdown()
+
+
+def test_debug_pprof_profile_and_heap(server):
+    """/debug/pprof/profile samples every serving thread into
+    folded-stack lines; /debug/pprof/heap arms tracemalloc then
+    snapshots top allocation sites (http/handler.go:241 mounts the full
+    pprof suite)."""
+    import threading
+    import time as time_mod
+    import urllib.request
+
+    api, client = server
+    stop = threading.Event()
+
+    def spin():  # a busy worker the profiler must catch
+        while not stop.is_set():
+            sum(range(1000))
+
+    t = threading.Thread(target=spin, daemon=True, name="busy-worker")
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            client.uri + "/debug/pprof/profile?seconds=0.3&hz=200", timeout=30
+        ) as resp:
+            prof = json.loads(resp.read())
+        assert prof["samples"] > 10
+        assert prof["folded"], "no stacks sampled"
+        assert any("spin" in line for line in prof["folded"])
+        assert any("spin" in e["func"] for e in prof["top"])
+    finally:
+        stop.set()
+
+    try:
+        heap = client._get("/debug/pprof/heap")
+        assert heap["tracing"] is True  # first call arms the tracer
+        blob = [bytearray(1 << 20) for _ in range(4)]  # 4 MB live
+        heap = client._get("/debug/pprof/heap")
+        assert heap["tracedBytes"] > (1 << 20)
+        assert heap["top"] and heap["top"][0]["bytes"] > 0
+        del blob
+    finally:
+        # Always disarm: process-global tracemalloc left tracing would
+        # tax every later test in this pytest process.
+        out = client._get("/debug/pprof/heap?reset=true")
+    assert out == {"tracing": False}

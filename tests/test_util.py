@@ -70,3 +70,64 @@ def test_statsd_datagrams():
     assert msg == "pilosa_tpu.latency:250|ms|#index:i"
     recv.close()
     c.close()
+
+
+def test_diagnostics_version_check():
+    """diagnostics.go CheckVersion :102-150: fetch {"version": ...} from
+    the configured URL, warn (by severity segment) when upstream is
+    ahead, dedupe repeat answers."""
+    import http.server
+    import json as json_mod
+    import threading
+
+    latest = {"v": "v9.9.9"}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json_mod.dumps({"version": latest["v"]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("localhost", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        api = API()
+        d = Diagnostics(
+            api=api,
+            version_url=f"http://localhost:{srv.server_address[1]}/version",
+        )
+        w = d.check_version()
+        assert "newer version (v9.9.9)" in w
+        assert d.last_version == "v9.9.9"
+        # Same answer again: deduped, warning retained.
+        assert d.check_version() == w
+        # Patch-level bump produces the patch message.
+        local = api.version().lstrip("v").split("-")[0].split(".")
+        latest["v"] = f"v{local[0]}.{local[1]}.{int(local[2]) + 1}"
+        assert "patch release" in d.check_version()
+        # Upstream equal to local: no warning.
+        latest["v"] = "v" + api.version().lstrip("v").split("-")[0]
+        assert d.check_version() == ""
+    finally:
+        srv.shutdown()
+
+
+def test_diagnostics_version_check_unreachable():
+    """A dead version source is best-effort: no raise, no warning."""
+    d = Diagnostics(api=API(), version_url="http://localhost:1/version")
+    assert d.check_version() == ""
+
+
+def test_compare_version_segments():
+    cmp = Diagnostics._compare_version
+    assert "newer version" in cmp("v1.0.0", "v2.0.0")
+    assert "minor release" in cmp("v1.1.0", "v1.2.0")
+    assert "patch release" in cmp("v1.1.1", "v1.1.2")
+    assert cmp("v1.1.1", "v1.1.1") == ""
+    assert cmp("v2.0.0", "v1.9.9") == ""  # local ahead
+    assert cmp("v1.2.3", "garbage") == ""  # malformed: no comparison
