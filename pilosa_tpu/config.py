@@ -90,6 +90,11 @@ class Config:
         # sequence tickets.  "self" = this node; a base URL = a peer;
         # "" = disabled (route collectives through one entry node).
         self.mesh_sequencer = ""
+        # Per-peer timeout for the collective dispatch handoff: a
+        # STALLED peer (frozen process, pumba-style) must fail the
+        # broadcast within this bound so fused queries degrade to the
+        # host path instead of hanging the dispatcher.
+        self.mesh_dispatch_timeout = 30.0
 
     # -- loading -----------------------------------------------------------
 
@@ -167,6 +172,10 @@ class Config:
         self.jax_process_id = mesh.get("jax-process-id", self.jax_process_id)
         self.mesh_peers = mesh.get("peers", self.mesh_peers)
         self.mesh_sequencer = mesh.get("sequencer", self.mesh_sequencer)
+        if "dispatch-timeout" in mesh:
+            self.mesh_dispatch_timeout = _parse_duration(
+                mesh["dispatch-timeout"]
+            )
 
     def load_env(self, environ=None):
         env = environ if environ is not None else os.environ

@@ -192,6 +192,12 @@ class Field:
             )
         # Shards known to exist anywhere in the cluster for this field.
         self.remote_available_shards = Bitmap()
+        # Bumped on every remote-availability change: executors cache
+        # the per-index default shard list against (shard_epoch, this)
+        # instead of re-unioning field bitmaps per query (np.unique in
+        # Index.available_shards measured as the top serving-tier CPU
+        # cost on a 1-core host).
+        self.avail_version = 0
         if path is not None:
             os.makedirs(path, exist_ok=True)
             self._load_meta()
@@ -259,6 +265,7 @@ class Field:
 
     def add_remote_available_shards(self, b: Bitmap):
         self.remote_available_shards = self.remote_available_shards.union(b)
+        self.avail_version += 1
         self._save_available_shards()
 
     def remove_available_shard(self, shard: int):
@@ -267,6 +274,7 @@ class Field:
         fragments, always remain)."""
         remaining = set(self.remote_available_shards) - {shard}
         self.remote_available_shards = Bitmap(remaining)
+        self.avail_version += 1
         self._save_available_shards()
 
     def _available_shards_path(self) -> str:

@@ -418,16 +418,10 @@ class Executor:
         if plan is False:
             return None, parsed
         if not shards:  # same default as _execute: every available shard
-            epoch = self.holder.shard_epoch(index)
-            cached = self._fast_shards.get(index)
-            if cached is not None and cached[0] == epoch:
-                shards = cached[1]
-            else:
-                idx = self.holder.index(index)
-                if idx is None:
-                    return None, parsed
-                shards = [int(s) for s in idx.available_shards()]
-                self._fast_shards[index] = (epoch, shards)
+            try:
+                shards = self._default_shards(index)
+            except IndexNotFoundError:
+                return None, parsed
         total = self._count_from_cardinalities(index, plan, shards)
         if total is None:
             return None, parsed
@@ -476,14 +470,35 @@ class Executor:
             self.translator.translate_results(index, idx, query.calls, results)
         return resp
 
+    def _default_shards(self, index: str) -> List[int]:
+        """The index's full available-shard list, cached against
+        (shard epoch, field availability versions): available_shards()
+        unions one Bitmap per field per call (its np.unique dominated
+        the serving tier under load) while the shard set changes only
+        on fragment create/remove (epoch) or NodeStatus merges
+        (per-field avail_version)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        token = (
+            self.holder.shard_epoch(index),
+            sum(f.avail_version for f in idx.fields.values()),
+            len(idx.fields),
+        )
+        cached = self._fast_shards.get(index)
+        if cached is not None and cached[0] == token:
+            return cached[1]
+        shards = [int(s) for s in idx.available_shards()]
+        self._fast_shards[index] = (token, shards)
+        return shards
+
     def _execute(self, index, query: Query, shards, opt) -> list:
         needs = any(
             c.name not in ("Set", "Clear", "SetRowAttrs", "SetColumnAttrs")
             for c in query.calls
         )
         if not shards and needs:
-            idx = self.holder.index(index)
-            shards = [int(s) for s in idx.available_shards()]
+            shards = self._default_shards(index)
             if not shards:
                 shards = [0]
 
@@ -1453,12 +1468,20 @@ class Executor:
         filter_call = c.call_arg("filter")
 
         child_rows: List[Optional[List[int]]] = [None] * len(c.children)
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
         for i, child in enumerate(c.children):
             if child.name != "Rows":
                 raise Error(
                     f"'{child.name}' is not a valid child query for GroupBy, "
                     "must be 'Rows'"
                 )
+            # An unknown field is an error up front (executor.go GroupBy
+            # "Unknown Field"), not a silent empty result.
+            fname = child.args.get("field")
+            if not isinstance(fname, str) or idx.field(fname) is None:
+                raise FieldNotFoundError(str(fname))
             _, has_lim = child.uint_arg("limit")
             _, has_col = child.uint_arg("column")
             if has_lim or has_col:

@@ -43,14 +43,6 @@ class CountBatcher:
     # collective); surface an error instead of blocking the HTTP thread
     # forever.
     WAIT_TIMEOUT = 300.0
-    # Above this measured device->host readback RTT, the transport
-    # overlaps concurrent per-request syncs far better than a serialized
-    # batch cycle can amortize the dispatch floor (e.g. a ~90 ms relay
-    # tunnel: 32 overlapped RTTs >> 1 RTT per ~10-query batch), so the
-    # batcher runs in OVERLAP mode: every submit executes concurrently
-    # on its own thread, unbatched.  On a real TPU host (RTT ~0.1 ms)
-    # the dispatch floor dominates and fused batching engages.
-    RTT_OVERLAP_THRESHOLD = 0.010
     # After a real (>=2 query) fused batch, keep routing arrivals through
     # the queue for this long: under sustained concurrency the direct
     # path would otherwise steal leadership after every batch and
@@ -60,7 +52,7 @@ class CountBatcher:
     # latency is untouched.
     HOT_WINDOW = 0.25
 
-    def __init__(self, engine, max_batch: int = 256):
+    def __init__(self, engine, max_batch: int = 512):
         self.engine = engine
         self.max_batch = max_batch
         self._lock = threading.Lock()
@@ -69,42 +61,24 @@ class CountBatcher:
         self._busy = False
         self._inflight = threading.Semaphore(self.MAX_INFLIGHT)
         self._last_fused = 0.0  # monotonic time of the last >=2 batch
-        self.readback_rtt = self._probe_rtt()
-        self.overlap_mode = self.readback_rtt > self.RTT_OVERLAP_THRESHOLD
         self._worker: Optional[threading.Thread] = None
         # Telemetry the QPS bench and tests assert on.
         self.batches = 0
         self.batched_queries = 0
 
-    def _probe_rtt(self) -> float:
-        """Measure dispatch + readback of a FRESH trivial computation —
-        the per-request sync floor.  It must be freshly computed: some
-        transports (the axon relay) answer committed-buffer reads from a
-        local cache, which would under-report the real round trip."""
-        import jax
-        import jax.numpy as jnp
-
-        try:
-            f = jax.jit(lambda x: x + jnp.int32(1))
-            x = jax.device_put(jnp.int32(1))
-            jax.device_get(f(x))  # compile + warm the channel
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.monotonic()
-                jax.device_get(f(x))
-                best = min(best, time.monotonic() - t0)
-            return best
-        except Exception:  # pragma: no cover — no device: batch mode
-            return 0.0
-
     def submit(self, index: str, call, shards) -> int:
-        """Count one tree; returns the count.  Overlap mode (slow
-        transport): execute concurrently, unbatched.  Batch mode: lone
-        callers run directly (no handoff); callers arriving while a
-        dispatch is in flight — or within the hot window after a fused
-        batch — are queued and answered from the next fused batch."""
-        if self.overlap_mode:
-            return self.engine.count(index, call, shards)
+        """Count one tree; returns the count.  Lone callers run directly
+        (no handoff); callers arriving while a dispatch is in flight —
+        or within the hot window after a fused batch — are queued and
+        answered from the next fused batch.
+
+        There is no unbatched "overlap mode" for slow transports any
+        more (round 4 had one): with completion threads pipelining up to
+        MAX_INFLIGHT batch readbacks, the batch cycle no longer
+        serializes on the readback RTT, and fusing K queries per
+        dispatch is what keeps the per-request host cost (jit-call
+        overhead, GIL) sublinear at high client counts — the axis round
+        4 left 8x under target."""
         with self._lock:
             hot = time.monotonic() - self._last_fused < self.HOT_WINDOW
             if not self._busy and not self._queue and not hot:
@@ -114,9 +88,12 @@ class CountBatcher:
                 item = _Item(index, call, list(shards))
                 self._queue.append(item)
                 self._ensure_worker()
-                # Wake the worker: in the hot-window case nobody is busy,
-                # so no completion notify is coming.
-                self._cond.notify_all()
+                # Wake the worker on the empty->non-empty transition
+                # only (it polls during accumulation): per-submit
+                # notify_all was measurable lock churn at ~1k
+                # submits/s on a single-core host.
+                if len(self._queue) == 1:
+                    self._cond.notify_all()
                 direct = False
         if direct:
             try:
@@ -139,11 +116,35 @@ class CountBatcher:
             )
             self._worker.start()
 
+    # Accumulation window: once the queue is non-empty, give concurrent
+    # arrivals this long to pile into the SAME drain before dispatching.
+    # Readback round trips serialize in the transport, so throughput is
+    # (answers per readback) x (readbacks per second) — an eager worker
+    # fragments arrivals into many small batches and caps throughput at
+    # the readback rate; a short accumulation multiplies it by K.  Idle
+    # single queries never pass through here (direct path), so this
+    # costs latency only when the system is already saturated.
+    # The window breaks EARLY when arrivals go quiet (depth stable
+    # across one poll), so a lone straggler pays ~one poll, not the
+    # whole window.
+    ACCUM_WINDOW = 0.15
+    ACCUM_POLL = 0.005
+
     def _worker_loop(self):
         while True:
             with self._lock:
                 while self._busy or not self._queue:
                     self._cond.wait(timeout=60.0)
+            deadline = time.monotonic() + self.ACCUM_WINDOW
+            prev = -1
+            while time.monotonic() < deadline:
+                with self._lock:
+                    depth = len(self._queue)
+                if depth >= self.max_batch or depth == prev:
+                    break  # full drain ready, or arrivals went quiet
+                prev = depth
+                time.sleep(self.ACCUM_POLL)
+            with self._lock:
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]
                 self._busy = True
@@ -157,17 +158,49 @@ class CountBatcher:
 
     # In-flight readbacks allowed to overlap: the worker dispatches
     # batch N+1 while N's results are still in transit — otherwise the
-    # readback round-trip floors the batch cycle time.  Bounded small: a
-    # runaway pipeline of unawaited collectives can starve the backend.
-    MAX_INFLIGHT = 4
+    # readback round-trip floors the batch cycle time.  DELIBERATELY
+    # small: device_get round trips serialize in the transport (~11/s
+    # measured through the relay regardless of concurrency), so an
+    # eager worker fragments the load into many small batches that each
+    # burn a serialized readback slot.  With 2 slots the worker BLOCKS
+    # on the third dispatch and the queue accumulates a full readback
+    # period of arrivals — batch size self-tunes to
+    # arrival_rate x readback_time, and throughput approaches
+    # slots x K / readback (measured 105 -> ~1900 qps at 384 clients).
+    MAX_INFLIGHT = 2
+
+    @staticmethod
+    def _signature(index, call) -> tuple:
+        """Batch-group key: index + the call tree with integer literals
+        masked.  Entries of one fused dispatch must share a STRUCTURE
+        (field names, operators, nesting) so the padded batch program's
+        compile key is independent of which rows/values were asked —
+        row ids are traced operands (engine slot vector), so any batch
+        of the same signature and tier reuses one executable.
+
+        Timestamp literals (segments touching '-'/':'/'T') are NOT
+        masked: a time Range lowers to one leaf per covered view, so
+        different spans are different program structures and must not
+        share a group."""
+        import re
+
+        def mask(m):
+            s, e = m.start(), m.end()
+            ctx = m.string[max(0, s - 1) : e + 1]
+            if "-" in ctx or ":" in ctx or "T" in ctx:
+                return m.group()
+            return "#"
+
+        return (index, re.sub(r"\d+", mask, str(call)))
 
     def _run_batch(self, batch: List[_Item]):
-        # One dispatch per index present in the drain (operand lists are
-        # per-index; mixed-index drains are rare and still amortize).
+        # One dispatch per (index, structure) group in the drain
+        # (operand lists are per-index; mixed structures would compile
+        # distinct padded programs, so each structure fuses separately).
         by_index = {}
         for it in batch:
-            by_index.setdefault(it.index, []).append(it)
-        for index, items in by_index.items():
+            by_index.setdefault(self._signature(it.index, it.call), []).append(it)
+        for (index, _sig), items in by_index.items():
             try:
                 self._inflight.acquire()
                 try:
@@ -191,18 +224,38 @@ class CountBatcher:
                 self.batched_queries += len(items)
                 if len(items) >= 2:
                     self._last_fused = time.monotonic()
-            except Exception:
-                # One bad tree (unlowerable shape, unknown field) must
-                # not fail its batchmates: retry each alone, attributing
-                # errors to their own submitters.
+            except Exception as batch_err:
+                # One bad tree (unlowerable argument shape, unknown
+                # field) must not fail its batchmates — but a serial
+                # per-item retry would stall the worker for minutes on a
+                # 512-item group (each retry pays a full readback).
+                # Instead split FAST: probe each item's LOWERING (host
+                # work, no dispatch) to attribute the error, then
+                # re-dispatch the survivors as ONE batch.
+                good = []
                 for it in items:
                     try:
-                        it.result = self.engine.count(
-                            it.index, it.call, it.shards
+                        from .engine import _Lowering
+
+                        lw = _Lowering(
+                            self.engine,
+                            self.engine.canonical_shards(it.index),
+                            slot_vector=True,
                         )
-                    except BaseException as e:  # noqa: BLE001
+                        self.engine._lower(it.index, it.call, lw)
+                        good.append(it)
+                    except Exception as e:  # noqa: BLE001
                         it.error = e
-                    it.event.set()
+                        it.event.set()
+                if good and len(good) < len(items):
+                    self._run_batch(good)  # one re-dispatch, same path
+                else:
+                    # Nothing attributable (a dispatch-level failure):
+                    # fail the whole group with the batch error.
+                    for it in good or items:
+                        if it.error is None:
+                            it.error = batch_err
+                        it.event.set()
 
     def _complete(self, dev, items: List[_Item]):
         import jax

@@ -91,15 +91,46 @@ class _TopNCandidates:
 
 
 class _Lowering:
-    """Flat operand list + per-operand shardings for one query program."""
+    """Flat operand list + per-operand shardings for one query program.
 
-    def __init__(self, engine, canonical: List[int]):
+    ``slot_vector=True`` (the batched-count path) coalesces every row-id
+    scalar into ONE int32 vector at operand 0, with prog leaves carrying
+    STATIC slot indices ``("sv", j)``: entry j of a K_pad batch then
+    always reads slots in a position that depends only on j, so the
+    compiled program is identical for every batch of the same structure
+    and tier — without this, each distinct raw batch size laid scalars
+    out at different operand indices and compiled a FRESH ~2 s XLA
+    program per drain (measured: the entire round-4 QPS shortfall)."""
+
+    def __init__(self, engine, canonical: List[int], slot_vector: bool = False):
         self.engine = engine
         self.canonical = canonical
         self.operands: list = []
         self.specs: list = []
         self._mat_ids: Dict[int, int] = {}
         self._stacks: dict = {}
+        self.scalar_values: Optional[list] = None
+        if slot_vector:
+            self.scalar_values = []
+            self.operands.append(None)  # slot vector, filled by finish()
+            self.specs.append(P())
+
+    def scalar_ref(self, value: int):
+        """Row-index scalar: a slot in the batch vector (slot_vector
+        mode) or a cached replicated device scalar operand."""
+        if self.scalar_values is not None:
+            self.scalar_values.append(int(value))
+            return ("sv", len(self.scalar_values) - 1)
+        return self.add_replicated(self.engine._scalar(value))
+
+    def finish(self):
+        """Materialize the slot vector (ONE tiny device put per batch)."""
+        if self.scalar_values is not None:
+            self.operands[0] = put_global(
+                self.engine.mesh,
+                np.asarray(self.scalar_values or [0], np.int32),
+                P(),
+            )
 
     def stack_for(self, index, field, view):
         """ONE field_stack call per (index, field, view) per query.
@@ -750,7 +781,7 @@ class MeshEngine:
             if stack is None or row_id not in stack.row_index:
                 continue
             i_mat = lw.add_matrix(stack.matrix)
-            i_idx = lw.add_replicated(self._scalar(stack.row_index[row_id]))
+            i_idx = lw.scalar_ref(stack.row_index[row_id])
             leaves.append(("row", i_mat, i_idx))
         if not leaves:
             return self._lower_zero(lw)
@@ -762,11 +793,34 @@ class MeshEngine:
         return ("zero", lw.add_matrix(self._zero_stack(lw.canonical)))
 
     def _lower_row(self, index, field, row_id, lw: _Lowering):
+        # A missing FIELD is an error (the host path raises
+        # FieldNotFound; a silent zero stack here would make the fused
+        # path diverge from the reference).  The auto-created existence
+        # field is exempt: Not() lowers it unconditionally and an index
+        # without existence tracking legitimately contributes zeros.
+        from ..core.index import EXISTENCE_FIELD_NAME
+
+        idx_obj = self.holder.index(index)
+        if field != EXISTENCE_FIELD_NAME and (
+            idx_obj is None or idx_obj.field(field) is None
+        ):
+            raise ValueError(f"field not found: {field!r}")
         stack = lw.stack_for(index, field, VIEW_STANDARD)
-        if stack is None or row_id not in stack.row_index:
+        if stack is None:
+            return self._lower_zero(lw)
+        if lw.scalar_values is not None:
+            # Slot-vector (batched) mode: row PRESENCE must be data, not
+            # program structure — a ("zero",) leaf for a missing row id
+            # would give each present/absent pattern across a drain its
+            # own compile key, resurrecting the per-drain ~2 s compiles
+            # the fixed tiers exist to kill.  ("rowm", ...) gathers with
+            # the slot's index and masks to zero when it carries -1.
+            i_mat = lw.add_matrix(stack.matrix)
+            return ("rowm", i_mat, lw.scalar_ref(stack.row_index.get(row_id, -1)))
+        if row_id not in stack.row_index:
             return self._lower_zero(lw)
         i_mat = lw.add_matrix(stack.matrix)
-        i_idx = lw.add_replicated(self._scalar(stack.row_index[row_id]))
+        i_idx = lw.scalar_ref(stack.row_index[row_id])
         return ("row", i_mat, i_idx)
 
     def _plane_spec(self, stack: _FieldStack, depth: int):
@@ -800,7 +854,7 @@ class MeshEngine:
             nn_idx = stack.row_index.get(depth)
             if nn_idx is None:
                 return self._lower_zero(lw)
-            i_idx = lw.add_replicated(self._scalar(nn_idx))
+            i_idx = lw.scalar_ref(nn_idx)
             return ("row", i_mat, i_idx)
 
         if cond.op == NEQ and cond.value is None:
@@ -1018,20 +1072,36 @@ class MeshEngine:
             broadcast,
         )
 
+    # Fixed batch-program tiers: the compile key is (query structure,
+    # tier), NOT the raw batch size — a drain of 17 and a drain of 23
+    # run the SAME 64-slot executable.  Three executables per structure
+    # family total, each warmable ahead of load.
+    BATCH_TIERS = (8, 64, 256, 512)
+
     def _dispatch_count_batch(self, index, calls, shards_list, canonical):
-        lw = _Lowering(self, canonical)
+        lw = _Lowering(self, canonical, slot_vector=True)
         progs = []
         for c, shards in zip(calls, shards_list):
             prog = self._lower(index, c, lw)
             i_mask = lw.add_mask(self._mask_words(shards, canonical))
             progs.append((prog, i_mask))
-        # Pad the program tuple to the next power of two by repeating the
-        # last pair: XLA CSEs the duplicate subtree (near-free) and the
-        # executable cache sees O(log K) batch sizes per structure
-        # instead of every K.
+        # Pad to the tier by RE-LOWERING query 0: padding entries then
+        # occupy their own deterministic slots, so the padded program is
+        # byte-identical for every batch of the same structure + tier
+        # (XLA CSEs the duplicate trees; the dead slots cost nothing).
+        # Repeating the LAST pair instead (round 4) kept the raw K in
+        # the operand indexing and compiled a fresh program per distinct
+        # drain size — ~2 s each, the entire QPS shortfall.
         K = len(progs)
-        K_pad = max(1, 1 << (K - 1).bit_length())
-        progs.extend([progs[-1]] * (K_pad - K))
+        K_pad = next(
+            (t for t in self.BATCH_TIERS if K <= t),
+            max(1, 1 << (K - 1).bit_length()),
+        )
+        for _ in range(K_pad - K):
+            prog = self._lower(index, calls[0], lw)
+            i_mask = lw.add_mask(self._mask_words(shards_list[0], canonical))
+            progs.append((prog, i_mask))
+        lw.finish()
         self.fused_dispatches += 1
         return kernels.count_batch_tree(
             self.mesh, tuple(progs), tuple(lw.specs), *lw.operands

@@ -63,8 +63,23 @@ def apply_prog(prog, operands):
     if kind == "zero":
         return operands[prog[1]][0]
     if kind == "row":
-        mat, idx = operands[prog[1]], operands[prog[2]]
+        mat = operands[prog[1]]
+        ref = prog[2]
+        # ("sv", j): STATIC slot j of the batch's row-index vector
+        # (operand 0, engine._Lowering slot_vector mode); otherwise a
+        # replicated scalar operand index.
+        idx = operands[0][ref[1]] if isinstance(ref, tuple) else operands[ref]
         return jax.lax.dynamic_index_in_dim(mat, idx, axis=0, keepdims=False)
+    if kind == "rowm":
+        # Maskable row gather (batched mode): slot index -1 means the
+        # row id doesn't exist — gather row 0 and zero the result, so
+        # presence is DATA and every drain compiles one program.
+        mat = operands[prog[1]]
+        idx = operands[0][prog[2][1]]
+        row = jax.lax.dynamic_index_in_dim(
+            mat, jnp.maximum(idx, 0), axis=0, keepdims=False
+        )
+        return jnp.where(idx >= 0, row, jnp.zeros_like(row))
     if kind == "range":
         _, rk, i_mat, pspec, i_bits = prog
         planes = gather_planes(operands[i_mat], pspec)
@@ -149,6 +164,55 @@ def _filter(prog, mask, ops):
     return jnp.bitwise_and(apply_prog(prog, ops), mask)
 
 
+# Operand cap per variadic lax.reduce: beyond this the reductions chunk
+# (each chunk re-reads the shared operand once — negligible for the
+# shared src row vs K candidate planes) to bound compile time.
+VARIADIC_CHUNK = 64
+
+
+def _sum_many(ops_list, axes):
+    """K popcount-style reductions over SHARED inputs in ONE pass each:
+    a variadic ``lax.reduce`` with an elementwise-add combiner.  XLA
+    fuses the virtual elementwise operands (pc(a & b), ...) into the
+    reduce loop, so every distinct input plane streams from HBM exactly
+    once — where K separate ``jnp.sum`` calls re-read the shared
+    operand K times (measured: TopN scoring 489 -> 756 GB/s, 3-field
+    GroupBy 173 -> 751 GB/s; scripts/kernel_opt.py).  Returns a list of
+    reduced arrays in input order."""
+    out = []
+    for c in range(0, len(ops_list), VARIADIC_CHUNK):
+        chunk = tuple(ops_list[c : c + VARIADIC_CHUNK])
+        outs = jax.lax.reduce(
+            chunk,
+            tuple(jnp.int32(0) for _ in chunk),
+            lambda a, b: tuple(x + y for x, y in zip(a, b)),
+            axes,
+        )
+        out.extend(outs if isinstance(outs, (tuple, list)) else [outs])
+    return out
+
+
+# Above this candidate count the variadic form's K unrolled gather+pc
+# nodes make XLA compile time scale with K (MAX_TOPN_CANDIDATES is
+# 4096); the broadcast form compiles O(1) and its src re-reads are
+# amortized over the much larger candidate plane read at that size.
+SCORE_VARIADIC_MAX = 128
+
+
+def score_rows(cands, src):
+    """Per-candidate masked popcount scores: uint32[K, S, W] x
+    uint32[S, W] -> int32[K, S] (fragment.go top :1089's per-candidate
+    intersection counts).  Small candidate sets (the serving norm) use
+    the one-pass variadic reduce — src streamed once per
+    VARIADIC_CHUNK candidates, 756 GB/s measured; very large sets fall
+    back to the broadcast form to keep compile time bounded."""
+    K = cands.shape[0]
+    if K > SCORE_VARIADIC_MAX:
+        return jnp.sum(_pc(jnp.bitwise_and(cands, src[None, :, :])), axis=-1)
+    ops_list = [_pc(cands[k] & src) for k in range(K)]
+    return jnp.stack(_sum_many(ops_list, (1,)), axis=0)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def count_tree(mesh, prog, specs, mask, *operands):
     """Count(tree): fused eval + popcount + psum -> replicated int32."""
@@ -174,8 +238,10 @@ def count_batch_tree(mesh, progs, specs, *operands):
 
     ``progs`` is a static tuple of (prog, i_mask) pairs — i_mask the
     operand index of that query's requested-shard mask (uint32[S, 1]).
-    The engine pads batches to power-of-two sizes by repeating the last
-    pair, which is compile-free (CSE) and bounds executable-cache keys."""
+    The engine pads batches to FIXED TIERS by re-lowering query 0 into
+    fresh slots (engine.BATCH_TIERS), so the compile key depends only
+    on (structure, tier) — never on the raw drain size (XLA CSEs the
+    duplicated pad entries)."""
 
     def body(*ops):
         outs = [
@@ -231,8 +297,9 @@ def topn_tree(mesh, prog, specs, mask, cand_mat, idxs, *operands):
     def body(m, cmat, ix, *ops):
         src = _filter(prog, m, ops)
         cands = jnp.take(cmat, ix, axis=0)
-        scores = jnp.sum(_pc(jnp.bitwise_and(cands, src[None, :, :])), axis=-1)
-        counts = jnp.sum(_pc(jnp.broadcast_to(src, cmat.shape[1:])), axis=-1)
+        srcb = jnp.broadcast_to(src, cmat.shape[1:])
+        scores = score_rows(cands, srcb)
+        counts = jnp.sum(_pc(srcb), axis=-1)
         # Replicated outputs (tiny int matrices): on a multi-process mesh
         # the caller's device_get only sees addressable shards, so
         # sharded outputs would silently drop remote shards.
@@ -280,7 +347,7 @@ def topn_full_tree(mesh, prog, specs, n_out, cand_idxs, mask, cand_mat, cnt, thr
             rest = ops
             cands = gather_rows(cmat, cand_idxs)
         src = _filter(prog, m, tuple(rest))
-        scores = jnp.sum(_pc(jnp.bitwise_and(cands, src[None, :, :])), axis=-1)
+        scores = score_rows(cands, jnp.broadcast_to(src, cands.shape[1:]))
         gate = jnp.logical_and(cn >= th, scores >= th)
         totals = jax.lax.psum(
             jnp.sum(jnp.where(gate, scores, 0), axis=1), SHARD_AXIS
@@ -337,8 +404,10 @@ def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
         f = _filter(prog, m, ops)
         p = gather_planes(pm, pspec)
         fb = jnp.broadcast_to(f, p.shape[1:])
-        fn = bsi_ops.min_valcount if is_min else bsi_ops.max_valcount
-        hi, lo, counts = jax.vmap(fn, in_axes=(1, 0))(p, fb)
+        # Direct ND call (no vmap): the variadic argmin-reduce keeps
+        # the shard axis as a batch axis and streams the planes ONCE
+        # (755 GB/s measured vs 380 for the 3-reduction form).
+        hi, lo, counts = bsi_ops.minmax_valcount_nd(p, fb, is_min)
         # Replicated (see topn_tree/replicate_shards): the host ValCount
         # reduce needs EVERY shard's value, including remote processes'.
         n_dev = mesh.shape[SHARD_AXIS]
@@ -371,11 +440,13 @@ def groupn_tree(mesh, prog, specs, idx_specs, mask, *operands):
     are the field stacks, then the traced index vectors for the None
     slots, then the filter-tree operands.
 
-    The [K1..Kn, S, W] intersection tensor is VIRTUAL: XLA fuses the
-    elementwise chain into the popcount-reduce, so the working set per
-    tile stays O(W), not O(prod(K) * W) — same fusion the 2-field
-    version relied on.  The engine caps prod(K) (MAX_GROUP_COMBOS) and
-    overflow falls back to the host iterator."""
+    Every combination count is one operand of a variadic popcount
+    reduce (_sum_many): XLA fuses the &-chains into the reduce loop and
+    each field plane streams from HBM exactly once, instead of the
+    virtual [K1..Kn, S, W] tensor's per-combination re-reads (measured
+    173 -> 751 GB/s on the 3-field bench shape).  The combination loop
+    is trace-time Python, so the engine caps prod(K)
+    (MAX_GROUP_COMBOS) and overflow falls back to the host iterator."""
     n = len(idx_specs)
 
     def body(m, *ops):
@@ -385,14 +456,21 @@ def groupn_tree(mesh, prog, specs, idx_specs, mask, *operands):
             spec if spec is not None else rest.pop(0) for spec in idx_specs
         ]
         f = _filter(prog, m, tuple(rest))
-        acc = jnp.bitwise_and(gather_rows(mats[0], idxs[0]), f[None, :, :])
-        for i in range(1, n):
-            g = gather_rows(mats[i], idxs[i])  # [Ki, S, W]
-            acc = jnp.bitwise_and(
-                acc[..., None, :, :],
-                g.reshape((1,) * i + g.shape),
-            )
-        return jax.lax.psum(jnp.sum(_pc(acc), axis=(-2, -1)), SHARD_AXIS)
+        rows = [gather_rows(mats[i], idxs[i]) for i in range(n)]  # [Ki, S, W]
+        dims = tuple(r.shape[0] for r in rows)
+        fb = jnp.broadcast_to(f, rows[0].shape[1:])
+
+        def build(i, acc):
+            if i == n:
+                return [_pc(acc)]
+            out = []
+            for k in range(dims[i]):
+                out.extend(build(i + 1, acc & rows[i][k]))
+            return out
+
+        ops_list = build(0, fb)
+        counts = jnp.stack(_sum_many(ops_list, (0, 1))).reshape(dims)
+        return jax.lax.psum(counts, SHARD_AXIS)
 
     return shard_map(
         body,

@@ -264,7 +264,9 @@ class Server:
         did = uuid.uuid4().hex
 
         def post(url, body):
-            self._make_client(url)._do(
+            self._make_client(
+                url, timeout=self.config.mesh_dispatch_timeout
+            )._do(
                 "POST", "/internal/mesh/dispatch", body,
                 content_type="application/json",
             )
@@ -354,15 +356,50 @@ class Server:
 
         cluster = self.cluster
 
+        # Membership events drain through a serialized worker (the
+        # reference's joiningLeavingNodes channel + listenForJoins
+        # goroutine, cluster.go:1095-1145): a join that triggers a
+        # resize JOB blocks until the job completes, and that must never
+        # stall the SWIM probe/ack loop the callbacks run on.
+        import queue as queue_mod
+
+        events: "queue_mod.Queue" = queue_mod.Queue()
+
+        def membership_worker():
+            while True:
+                item = events.get()
+                if item is None:
+                    return
+                kind, member = item
+                try:
+                    if kind == "join":
+                        cluster.add_node(
+                            Node(
+                                member.id,
+                                member.meta.get("uri"),
+                                member.meta.get("coordinator", False),
+                            )
+                        )
+                    else:
+                        cluster.node_failed(member.id)
+                except Exception as e:
+                    self.logger.printf(
+                        "membership %s for %s failed: %s", kind, member.id, e
+                    )
+
+        self._membership_events = events
+        t = threading.Thread(
+            target=membership_worker, daemon=True, name="membership"
+        )
+        t.start()
+        self._monitors.append(t)
+
         def on_join(member):
-            node_uri = member.meta.get("uri")
-            if node_uri:
-                cluster.add_node(
-                    Node(member.id, node_uri, member.meta.get("coordinator", False))
-                )
+            if member.meta.get("uri"):
+                events.put(("join", member))
 
         def on_leave(member):
-            cluster.node_failed(member.id)
+            events.put(("leave", member))
 
         def on_message(payload):
             # Gossip-delivered cluster messages (SendAsync receive path)
@@ -386,9 +423,34 @@ class Server:
             logger=self.logger,
         ).start()
         cluster.gossip_send_async = self.gossip.send_async
-        for seed in self.config.gossip_seeds:
-            h, _, p = seed.rpartition(":")
-            self.gossip.join((h or "127.0.0.1", int(p)))
+        if self.config.gossip_seeds:
+            # Seed joins RETRY in the background until another member is
+            # known: a one-shot join silently strands a node that boots
+            # before its seed (concurrent cluster bring-up — the normal
+            # case under an orchestrator).  The reference's memberlist
+            # Join is likewise driven until it reports contact
+            # (gossip/gossip.go joinWithRetry pattern).
+            def join_seeds():
+                deadline = time.monotonic() + 120.0
+                while (
+                    not self._closing.is_set()
+                    and time.monotonic() < deadline
+                ):
+                    for seed in self.config.gossip_seeds:
+                        h, _, p = seed.rpartition(":")
+                        try:
+                            self.gossip.join((h or "127.0.0.1", int(p)))
+                        except Exception as e:
+                            self.logger.debugf("seed join failed: %s", e)
+                    if len(self.gossip.members) > 1:
+                        return
+                    time.sleep(0.5)
+
+            t = threading.Thread(
+                target=join_seeds, daemon=True, name="gossip-join"
+            )
+            t.start()
+            self._monitors.append(t)
 
     @property
     def scheme(self) -> str:
@@ -511,6 +573,8 @@ class Server:
 
     def close(self):
         self._closing.set()
+        if getattr(self, "_membership_events", None) is not None:
+            self._membership_events.put(None)
         if getattr(self, "gossip", None) is not None:
             self.gossip.close()
         if self._http is not None:

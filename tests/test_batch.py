@@ -62,13 +62,11 @@ def _call(q):
 
 
 def _force_batch_mode(eng):
-    """Pin the micro-batcher to fused-batch mode: its RTT probe is
-    load-dependent (a busy CI host can cross the overlap threshold) and
-    these tests assert on fusing behavior, not on the policy pick."""
+    """Instantiate the batcher eagerly (batching is now the only mode —
+    the round-4 RTT-probe overlap escape hatch is gone)."""
     from pilosa_tpu.parallel.batcher import CountBatcher
 
     eng._batcher = CountBatcher(eng)
-    eng._batcher.overlap_mode = False
 
 
 def test_count_many_matches_singles(holder, mesh):
@@ -270,3 +268,62 @@ def test_count_batch_collective_replay(holder, mesh):
         api.mesh_collective_accept(
             dict(payload, queries=[], shardsList=[])
         )
+
+
+def test_count_many_missing_rows_uniform_program(holder, mesh):
+    """A row id that doesn't exist lowers to the SAME batch program as
+    one that does (presence is a -1 slot value, not structure): counts
+    are 0 for missing rows and the executable cache must not grow per
+    present/absent pattern (r5 review: compile-key stability)."""
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(8))
+    mixes = [
+        [_call("Row(f=10)"), _call("Row(f=999)")],
+        [_call("Row(f=999)"), _call("Row(f=10)")],
+        [_call("Row(f=999)"), _call("Row(f=998)")],
+    ]
+    want10 = eng.count("i", _call("Row(f=10)"), shards)
+    for calls in mixes:
+        got = eng.count_many("i", calls, [shards] * 2)
+        want = [want10 if "999" not in str(c) and "998" not in str(c) else 0
+                for c in calls]
+        assert got == want, (calls, got)
+
+
+def test_batcher_poisoned_batch_splits_fast(holder, mesh):
+    """One unlowerable query in a drain must fail ONLY its submitter;
+    the survivors re-dispatch as one batch (not a serial per-item
+    retry that would stall the worker)."""
+    import threading
+
+    eng = MeshEngine(holder, mesh)
+    _force_batch_mode(eng)
+    b = eng._batcher
+    shards = list(range(8))
+    good_calls = [_call(q) for q in QUERIES[:3]]
+    want = [eng.count("i", c, shards) for c in good_calls]
+    bad = _call("Row(nosuchfield=1)")
+
+    results = {}
+    errors = {}
+
+    def submit(tag, call):
+        try:
+            results[tag] = b.submit("i", call, shards)
+        except Exception as e:  # noqa: BLE001
+            errors[tag] = e
+
+    # Occupy the direct path so everything else queues into ONE drain.
+    blocker = threading.Thread(target=submit, args=("b0", good_calls[0]))
+    blocker.start()
+    threads = [
+        threading.Thread(target=submit, args=(f"g{i}", c))
+        for i, c in enumerate(good_calls)
+    ] + [threading.Thread(target=submit, args=("bad", bad))]
+    for t in threads:
+        t.start()
+    for t in threads + [blocker]:
+        t.join(timeout=60)
+    assert "bad" in errors, "unlowerable query did not error"
+    for i in range(3):
+        assert results.get(f"g{i}") == want[i], (i, results, errors)

@@ -171,11 +171,13 @@ def test_groupby_errors(groupby_env):
     h, ex = groupby_env
     with pytest.raises(Error, match="child"):
         ex.execute("i", "GroupBy()")
-    # Unknown field: per-shard nil fragment -> empty result, NO error —
-    # matching newGroupByIterator (executor.go:2743-2747; the Go test at
-    # executor_test.go:2828 only type-checks the error IF one occurs).
-    (res,) = ex.execute("i", "GroupBy(Rows(field=missing))").results
-    assert res == []
+    # Unknown field: ErrFieldNotFound up front (executor_test.go:2828
+    # accepts either no-error or exactly ErrFieldNotFound; the explicit
+    # error is the stricter conformant behavior and what a user wants).
+    from pilosa_tpu.executor.executor import FieldNotFoundError
+
+    with pytest.raises(FieldNotFoundError):
+        ex.execute("i", "GroupBy(Rows(field=missing))")
     with pytest.raises(Error, match="Rows"):
         ex.execute("i", "GroupBy(Row(wa=0))")
 

@@ -7,6 +7,7 @@ from pilosa_tpu import pql
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.executor import ValCount
 from pilosa_tpu.ops import SHARD_WIDTH
 from pilosa_tpu.parallel import MeshEngine, make_mesh, pad_shards
 
@@ -296,6 +297,77 @@ def test_executor_mesh_min_max(holder, mesh):
         "Max(Row(f=10), field=v)",
     ]:
         assert fused.execute("i", q).results == plain.execute("i", q).results, q
+
+
+def test_executor_mesh_min_max_deep_bsi(holder, mesh):
+    """bit_depth > 31 exercises the (hi, lo) split of the variadic
+    argmin/argmax reduce: values straddling the 31-bit boundary, ties
+    on both sides, and a filter that empties the considered set."""
+    idx = holder.create_index("i")
+    v = idx.create_field(
+        "big", FieldOptions(type="int", min=0, max=(1 << 40))
+    )
+    f = idx.create_field("f")
+    vals = {
+        1: (1 << 39) + 7,
+        2: 5,
+        3: (1 << 39) + 7,  # tie with col 1 (hi side)
+        4: 5,               # tie with col 2 (lo side)
+        5: (1 << 35) + 123,
+        SHARD_WIDTH + 1: 5,  # cross-shard tie with cols 2/4 at the min
+        2 * SHARD_WIDTH + 9: (1 << 40) - 1,
+    }
+    v.import_values(list(vals), [vals[c] for c in vals])
+    f.import_bulk([10] * 3, [1, 3, 5])
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    for q in [
+        "Min(field=big)",
+        "Max(field=big)",
+        "Min(Row(f=10), field=big)",
+        "Max(Row(f=10), field=big)",
+        "Min(Row(f=99), field=big)",  # empty filter: count 0
+    ]:
+        got = fused.execute("i", q).results
+        want = plain.execute("i", q).results
+        assert got == want, (q, got, want)
+    # Reference parity on cross-shard ties: ValCount.smaller keeps the
+    # FIRST shard's count (executor.go:2676 — other only wins on
+    # strictly-smaller val), so the shard-1 tie column is not added:
+    # count is shard 0's 2, not 3.
+    assert fused.execute("i", "Min(field=big)").results[0] == ValCount(5, 2)
+    assert (
+        fused.execute("i", "Max(field=big)").results[0].val
+        == (1 << 40) - 1
+    )
+    # hi-side tie: cols 1 and 3 share (1<<39)+7, the max among Row(f=10).
+    vc = fused.execute("i", "Max(Row(f=10), field=big)").results[0]
+    assert (vc.val, vc.count) == ((1 << 39) + 7, 2)
+
+
+def test_fused_topn_many_candidates_chunking(holder, mesh):
+    """> VARIADIC_CHUNK candidate rows: the variadic scoring reduce
+    chunks (kernels.VARIADIC_CHUNK) and results stay exact."""
+    from pilosa_tpu.parallel import kernels as k_mod
+
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    src = idx.create_field("s")
+    n_rows = k_mod.VARIADIC_CHUNK + 9
+    rows, cols = [], []
+    rng = np.random.default_rng(3)
+    for r in range(n_rows):
+        for c in rng.choice(2 * SHARD_WIDTH, size=5 + (r % 7), replace=False):
+            rows.append(r)
+            cols.append(int(c))
+    f.import_bulk(rows, cols)
+    src.import_bulk([0] * (SHARD_WIDTH // 256), list(range(0, SHARD_WIDTH, 256)))
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    for q in [f"TopN(f, n={n_rows})", "TopN(f, Row(s=0), n=20)"]:
+        got = fused.execute("i", q).results
+        want = plain.execute("i", q).results
+        assert got == want, (q, got, want)
 
 
 def test_fused_topn_ties_thresholds(holder, mesh):
