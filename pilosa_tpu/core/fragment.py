@@ -102,6 +102,7 @@ class Fragment:
 
         self.op_n = 0
         self._op_file = None
+        self._closed = False
         # Coarse per-fragment lock: the stand-in for the reference's
         # per-fragment RWMutex (fragment.go:88); serializes host-truth
         # mutation, snapshot, and device-mirror sync under the threaded
@@ -204,6 +205,7 @@ class Fragment:
     def snapshot(self):
         """Compact: write a fresh roaring snapshot, truncate the op-log
         (atomic temp-file + rename, fragment.go:1737-1776)."""
+        self._check_open()
         self._store.compact()
         if self.path is None:
             self.op_n = 0
@@ -239,13 +241,32 @@ class Fragment:
             self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
         self.cache.invalidate()
 
+    @_locked
     def close(self):
+        """Locked, and marks the fragment CLOSED: a write racing close
+        must either complete durably (it held the lock first) or RAISE —
+        round 5's restart-under-write-load test caught writes that were
+        acked after the op file was gone and silently lost on replay."""
+        self._closed = True
         self.flush_cache()
         if self._op_file is not None:
             self._op_file.close()
             self._op_file = None
 
+    def _check_open(self):
+        """Every mutation path calls this first: a write racing close()
+        must RAISE, never ack — the single-bit path persists via the
+        op-log (_append_op) but the bulk paths persist via snapshot(),
+        which would otherwise run os.replace on — and reopen — a file a
+        successor Fragment instance may already own."""
+        if self._closed:
+            raise RuntimeError(
+                f"fragment {self.index}/{self.field}/{self.view}/"
+                f"{self.shard} is closed"
+            )
+
     def _append_op(self, typ: int, pos: int):
+        self._check_open()
         if self._op_file is not None:
             self._op_file.write(codec.encode_op(typ, pos))
             self.op_n += 1
@@ -335,6 +356,7 @@ class Fragment:
 
     @_locked
     def set_bit(self, row_id: int, column_id: int) -> bool:
+        self._check_open()
         if self.mutex:
             self._handle_mutex(row_id, column_id)
         return self._set_bit(row_id, column_id)
@@ -377,6 +399,7 @@ class Fragment:
 
     @_locked
     def clear_bit(self, row_id: int, column_id: int) -> bool:
+        self._check_open()
         return self._clear_bit(row_id, column_id)
 
     def _clear_bit(self, row_id: int, column_id: int) -> bool:
@@ -483,6 +506,7 @@ class Fragment:
     @_locked
     def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
         """Write a BSI value + not-null bit (fragment.go:634-689)."""
+        self._check_open()
         changed = False
         for i in range(bit_depth):
             if (value >> i) & 1:
@@ -518,6 +542,7 @@ class Fragment:
         Mutex fragments go through a vectorized clear-previous-owner pass
         (bulkImportMutex :1538) driven by the occupancy vector; a CLEAR
         import bypasses it (fragment.go:1451 `!options.Clear`)."""
+        self._check_open()
         row_ids = np.asarray(list(row_ids), dtype=np.int64)
         column_ids = np.asarray(list(column_ids), dtype=np.int64)
         if row_ids.size == 0:
@@ -614,6 +639,7 @@ class Fragment:
         columns (fragment.go importSetValue :669 clear branch) — the
         value planes are still written per the given bits, matching the
         reference exactly."""
+        self._check_open()
         cols = np.asarray(list(column_ids), dtype=np.int64)
         vals = np.asarray(list(values), dtype=np.int64)
         if cols.size == 0:
@@ -659,6 +685,7 @@ class Fragment:
         """Union (or with ``clear``, subtract) a serialized roaring bitmap
         straight into storage — the fast ingest path
         (fragment.go importRoaring :1659; ImportRoaringRequest.Clear)."""
+        self._check_open()
         dec = codec.deserialize(data)
         before = sum(self._store.counts.values())
         if clear:
@@ -694,6 +721,7 @@ class Fragment:
     def clear_row(self, row_id: int) -> bool:
         """Remove every bit in a row, snapshot (fragment.go clearRow :551,
         unprotectedClearRow)."""
+        self._check_open()
         if self._mutex_owners is not None:
             self._mutex_owners[
                 self._store.positions(row_id).astype(np.int64)
@@ -708,6 +736,7 @@ class Fragment:
     def set_row(self, row, row_id: int) -> bool:
         """Overwrite a row with a Row's segment for this shard, snapshot
         (fragment.go setRow :501 — Store()/SetRow support)."""
+        self._check_open()
         seg = row.segment(self.shard) if row is not None else None
         new = (
             np.zeros(WORDS64, dtype=np.uint64)
@@ -902,6 +931,7 @@ class Fragment:
         (row, col) pair — ties resolve to set (fragment.go mergeBlock
         :1323-1442).  Applies the local diff and returns per-peer
         (sets, clears) diff lists to push back to each peer."""
+        self._check_open()
         local_rows, local_cols = self.block_data(block)
         copies = [set(zip(local_rows.tolist(), local_cols.tolist()))]
         copies += [set(zip(pr.tolist(), pc.tolist())) for pr, pc in peer_pairs]

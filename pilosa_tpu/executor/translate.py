@@ -82,11 +82,10 @@ class QueryTranslator:
                 v = c.args.get(row_key)
                 if v is not None:
                     if not isinstance(v, bool):
-                        # `b=1` / `b=0` literals are also accepted.
-                        if v in (0, 1):
-                            v = bool(v)
-                        else:
-                            raise TranslateError("bool field rows must be true/false")
+                        # Strings and integers are invalid bool rows —
+                        # executor_test.go:713-726 expects an error for
+                        # both `f="true"` and `f=1`.
+                        raise TranslateError("bool field rows must be true/false")
                     c.args[row_key] = TRUE_ROW_ID if v else FALSE_ROW_ID
             elif field.options.keys:
                 v = c.args.get(row_key)
