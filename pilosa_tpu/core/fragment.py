@@ -52,6 +52,24 @@ FALSE_ROW_ID = 0
 TRUE_ROW_ID = 1
 
 
+class _WriteSeq:
+    """Process-global write sequence, bumped on every fragment mutation
+    (_touch).  Read-your-writes for singleflight request collapsing: a
+    flight key includes the value at key time, so a caller whose own
+    completed write bumped it never joins a flight computed before that
+    write.
+    Racy increments may coalesce, but any write CHANGES the value, which
+    is the only property the keys need."""
+
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0
+
+
+WRITE_SEQ = _WriteSeq()
+
+
 def _locked(fn):
     """Run under the fragment mutex (fragment.go:88 RWMutex discipline)."""
     import functools
@@ -315,6 +333,7 @@ class Fragment:
                 self._word_floor[row_id] = v
                 self._word_log.pop(row_id, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        WRITE_SEQ.v += 1
         if self._on_touch is not None:
             self._on_touch()
 
@@ -518,6 +537,7 @@ class Fragment:
 
     @_locked
     def clear_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        self._check_open()
         changed = False
         for i in range(bit_depth):
             if (value >> i) & 1:
@@ -673,6 +693,7 @@ class Fragment:
         """Install a dense row wholesale — the zero-copy load path for
         benchmarks/restore (no op-log, no snapshot; caller invalidates the
         rank cache once after the batch)."""
+        self._check_open()
         n = self._store.set_dense(
             row_id, np.ascontiguousarray(words_u64, dtype=np.uint64)
         )

@@ -29,6 +29,7 @@ from ..parallel.errors import PeerlessMeshError
 from ..core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_SET, FIELD_TYPE_TIME
 from ..core.fragment import SHARD_WIDTH
 from ..core import cache as cache_mod
+from ..core import fragment as frag_mod
 from ..core import timequantum
 from ..core.row import Row
 from ..core.view import VIEW_STANDARD, view_bsi_name
@@ -339,6 +340,13 @@ class Executor:
         # index -> (shard_epoch, default shard list): available_shards()
         # walks every field's bitmap, too slow for the O(1) lane.
         self._fast_shards: Dict[str, Tuple[int, List[int]]] = {}
+        # Identical concurrent aggregate queries collapse into ONE fused
+        # dispatch (parallel/singleflight.py): readback round trips
+        # serialize in the transport, so N clients asking the same
+        # TopN/Sum simultaneously must not burn N slots for one answer.
+        from ..parallel.singleflight import SingleFlight
+
+        self._sflight = SingleFlight()
 
     _PARSE_CACHE_MAX = 512
 
@@ -1135,7 +1143,12 @@ class Executor:
             return None
         filter_call = c.children[0] if c.children else None
         try:
-            total, n = self.mesh_engine.sum(index, field_name, filter_call, local)
+            total, n = self._sflight.do(
+                ("sum", frag_mod.WRITE_SEQ.v, index, str(c), tuple(local)),
+                lambda: self.mesh_engine.sum(
+                    index, field_name, filter_call, local
+                ),
+            )
         except (ValueError, PeerlessMeshError):
             return None
         return set(local), ValCount(total, n)
@@ -1190,8 +1203,11 @@ class Executor:
             return None
         filter_call = c.children[0] if c.children else None
         try:
-            val, n = self.mesh_engine.min_max(
-                index, field_name, filter_call, local, is_min
+            val, n = self._sflight.do(
+                ("minmax", frag_mod.WRITE_SEQ.v, is_min, index, str(c), tuple(local)),
+                lambda: self.mesh_engine.min_max(
+                    index, field_name, filter_call, local, is_min
+                ),
             )
         except (ValueError, PeerlessMeshError):
             return None
@@ -1259,15 +1275,20 @@ class Executor:
                 return self.mesh_engine.topn_cache_only(
                     index, field_name, shards, n, min_threshold, row_ids or None
                 )
-            return self.mesh_engine.topn_full(
-                index,
-                field_name,
-                c.children[0],
-                shards,
-                n,
-                min_threshold,
-                row_ids or None,
+            out = self._sflight.do(
+                ("topn", frag_mod.WRITE_SEQ.v, index, str(c), tuple(sorted(local))),
+                lambda: self.mesh_engine.topn_full(
+                    index,
+                    field_name,
+                    c.children[0],
+                    shards,
+                    n,
+                    min_threshold,
+                    row_ids or None,
+                ),
             )
+            # Copy: waiters share the flight's list and callers may trim.
+            return list(out) if isinstance(out, list) else out
         except (ValueError, PeerlessMeshError):
             return None
 
@@ -1549,8 +1570,11 @@ class Executor:
         if any(not rows for rows in row_lists):
             return set(shards), []
         try:
-            counts = self.mesh_engine.group_counts(
-                index, fields, row_lists, filter_call, shards
+            counts = self._sflight.do(
+                ("groupby", frag_mod.WRITE_SEQ.v, index, str(c), tuple(sorted(shards)), tuple(map(tuple, row_lists))),
+                lambda: self.mesh_engine.group_counts(
+                    index, fields, row_lists, filter_call, shards
+                ),
             )
         except (ValueError, PeerlessMeshError):
             return None

@@ -327,3 +327,49 @@ def test_batcher_poisoned_batch_splits_fast(holder, mesh):
     assert "bad" in errors, "unlowerable query did not error"
     for i in range(3):
         assert results.get(f"g{i}") == want[i], (i, results, errors)
+
+
+def test_singleflight_collapses_identical_aggregates(holder, mesh):
+    """N concurrent identical Sum/TopN queries produce ONE fused
+    dispatch per burst (request collapsing): correct answers for every
+    caller, engine dispatch count stays ~constant, and results are not
+    cached across bursts (a write between bursts is visible)."""
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder, mesh_engine=eng)
+    want_sum = ex.execute("i", "Sum(field=v)").results[0]
+    want_top = ex.execute("i", "TopN(f, Row(f=11), n=2)").results[0]
+
+    results, errs = [], []
+
+    def worker(q, exp):
+        try:
+            got = ex.execute("i", q).results[0]
+            results.append(got == exp)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    before = eng.fused_dispatches
+    threads = [
+        threading.Thread(target=worker, args=("Sum(field=v)", want_sum))
+        for _ in range(12)
+    ] + [
+        threading.Thread(
+            target=worker, args=("TopN(f, Row(f=11), n=2)", want_top)
+        )
+        for _ in range(12)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs and all(results), (errs, results)
+    assert ex._sflight.shared > 0, "no requests were collapsed"
+    # Far fewer dispatches than callers (leaders only; bursts may split).
+    assert eng.fused_dispatches - before < 24
+
+    # NOT a cache: a write invalidates the next burst's answer.
+    ex.execute("i", "Set(123, f=11)")
+    c1 = ex.execute("i", "Count(Row(f=11))").results[0]
+    ex.execute("i", "Set(124, f=11)")
+    c2 = ex.execute("i", "Count(Row(f=11))").results[0]
+    assert c2 == c1 + 1
