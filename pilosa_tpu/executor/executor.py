@@ -1138,13 +1138,18 @@ class Executor:
         field_name = c.args.get("field")
         if not field_name or len(c.children) > 1:
             return None
+        # Key the flight on the write sequence AS OF NOW — before any
+        # derived state (shard lists, row sets) is computed — so a
+        # leader that computed stale derivations keys as pre-write and
+        # can never share with a post-write waiter.
+        seq = frag_mod.WRITE_SEQ.v
         local = self._local_shards(index, shards, opt.remote)
         if not local:
             return None
         filter_call = c.children[0] if c.children else None
         try:
             total, n = self._sflight.do(
-                ("sum", frag_mod.WRITE_SEQ.v, index, str(c), tuple(local)),
+                ("sum", seq, index, str(c), tuple(local)),
                 lambda: self.mesh_engine.sum(
                     index, field_name, filter_call, local
                 ),
@@ -1198,13 +1203,14 @@ class Executor:
         field_name = c.args.get("field")
         if not field_name or len(c.children) > 1:
             return None
+        seq = frag_mod.WRITE_SEQ.v  # before derived state (see _mesh_sum)
         local = self._local_shards(index, shards, opt.remote)
         if not local:
             return None
         filter_call = c.children[0] if c.children else None
         try:
             val, n = self._sflight.do(
-                ("minmax", frag_mod.WRITE_SEQ.v, is_min, index, str(c), tuple(local)),
+                ("minmax", seq, is_min, index, str(c), tuple(local)),
                 lambda: self.mesh_engine.min_max(
                     index, field_name, filter_call, local, is_min
                 ),
@@ -1261,6 +1267,7 @@ class Executor:
             return None
         if len(c.children) > 1:
             raise Error("TopN() can only have one input bitmap")
+        seq = frag_mod.WRITE_SEQ.v  # before derived state (see _mesh_sum)
         local = set(self._local_shards(index, shards, opt.remote))
         if any(s not in local for s in shards):
             return None
@@ -1276,7 +1283,7 @@ class Executor:
                     index, field_name, shards, n, min_threshold, row_ids or None
                 )
             out = self._sflight.do(
-                ("topn", frag_mod.WRITE_SEQ.v, index, str(c), tuple(sorted(local))),
+                ("topn", seq, index, str(c), tuple(sorted(local))),
                 lambda: self.mesh_engine.topn_full(
                     index,
                     field_name,
@@ -1555,6 +1562,8 @@ class Executor:
             extra = set(child.args) - {"field"}
             if child.name != "Rows" or extra:
                 return None
+        seq = frag_mod.WRITE_SEQ.v  # BEFORE row_lists: a leader with
+        # stale row sets must key as pre-write (see _mesh_sum)
         shards = self._local_shards(index, shards, opt.remote)
         if not shards:
             return None
@@ -1571,7 +1580,10 @@ class Executor:
             return set(shards), []
         try:
             counts = self._sflight.do(
-                ("groupby", frag_mod.WRITE_SEQ.v, index, str(c), tuple(sorted(shards)), tuple(map(tuple, row_lists))),
+                # row_lists are DERIVED from fragment state already
+                # versioned by WRITE_SEQ, so they need not (and must
+                # not — O(total rows) hashing per query) join the key.
+                ("groupby", seq, index, str(c), tuple(sorted(shards))),
                 lambda: self.mesh_engine.group_counts(
                     index, fields, row_lists, filter_call, shards
                 ),

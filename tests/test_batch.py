@@ -349,12 +349,20 @@ def test_singleflight_collapses_identical_aggregates(holder, mesh):
             errs.append(e)
 
     before = eng.fused_dispatches
+    # Barrier: all workers release together so flight overlap is
+    # deterministic, not a thread-spawn race.
+    barrier = threading.Barrier(24)
+
+    def gated(q, exp):
+        barrier.wait(30)
+        worker(q, exp)
+
     threads = [
-        threading.Thread(target=worker, args=("Sum(field=v)", want_sum))
+        threading.Thread(target=gated, args=("Sum(field=v)", want_sum))
         for _ in range(12)
     ] + [
         threading.Thread(
-            target=worker, args=("TopN(f, Row(f=11), n=2)", want_top)
+            target=gated, args=("TopN(f, Row(f=11), n=2)", want_top)
         )
         for _ in range(12)
     ]
@@ -367,9 +375,10 @@ def test_singleflight_collapses_identical_aggregates(holder, mesh):
     # Far fewer dispatches than callers (leaders only; bursts may split).
     assert eng.fused_dispatches - before < 24
 
-    # NOT a cache: a write invalidates the next burst's answer.
-    ex.execute("i", "Set(123, f=11)")
-    c1 = ex.execute("i", "Count(Row(f=11))").results[0]
-    ex.execute("i", "Set(124, f=11)")
-    c2 = ex.execute("i", "Count(Row(f=11))").results[0]
-    assert c2 == c1 + 1
+    # NOT a cache: a write bumps WRITE_SEQ, so the next SUM (a
+    # singleflighted path) reflects it instead of joining a stale
+    # flight's key space.
+    s1 = ex.execute("i", "Sum(field=v)").results[0]
+    ex.execute("i", "Set(123, v=9)")
+    s2 = ex.execute("i", "Sum(field=v)").results[0]
+    assert (s2.val, s2.count) == (s1.val + 9, s1.count + 1)

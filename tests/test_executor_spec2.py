@@ -603,3 +603,87 @@ def test_http_query_args_parity(tmp_path):
         assert out["results"][0]["attrs"] == {"team": "red"}
     finally:
         srv.shutdown()
+
+
+# -- 2-node keyed import + translate replication (api_test.go :28-157) -----
+
+
+def test_keyed_import_two_nodes(tmp_path):
+    """Keyed imports land via the coordinator (the translate PRIMARY);
+    a follower configured with translation-primary-url replicates the
+    key log and serves keyed queries with identical translations on
+    both nodes (TestAPI_Import RowIDColumnKey, scaled to our
+    primary/replica translate design)."""
+    import time as time_mod
+
+    from pilosa_tpu.cluster import Cluster, Node
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    cfg0 = Config()
+    cfg0.data_dir = str(tmp_path / "n0")
+    cfg0.bind = "localhost:0"
+    s0 = Server(cfg0)
+    s0.node_id = "n0"
+    s0.open(port_override=0)
+
+    cfg1 = Config()
+    cfg1.data_dir = str(tmp_path / "n1")
+    cfg1.bind = "localhost:0"
+    cfg1.translation_primary_url = f"http://localhost:{s0.port}"
+    s1 = Server(cfg1)
+    s1.node_id = "n1"
+    s1.open(port_override=0)
+
+    nodes = [
+        Node("n0", f"http://localhost:{s0.port}", is_coordinator=True),
+        Node("n1", f"http://localhost:{s1.port}"),
+    ]
+    for i, srv in enumerate((s0, s1)):
+        cl = Cluster(node=nodes[i], replica_n=1, path=srv.data_dir)
+        cl.nodes = list(nodes)
+        cl.holder = srv.holder
+        cl.state = "NORMAL"
+        srv.cluster = cl
+        srv.api.attach_cluster(cl, nodes[i])
+
+    from pilosa_tpu.net import InternalClient
+
+    c0 = InternalClient(f"http://localhost:{s0.port}")
+    c1 = InternalClient(f"http://localhost:{s1.port}")
+    try:
+        c0.create_index("rick", keys=True)
+        c0.create_field("rick", "f", {"type": "set", "keys": False})
+        col_keys = [f"col{i}" for i in range(1, 11)]
+        c0.import_keyed_bits("rick", "f", [], [])  # no-op accepted
+        # rowIDs with column KEYS (the RowIDColumnKey case).
+        import json as json_mod
+        import urllib.request
+
+        body = json_mod.dumps(
+            {"rowIDs": [1] * len(col_keys), "columnKeys": col_keys}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://localhost:{s0.port}/index/rick/field/f/import",
+            data=body, method="POST",
+        )
+        req.add_header("Content-Type", "application/json")
+        urllib.request.urlopen(req, timeout=30).read()
+
+        out = c0.query("rick", "Row(f=1)")
+        assert out["results"][0]["keys"] == col_keys
+        # The follower replicates the key log (1 s poll) and answers
+        # with the SAME translations.
+        deadline = time_mod.monotonic() + 15
+        while time_mod.monotonic() < deadline:
+            out = c1.query("rick", "Row(f=1)")
+            if out["results"][0].get("keys") == col_keys:
+                break
+            time_mod.sleep(0.3)
+        else:
+            import pytest as _pytest
+
+            _pytest.fail(f"follower never converged: {out}")
+    finally:
+        s0.close()
+        s1.close()
