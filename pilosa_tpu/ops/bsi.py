@@ -137,6 +137,9 @@ def sum_counts(planes, filter_row):
     """
     depth = planes.shape[0] - 1
     consider = planes[depth] & filter_row
+    if depth == 0:
+        # max == min: no value planes; the total is count * base.
+        return jnp.zeros(0, jnp.int32), bitops.popcount(consider)
     counts = jnp.stack(
         [bitops.popcount_and(planes[i], consider) for i in range(depth)]
     )

@@ -378,10 +378,22 @@ def sum_tree(mesh, prog, specs, pspec, mask, plane_mat, *operands):
         f = _filter(prog, m, ops)
         p = gather_planes(pm, pspec)
         consider = jnp.bitwise_and(p[-1], f)
-        masked = jnp.bitwise_and(p[:-1], consider[None, :, :])
+        # ONE variadic reduce over D+1 popcount operands: the not-null
+        # plane (inside ``consider``) loads once per element and is
+        # reused across every masked plane instead of re-read per plane
+        # (the 553 GB/s vs 755 gap of the two-reduction form).
+        depth = p.shape[0] - 1
+        ops_list = [_pc(p[i] & consider) for i in range(depth)]
+        ops_list.append(_pc(consider))
+        outs = _sum_many(ops_list, (0, 1))
+        # depth 0 (a BSI group with max == min): no value planes, the
+        # total is count * base — jnp.stack([]) would raise.
+        counts = (
+            jnp.stack(outs[:depth]) if depth else jnp.zeros(0, jnp.int32)
+        )
         return (
-            jax.lax.psum(jnp.sum(_pc(masked), axis=(1, 2)), SHARD_AXIS),
-            jax.lax.psum(jnp.sum(_pc(consider)), SHARD_AXIS),
+            jax.lax.psum(counts, SHARD_AXIS),
+            jax.lax.psum(outs[depth], SHARD_AXIS),
         )
 
     return shard_map(

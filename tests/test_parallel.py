@@ -634,3 +634,17 @@ def test_put_global_pins_row_major_layout(mesh):
     if fmt is None or fmt.layout is None:
         pytest.skip("jax without Format introspection")
     assert tuple(fmt.layout.major_to_minor) == (0, 1, 2)
+
+
+def test_sum_zero_bit_depth(holder, mesh):
+    """A BSI group with max == min has bit_depth 0 (no value planes):
+    Sum is count * base and must not crash the fused kernel
+    (r5 review: jnp.stack of zero planes)."""
+    idx = holder.create_index("i")
+    v = idx.create_field("k", FieldOptions(type="int", min=7, max=7))
+    v.import_values([1, 2, SHARD_WIDTH + 3], [7, 7, 7])
+    plain = Executor(holder)
+    fused = Executor(holder, mesh_engine=MeshEngine(holder, mesh))
+    want = plain.execute("i", "Sum(field=k)").results
+    got = fused.execute("i", "Sum(field=k)").results
+    assert got == want == [ValCount(21, 3)]
