@@ -382,3 +382,43 @@ def test_singleflight_collapses_identical_aggregates(holder, mesh):
     ex.execute("i", "Set(123, v=9)")
     s2 = ex.execute("i", "Sum(field=v)").results[0]
     assert (s2.val, s2.count) == (s1.val + 9, s1.count + 1)
+
+
+def test_batch_tier_compile_key_stability(holder, mesh):
+    """THE round-5 serving guarantee: batched count programs compile per
+    (structure, tier), never per drain size — distinct batch sizes
+    within one tier reuse one executable (round 4 compiled a fresh ~2 s
+    program per distinct size, the entire QPS shortfall).  Pinned via
+    the jit executable-cache size."""
+    from pilosa_tpu.parallel import kernels as k_mod
+
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(8))
+    c = _call("Intersect(Row(f=10), Row(f=11))")
+    base = eng.count("i", c, shards)
+
+    def run(k):
+        got = eng.count_many("i", [c] * k, [shards] * k)
+        assert got == [base] * k
+
+    run(9)  # tier 64: compiles once
+    size_after_first = k_mod.count_batch_tree._cache_size()
+    for k in (10, 17, 23, 41, 64):  # all tier 64, different raw sizes
+        run(k)
+    assert k_mod.count_batch_tree._cache_size() == size_after_first, (
+        "a drain size within the tier compiled a new executable"
+    )
+    # Different ROW IDS in the same structure also reuse it (ids are
+    # slot-vector data), including MISSING rows (presence is data too).
+    mixed = [
+        _call("Intersect(Row(f=10), Row(f=999))"),
+        _call("Intersect(Row(f=998), Row(f=11))"),
+    ]
+    got = eng.count_many("i", mixed * 6, [shards] * 12)
+    assert got == [0] * 12
+    assert k_mod.count_batch_tree._cache_size() == size_after_first
+    # A new TIER adds at most one executable (zero when an earlier test
+    # in this process already compiled this structure at tier 8 — the
+    # cache is process-global, which is itself the point).
+    run(2)  # tier 8
+    assert k_mod.count_batch_tree._cache_size() <= size_after_first + 1
