@@ -384,3 +384,101 @@ def test_remote_available_shards_persist(tmp_path):
     h3 = Holder(path=h.path)
     h3.open()
     assert list(h3.index("i").field("f").remote_available_shards) == [9]
+
+
+def test_no_standard_view_time_field():
+    """field.go OptFieldTypeTime(..., noStandardView=true): timestamped
+    imports fan ONLY to time views — the standard view is never
+    created, Row() answers empty, and time Ranges still work
+    (index_test.go TimeQuantumNoStandardView)."""
+    f = Field(
+        "i", "t",
+        FieldOptions(
+            type=FIELD_TYPE_TIME, time_quantum="YMD", no_standard_view=True
+        ),
+    )
+    ts = [dt.datetime(2018, 8, 1, 12, 30), dt.datetime(2018, 8, 2, 12, 30)]
+    f.import_bulk([1, 1], [10, 20], ts)
+    assert "standard" not in f.views
+    assert "standard_20180801" in f.views
+    assert f.row(1).columns().tolist() == []  # no standard view
+    # The time views still answer row_time / range queries.
+    got = f.row_time(1, ts[0], "D").columns().tolist()
+    assert got == [10]
+    # Options survive a to_dict/from_dict round trip.
+    opts = FieldOptions.from_dict(f.options.to_dict())
+    assert opts.no_standard_view is True
+
+
+def test_field_name_validation_matrix():
+    """field.go TestField_NameValidation: the exact valid/invalid name
+    sets (lowercase start, [a-z0-9_-]*, <= 64 chars)."""
+    from pilosa_tpu.core.index import validate_name
+
+    valid = ["foo", "hyphen-ated", "under_score", "abc123", "trailing_"]
+    invalid = [
+        "",
+        "123abc",
+        "x.y",
+        "_foo",
+        "-bar",
+        "abc def",
+        "camelCase",
+        "UPPERCASE",
+        "a" + "1234567890" * 6 + "12345",  # 65 chars
+    ]
+    for name in valid:
+        validate_name(name)  # must not raise
+    for name in invalid:
+        with pytest.raises(ValueError):
+            validate_name(name)
+
+
+def test_field_options_validation_matrix():
+    """field.go applyOptions :477-553: bad type / cache type / BSI
+    range / time quantum are rejected at create time."""
+    for opts in [
+        FieldOptions(type="nope"),
+        FieldOptions(cache_type="warm"),
+        FieldOptions(type=FIELD_TYPE_INT, min=20, max=10),
+        FieldOptions(type=FIELD_TYPE_TIME, time_quantum="XQ"),
+    ]:
+        with pytest.raises(ValueError):
+            opts.validate()
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    with pytest.raises(ValueError):
+        idx.create_field("bad", FieldOptions(type=FIELD_TYPE_INT, min=9, max=2))
+
+
+def test_corrupt_field_options_raise_on_open(tmp_path):
+    """holder_test.go ErrFieldOptionsCorrupt: torn field meta fails the
+    holder open loudly rather than silently dropping the field."""
+    import json as json_mod
+    import os
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("f").set_bit(1, 2)
+    h.close()
+
+    # Find and corrupt the field's meta file.
+    meta = None
+    for root, _dirs, files in os.walk(str(tmp_path / "d")):
+        for fn in files:
+            p = os.path.join(root, fn)
+            if fn.startswith(".meta") and "/i/" in p.replace(os.sep, "/"):
+                try:
+                    doc = json_mod.load(open(p))
+                except Exception:
+                    continue
+                if "type" in doc or "options" in doc or "cacheType" in doc:
+                    meta = p
+    assert meta, "field meta file not found"
+    with open(meta, "w") as fh:
+        fh.write("{torn")
+    h2 = Holder(str(tmp_path / "d"))
+    with pytest.raises(Exception):
+        h2.open()
