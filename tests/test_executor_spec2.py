@@ -687,3 +687,133 @@ def test_keyed_import_two_nodes(tmp_path):
     finally:
         s0.close()
         s1.close()
+
+
+# -- concurrent imports into one fragment (fragment_internal_test.go
+#    concurrent import benchmarks, behavior-checked) ------------------------
+
+
+def test_concurrent_bulk_imports_one_fragment():
+    """N writer threads bulk-import disjoint row/column slices into the
+    SAME fragment concurrently (the threaded HTTP server's reality);
+    final counts must equal the single-writer oracle exactly."""
+    import threading
+
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    n_writers, per = 6, 300
+    rng = np.random.default_rng(17)
+    slices = []
+    for w in range(n_writers):
+        cols = rng.choice(SHARD_WIDTH, size=per, replace=False)
+        slices.append([(w, int(c)) for c in cols])
+
+    errs = []
+
+    def writer(w):
+        try:
+            rows = [r for r, _ in slices[w]]
+            cols = [c for _, c in slices[w]]
+            f.import_bulk(rows, cols)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(w,)) for w in range(n_writers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "writer deadlocked"
+    assert not errs
+    ex = Executor(h)
+    for w in range(n_writers):
+        (cnt,) = ex.execute("i", f"Count(Row(f={w}))").results
+        assert cnt == len(set(c for _, c in slices[w])), w
+
+
+def test_concurrent_set_clear_with_snapshot(tmp_path):
+    """Writers set/clear while another thread forces snapshots: the
+    final persisted state replays to the exact in-memory truth."""
+    import threading
+
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    ex = Executor(h)
+    stop = threading.Event()
+    errs = []
+    snapshots = [0]
+
+    def snapshotter():
+        while not stop.is_set():
+            frag = h.fragment("i", "f", "standard", 0)
+            if frag is not None:
+                try:
+                    frag.snapshot()
+                    snapshots[0] += 1
+                except RuntimeError:
+                    return  # closed underneath: fine
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+
+    snap = threading.Thread(target=snapshotter, daemon=True)
+
+    def writer(w):
+        try:
+            for j in range(150):
+                col = w * 1000 + j
+                ex.execute("i", f"Set({col}, f=7)")
+                if j % 3 == 0:
+                    ex.execute("i", f"Clear({col}, f=7)")
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    snap.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "writer deadlocked"
+    stop.set()
+    snap.join(10)
+    assert not errs
+    assert snapshots[0] > 0, "no snapshot ever completed"
+    (want,) = ex.execute("i", "Count(Row(f=7))").results
+    h.close()
+
+    h2 = Holder(str(tmp_path))
+    h2.open()
+    (got,) = Executor(h2).execute("i", "Count(Row(f=7))").results
+    assert got == want
+    h2.close()
+
+
+# -- ImportValue with column keys (api_test.go ValColumnKey :157) ----------
+
+
+def test_import_value_column_keys():
+    h = Holder()
+    h.open()
+    h.create_index("keyed", keys=True)
+    from pilosa_tpu.api import API, ImportValueRequest, QueryRequest
+
+    api = API(holder=h)
+    api.create_field("keyed", "f", {"type": "int", "min": 0, "max": 100})
+    col_keys = [f"col{i}" for i in range(1, 6)]
+    api.import_values(
+        ImportValueRequest(
+            "keyed", "f", shard=0, column_keys=col_keys,
+            values=[10, 20, 30, 40, 50],
+        )
+    )
+    out = api.query(QueryRequest("keyed", "Range(f > 0)"))
+    assert out.results[0].keys == col_keys
+    vc = api.query(QueryRequest("keyed", "Sum(field=f)")).results[0]
+    assert (vc.val, vc.count) == (150, 5)

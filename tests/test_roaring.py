@@ -439,3 +439,70 @@ def test_decode_reference_sample_fragment():
         data = f.read()
     b = roaring.Bitmap.from_bytes(data)
     assert b.count() > 0
+
+
+# -- Flip region goldens (roaring_test.go TestBitmap_Flip_* :796-858) ------
+
+
+def test_flip_empty_golden():
+    b = roaring.Bitmap()
+    r = b.flip(0, 10)
+    assert r.count() == 11
+    assert r.flip(0, 10).count() == 0
+
+
+def test_flip_array_subrange_golden():
+    """A subrange flip must not disturb bits outside the range."""
+    b = roaring.Bitmap([0, 1, 2, 3, 4, 8, 16, 32, 64, 128, 256, 512, 1024])
+    r = b.flip(0, 4)
+    assert r.values.tolist() == [8, 16, 32, 64, 128, 256, 512, 1024]
+    r = r.flip(0, 4)
+    assert r.values.tolist() == [
+        0, 1, 2, 3, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+    ]
+
+
+def test_flip_bitmap_container_golden():
+    size = 10000
+    b = roaring.Bitmap(list(range(0, size, 2)))
+    r = b.flip(0, size - 1)
+    assert r.count() == size // 2
+    assert r.flip(0, size - 1).count() == size // 2
+
+
+def test_flip_regions_golden():
+    """Begin/middle/end regions (TestBitmap_Flip_After)."""
+    b = roaring.Bitmap([0, 2, 4, 8])
+    r = b.flip(9, 10)
+    assert r.values.tolist() == [0, 2, 4, 8, 9, 10]
+    r = r.flip(0, 1)
+    assert r.values.tolist() == [1, 2, 4, 8, 9, 10]
+    r = r.flip(4, 8)
+    assert r.values.tolist() == [1, 2, 5, 6, 7, 9, 10]
+
+
+def test_intersection_count_across_containers_golden():
+    """IntersectionCount over values straddling container keys
+    (TestBitmap_IntersectionCount_ArrayArray), both directions."""
+    b0 = roaring.Bitmap([0, 1000001, 1000002, 1000003])
+    b1 = roaring.Bitmap(
+        [0, 50000, 999998, 999999, 1000000, 1000001, 1000002]
+    )
+    assert b0.intersection_count(b1) == 3
+    assert b1.intersection_count(b0) == 3
+
+
+def test_offset_range_window_goldens():
+    """offset_range slices container-aligned windows (TestBitmapOffsetRange
+    pattern: a window over everything keeps the count; a half window
+    keeps that half)."""
+    vals = [k << 16 | v for k in range(5) for v in range(0, 4096, 16)]
+    b = roaring.Bitmap(vals)
+    whole = b.offset_range(0, 0, 5 << 16)
+    assert whole.count() == b.count()
+    half = b.offset_range(0, 0, 2 << 16)
+    assert half.count() == 2 * 256
+    # Offsetting relocates values verbatim.
+    moved = b.offset_range(7 << 16, 0, 5 << 16)
+    assert moved.count() == b.count()
+    assert int(moved.values.min()) == (7 << 16) | vals[0]
