@@ -238,7 +238,29 @@ def progress(msg, _t0=[None]):
     print(f"[{time.perf_counter() - _t0[0]:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def main():
+def report_pipeline(eng):
+    """Emit the batch pipeline's fill telemetry (round-6 tentpole:
+    in-flight depth + batch occupancy are part of the bench record, so
+    the QPS number can be attributed to pipelining, not guessed at)."""
+    snap = eng.pipeline_snapshot()
+    if snap is None or not snap["batches"]:
+        return
+    g = snap["gauges"]
+    emit_raw("pipeline_depth_configured", snap["depth"], "batches", 1.0)
+    emit_raw("pipeline_inflight_max", g.get("inflight_max", 0), "batches", 1.0)
+    emit_raw("batch_occupancy_avg", snap["avgOccupancy"], "queries/batch", 1.0)
+    emit_raw(
+        "batch_occupancy_max", g.get("max_batch_occupancy", 0),
+        "queries/batch", 1.0,
+    )
+    for stage, s in sorted(snap["stages"].items()):
+        progress(
+            f"  pipeline stage {stage}: n={s['count']} "
+            f"mean={s['meanSeconds'] * 1e3:.2f}ms max={s['maxSeconds'] * 1e3:.2f}ms"
+        )
+
+
+def main(depth_sweep=False):
     progress("importing jax")
     import jax
     import jax.numpy as jnp
@@ -825,6 +847,7 @@ print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
             f"{batcher.batches} fused batches "
             f"(avg {batcher.batched_queries / batcher.batches:.1f}/batch)"
         )
+    report_pipeline(eng)
     progress(f"http timed ({qps:.1f} qps over {n_total} requests)")
 
     # Mixed-kind QPS (round-4 VERDICT #1): Count + TopN + Sum
@@ -846,6 +869,28 @@ print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
         urllib.request.urlopen(req).read()  # warm/compile each kind
     mixed_qps, mixed_total = run_qps(mixed_texts)
     progress(f"http mixed timed ({mixed_qps:.1f} qps over {mixed_total})")
+
+    # ---- optional QPS-vs-in-flight-depth sweep (--depth-sweep) -----------
+    # One command reproduces the pipelining curve: the batcher is rebuilt
+    # at each depth and the same Count load is re-driven.
+    if depth_sweep:
+        from pilosa_tpu.parallel.batcher import CountBatcher
+
+        for d in (1, 2, 4, 8):
+            if eng._batcher is not None:
+                eng._batcher.stop()  # don't leak the prior depth's workers
+            eng._batcher = CountBatcher(eng, max_inflight=d)
+            d_qps, d_total = run_qps([t.decode() for t in c2_texts])
+            emit_raw(f"http_count_qps_depth{d}", d_qps, "qps", d_qps * c_c2)
+            snap = eng.pipeline_snapshot()
+            g = snap["gauges"] if snap else {}
+            progress(
+                f"depth {d}: {d_qps:.1f} qps over {d_total}, "
+                f"inflight_max={g.get('inflight_max', 0)}, "
+                f"occupancy={snap['avgOccupancy'] if snap else 0}"
+            )
+        eng._batcher.stop()
+        eng._batcher = None  # back to the default-depth lazy batcher
     httpd.shutdown()
     emit("http_count_e2e_p50", t_http, c_c2)
     emit_raw("http_count_qps", qps, "qps", qps * c_c2)
@@ -935,4 +980,14 @@ def __rand(rng, words64):
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--depth-sweep",
+        action="store_true",
+        help="also sweep the batch pipeline's in-flight depth (1/2/4/8) "
+        "and emit http_count_qps_depthN lines (the QPS-vs-depth curve)",
+    )
+    args = ap.parse_args()
+    main(depth_sweep=args.depth_sweep)

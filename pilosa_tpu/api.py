@@ -194,6 +194,39 @@ class API:
             )
         return resp
 
+    def query_async(self, req: QueryRequest):
+        """Deferred query: returns a future (result/add_done_callback ->
+        QueryResponse) when the executor can pipeline the request
+        (all-Count queries through the batch pipeline), else None — the
+        caller falls back to the synchronous ``query``.  The HTTP layer
+        uses this to resolve responses from completion callbacks instead
+        of holding a handler thread per in-flight query."""
+        opt = ExecOptions(
+            remote=req.remote,
+            exclude_row_attrs=req.exclude_row_attrs,
+            exclude_columns=req.exclude_columns,
+            column_attrs=req.column_attrs,
+        )
+        start = time.monotonic()
+        fut = self.executor.execute_async(req.index, req.query, req.shards, opt)
+        if fut is None:
+            return None
+        if self.long_query_time:
+
+            def _log_long(_f):
+                elapsed = time.monotonic() - start
+                if elapsed > self.long_query_time:
+                    self.logger.printf(
+                        "%.3fs > %.1fs: %s %s",
+                        elapsed,
+                        self.long_query_time,
+                        req.index,
+                        str(req.query)[:200],
+                    )
+
+            fut.add_done_callback(_log_long)
+        return fut
+
     # -- schema (api.go :129-386, 625-687) ---------------------------------
 
     def create_index(

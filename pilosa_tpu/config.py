@@ -99,10 +99,15 @@ class Config:
     # -- loading -----------------------------------------------------------
 
     def load_file(self, path: str):
-        import tomllib
-
+        try:
+            import tomllib
+        except ImportError:  # Python < 3.11: no stdlib TOML reader
+            tomllib = None
         with open(path, "rb") as f:
-            doc = tomllib.load(f)
+            if tomllib is not None:
+                doc = tomllib.load(f)
+            else:
+                doc = _parse_toml_subset(f.read().decode())
         self.apply_dict(doc)
 
     def apply_dict(self, doc: dict):
@@ -285,3 +290,50 @@ sequencer = "{self.mesh_sequencer}"
     def bind_host_port(self):
         host, _, port = self.bind.rpartition(":")
         return host or "0.0.0.0", int(port or 10101)
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Minimal TOML reader for the config dialect ``to_toml`` emits
+    (dotted/flat section headers, string/bool/int/float scalars, string
+    arrays, full-line comments) — used only on Python < 3.11, where
+    stdlib ``tomllib`` doesn't exist and the container bakes no
+    third-party TOML package.  Unsupported constructs raise ValueError
+    rather than misparse."""
+    doc: dict = {}
+    cur = doc
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = doc
+            for part in line[1:-1].strip().split("."):
+                cur = cur.setdefault(part.strip(), {})
+            continue
+        if "=" not in line:
+            raise ValueError(f"config line {ln}: expected key = value")
+        key, _, val = line.partition("=")
+        cur[key.strip()] = _parse_toml_scalar(val.strip(), ln)
+    return doc
+
+
+def _parse_toml_scalar(v: str, ln: int):
+    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+        return v[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if v.startswith("[") and v.endswith("]"):
+        inner = v[1:-1].strip()
+        if not inner:
+            return []
+        return [_parse_toml_scalar(x.strip(), ln) for x in inner.split(",")]
+    if v == "true":
+        return True
+    if v == "false":
+        return False
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"config line {ln}: unsupported value {v!r}") from None

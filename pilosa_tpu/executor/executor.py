@@ -296,6 +296,116 @@ def _call_cacheable(c: Call) -> bool:
     return all(_call_cacheable(ch) for ch in c.children)
 
 
+class _QueryFuture:
+    """Future for a deferred all-Count query (Executor.execute_async):
+    resolves to a QueryResponse once every batched item lands.  On ANY
+    item error it falls back to a full synchronous re-execution on a
+    fresh thread — the sync path has per-call fallbacks (host path on
+    unlowerable argument shapes, peerless meshes) the pipeline skips,
+    so an async error must converge to the sync answer, not surface an
+    error the sync path wouldn't have returned.  The fallback thread is
+    fresh, never a batcher collect worker: re-executing there could
+    block the pool that resolves other batches."""
+
+    __slots__ = (
+        "_executor",
+        "_index",
+        "_query",
+        "_shards",
+        "_opt",
+        "_slots",
+        "_items",
+        "_event",
+        "_response",
+        "_error",
+        "_callbacks",
+        "_pending",
+        "_lock",
+    )
+
+    def __init__(self, executor, index, query, shards, opt, slots, items):
+        self._executor = executor
+        self._index = index
+        self._query = query
+        self._shards = shards
+        self._opt = opt
+        self._slots = slots
+        self._items = items  # [(result slot, batcher _Item), ...]
+        self._event = threading.Event()
+        self._response: Optional[QueryResponse] = None
+        self._error: Optional[BaseException] = None
+        self._callbacks: list = []
+        self._pending = len(items)
+        self._lock = threading.Lock()
+        if not items:
+            self._finish_ok()  # every call hit the O(1) lane
+        else:
+            for _k, it in items:
+                it.add_done_callback(self._item_done)
+
+    def _item_done(self, _item):
+        with self._lock:
+            self._pending -= 1
+            if self._pending > 0:
+                return
+        if any(it.error is not None for _k, it in self._items):
+            threading.Thread(
+                target=self._fallback, daemon=True, name="query-fallback"
+            ).start()
+            return
+        for k, it in self._items:
+            self._slots[k] = it.result
+        self._finish_ok()
+
+    def _finish_ok(self):
+        self._response = QueryResponse(list(self._slots))
+        self._resolve()
+
+    def _fallback(self):
+        try:
+            self._response = self._executor.execute(
+                self._index, self._query, self._shards, self._opt
+            )
+        except BaseException as e:  # noqa: BLE001
+            self._error = e
+        self._resolve()
+
+    def _resolve(self):
+        self._event.set()
+        while self._callbacks:
+            try:
+                fn = self._callbacks.pop()
+            except IndexError:
+                break
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def add_done_callback(self, fn):
+        """Run ``fn(self)`` on resolution (immediately if resolved);
+        same lock-free append-then-claim protocol as the batcher items."""
+        self._callbacks.append(fn)
+        if self._event.is_set():
+            try:
+                self._callbacks.remove(fn)
+            except ValueError:
+                return
+            fn(self)
+
+    def result(self, timeout: Optional[float] = None) -> QueryResponse:
+        if not self._event.wait(
+            timeout if timeout is not None else 310.0
+        ):
+            raise Error("deferred query timed out (pipeline wedged?)")
+        if self._error is not None:
+            raise self._error
+        return self._response
+
+
 class Executor:
     """Single-node query executor; the cluster layer overrides ``_mapper``
     routing (executor.go:34-60)."""
@@ -390,6 +500,73 @@ class Executor:
                 query = parsed  # don't re-parse on the outer path
         with self.tracer.start_span("executor.Execute", index=index):
             return self._execute_outer(index, query, shards, opt)
+
+    # -- deferred execution (pipelined serving) ----------------------------
+
+    def execute_async(self, index, query, shards=None, opt=None):
+        """Deferred execution for all-Count queries: every Count is
+        either answered from the O(1) cardinality lane or queued into
+        the engine's bounded batch pipeline, and a future
+        (result/add_done_callback) is returned WITHOUT waiting for the
+        device.  Returns None when the query isn't eligible — the
+        caller runs the synchronous ``execute`` path.  This is the seam
+        the HTTP layer uses to stop parking a handler thread per
+        in-flight query: completion callbacks resolve pending responses
+        when the fused batch's readback lands."""
+        eng = self.mesh_engine
+        if eng is None or eng._peerless_multiproc:
+            return None
+        if opt is not None and (opt.remote or opt.column_attrs):
+            return None
+        try:
+            if isinstance(query, str):
+                query = self._parse_cached(query)
+        except Exception:  # noqa: BLE001 — sync path surfaces the error
+            return None
+        calls = query.calls
+        if not calls or any(
+            c.name != "Count" or len(c.children) != 1 for c in calls
+        ):
+            return None
+        idx = self.holder.index(index)
+        if idx is None:
+            return None  # sync path raises IndexNotFoundError
+        opt = opt or ExecOptions()
+        try:
+            if not opt.remote and self.translator is not None:
+                # In-place key->id rewrite, same as the sync prologue
+                # (idempotent: a later sync fallback re-translates ints
+                # as no-ops).  translate_results is safely skipped:
+                # Count results are plain ints, never key-translated.
+                self.translator.translate_calls(index, idx, calls)
+            if not shards:
+                shards = self._default_shards(index) or [0]
+            if self.cluster is not None:
+                local = set(self._local_shards(index, shards, opt.remote))
+                if any(s not in local for s in shards):
+                    return None  # remote shards: the sync mapper splits
+            children = [c.children[0] for c in calls]
+            if not all(eng.lowerable(ch) for ch in children):
+                return None
+            # Two passes: probe every fast-lane answer FIRST, so a late
+            # surprise in this (fallible, host-side) pass aborts to the
+            # sync path with ZERO batcher items enqueued — bailing after
+            # an enqueue would orphan in-flight device work and execute
+            # the query twice.  The second pass is queue appends only.
+            slots: list = [None] * len(calls)
+            for k, ch in enumerate(children):
+                slots[k] = self._count_from_cardinalities(
+                    index, ch, shards, opt.remote
+                )
+        except Exception:  # noqa: BLE001 — any surprise: sync path decides
+            return None
+        items = [
+            (k, eng.batched_count_async(index, ch, shards))
+            for k, ch in enumerate(children)
+            if slots[k] is None
+        ]
+        self.stats.count("Count", len(calls), tags=[f"index:{index}"])
+        return _QueryFuture(self, index, query, shards, opt, slots, items)
 
     def _execute_fast_count(self, index, query, shards):
         """O(1)-lane probe: returns (response, parsed).  ``response`` is

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Tuple
@@ -22,6 +23,64 @@ from ..executor.executor import Error as ExecError, FieldNotFoundError, IndexNot
 from ..executor.translate import TranslateError
 from ..pql import ParseError
 from .wire import response_to_json
+
+
+class DeferredResponse:
+    """A route handler's promise of a (status, content-type, payload)
+    triple resolved later by a completion callback (the pipelined query
+    path): the connection thread registers a writer and goes back to
+    reading requests instead of blocking on the device readback — no
+    handler thread is held per in-flight query, and one connection can
+    have many requests in flight (HTTP pipelining; the per-connection
+    _ResponseSequencer keeps responses in request order)."""
+
+    __slots__ = ("_triple", "_event", "_cbs")
+
+    def __init__(self):
+        self._triple = None
+        self._event = threading.Event()
+        self._cbs: list = []
+
+    def resolve(self, status: int, ctype: str, payload: bytes):
+        if self._event.is_set():
+            return  # first resolution wins: a duplicate must not double-write
+        self._triple = (status, ctype, payload)
+        self._event.set()
+        while self._cbs:
+            try:
+                fn = self._cbs.pop()
+            except IndexError:
+                break
+            try:
+                fn(*self._triple)
+            except Exception:  # noqa: BLE001 — a dead connection must not
+                pass  # poison the resolver (a batch collect worker)
+
+    def on_ready(self, fn):
+        """Register ``fn(status, ctype, payload)`` (runs immediately if
+        already resolved; append-then-claim keeps the race with resolve
+        lock-free)."""
+        self._cbs.append(fn)
+        if self._event.is_set():
+            try:
+                self._cbs.remove(fn)
+            except ValueError:
+                return
+            fn(*self._triple)
+
+
+def error_response(e: BaseException) -> Tuple[int, bytes]:
+    """Exception -> (status, JSON payload), shared by the synchronous
+    route dispatch and deferred completion callbacks so both paths map
+    errors identically."""
+    if isinstance(e, (NotFoundError, IndexNotFoundError, FieldNotFoundError)):
+        return 404, json.dumps({"error": str(e)}).encode()
+    if isinstance(e, (ApiError, ExecError, ParseError, TranslateError, ValueError)):
+        return 400, json.dumps({"error": str(e)}).encode()
+    # Panic recovery (http/handler.go); print_exception(triple) works
+    # from callbacks too, where there is no "current" exception.
+    traceback.print_exception(type(e), e, e.__traceback__)
+    return 500, json.dumps({"error": str(e)}).encode()
 
 
 class Route:
@@ -166,13 +225,11 @@ class Handler:
                 continue
             try:
                 result = route.fn(query, body, _headers=headers, **m.groupdict())
-            except (NotFoundError, IndexNotFoundError, FieldNotFoundError) as e:
-                return 404, "application/json", json.dumps({"error": str(e)}).encode()
-            except (ApiError, ExecError, ParseError, TranslateError, ValueError) as e:
-                return 400, "application/json", json.dumps({"error": str(e)}).encode()
-            except Exception as e:  # panic recovery (http/handler.go)
-                traceback.print_exc()
-                return 500, "application/json", json.dumps({"error": str(e)}).encode()
+            except Exception as e:  # noqa: BLE001 — shared status mapping
+                status, payload = error_response(e)
+                return status, "application/json", payload
+            if isinstance(result, DeferredResponse):
+                return result
             if isinstance(result, bytes):
                 return 200, "application/octet-stream", result
             if isinstance(result, str):
@@ -310,6 +367,25 @@ class Handler:
             or doc.get("excludeColumns", False),
             remote=_qbool(q, "remote") or doc.get("remote", False),
         )
+        fut = self.api.query_async(req)
+        if fut is not None:
+            # Pipelined: the response resolves from the batch pipeline's
+            # completion callback; this handler thread goes back to
+            # reading requests instead of parking on the readback.
+            d = DeferredResponse()
+
+            def _done(f):
+                try:
+                    payload = json.dumps(
+                        response_to_json(f.result(0))
+                    ).encode()
+                    d.resolve(200, "application/json", payload)
+                except Exception as e:  # noqa: BLE001
+                    status, payload = error_response(e)
+                    d.resolve(status, "application/json", payload)
+
+            fut.add_done_callback(_done)
+            return d
         return response_to_json(self.api.query(req))
 
     def _post_import(self, q, b, *, index, field, **kw):
@@ -384,9 +460,19 @@ class Handler:
 
     def _debug_vars(self, q, b, **kw):
         stats = getattr(self.api.executor, "stats", None)
-        if stats is not None and hasattr(stats, "snapshot"):
-            return stats.snapshot()
-        return {}
+        out = (
+            stats.snapshot()
+            if stats is not None and hasattr(stats, "snapshot")
+            else {}
+        )
+        # Pipeline telemetry (parallel/batcher.py): per-stage timings,
+        # in-flight depth, batch occupancy.
+        eng = getattr(self.api, "mesh_engine", None)
+        if eng is not None and hasattr(eng, "pipeline_snapshot"):
+            snap = eng.pipeline_snapshot()
+            if snap is not None:
+                out["pipeline"] = snap
+        return out
 
     def _debug_pprof(self, q, b, **kw):
         """/debug/pprof equivalent (http/handler.go:241): a full thread
@@ -621,9 +707,93 @@ def _parse_shards(q: dict) -> Optional[List[int]]:
     return [int(s) for s in raw.split(",")]
 
 
+class _ResponseSequencer:
+    """Per-connection ordered response writer.  Every response on a
+    connection — synchronous or deferred — takes a slot in request
+    order and is written when it (and everything before it) is ready,
+    so the connection thread can keep READING pipelined requests while
+    completion callbacks resolve earlier ones out of order.  Writes run
+    under the lock (ordering demands serialization anyway); a broken
+    socket marks the sequencer dead and drops the backlog."""
+
+    # Pending responses allowed per connection before the reader stalls:
+    # bounds per-connection memory against a client that pipelines
+    # without reading.
+    MAX_PENDING = 64
+
+    __slots__ = ("_wfile", "_lock", "_cond", "_next_slot", "_next_write",
+                 "_ready", "dead")
+
+    def __init__(self, wfile):
+        self._wfile = wfile
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._next_slot = 0
+        self._next_write = 0
+        self._ready = {}
+        self.dead = False
+
+    def open_slot(self) -> int:
+        with self._cond:
+            while (
+                self._next_slot - self._next_write >= self.MAX_PENDING
+                and not self.dead
+            ):
+                self._cond.wait(1.0)
+            slot = self._next_slot
+            self._next_slot += 1
+            return slot
+
+    def complete(self, slot: int, raw: bytes):
+        with self._cond:
+            self._ready[slot] = raw
+            while not self.dead and self._next_write in self._ready:
+                buf = self._ready.pop(self._next_write)
+                try:
+                    self._wfile.write(buf)
+                except Exception:  # noqa: BLE001 — client went away
+                    self.dead = True
+                    self._ready.clear()
+                    break
+                self._next_write += 1
+            self._cond.notify_all()
+
+    def drain(self, timeout: float) -> bool:
+        """Wait until every opened slot is written (or the connection
+        died); returns True when fully drained."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._next_write < self._next_slot and not self.dead:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 1.0))
+            return self._next_write >= self._next_slot
+
+    def kill(self):
+        with self._cond:
+            self.dead = True
+            self._ready.clear()
+            self._cond.notify_all()
+
+
 class _HTTPRequestHandler(BaseHTTPRequestHandler):
     handler: Handler = None
     protocol_version = "HTTP/1.1"
+    # Per-connection socket timeout (reads AND writes).  Load-bearing
+    # for the pipeline: deferred responses are written by the shared
+    # batch collect workers, so a client that stops reading (zero TCP
+    # window) would otherwise block a collect worker — and its
+    # batchmates' completions — inside wfile.write forever.  With the
+    # timeout, the write raises, the sequencer marks the connection
+    # dead, and the worker moves on.  It is also the wedged-pipeline
+    # backstop for deferred responses that never resolve: the idle
+    # read times out, the connection closes after the drain below.
+    timeout = 120.0
+    # Ceiling on waiting for in-flight deferred responses at connection
+    # close; above the batcher's 300 s wedge timeout so a drain hit
+    # means the pipeline, not the drain, failed.
+    DRAIN_TIMEOUT = 320.0
 
     def log_message(self, fmt, *args):
         pass
@@ -639,25 +809,85 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
             return origin
         return None
 
+    def _sequencer(self) -> _ResponseSequencer:
+        seq = getattr(self, "_seq", None)
+        if seq is None:
+            seq = self._seq = _ResponseSequencer(self.wfile)
+        return seq
+
+    def _render_response(self, status, ctype, payload, cors_origin, vary):
+        """Raw HTTP/1.1 response bytes.  Built by hand (not
+        send_response/send_header) because deferred responses are
+        written by completion callbacks AFTER the connection thread has
+        moved on to the next request — the handler object's header
+        state machine belongs to that next request by then."""
+        reason = self.responses.get(status, ("", ""))[0]
+        head = [
+            f"{self.protocol_version} {status} {reason}".encode(),
+            b"Content-Type: " + ctype.encode(),
+            b"Content-Length: " + str(len(payload)).encode(),
+        ]
+        if vary:
+            # Per-Origin responses must not be cached across origins.
+            head.append(b"Vary: Origin")
+            if cors_origin is not None:
+                head.append(
+                    b"Access-Control-Allow-Origin: " + cors_origin.encode()
+                )
+        return b"\r\n".join(head) + b"\r\n\r\n" + payload
+
     def _dispatch(self, method):
         parsed = urlparse(self.path)
         query = parse_qs(parsed.query)
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
-        status, ctype, payload = self.handler.handle(
-            method, parsed.path, query, body, dict(self.headers)
-        )
-        self.send_response(status)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(payload)))
-        if self.handler.allowed_origins:
-            # Per-Origin responses must not be cached across origins.
-            self.send_header("Vary", "Origin")
-            origin = self._cors_origin()
-            if origin is not None:
-                self.send_header("Access-Control-Allow-Origin", origin)
-        self.end_headers()
-        self.wfile.write(payload)
+        seq = self._sequencer()
+        slot = seq.open_slot()
+        try:
+            result = self.handler.handle(
+                method, parsed.path, query, body, dict(self.headers)
+            )
+        except Exception as e:  # noqa: BLE001 — an opened slot must be
+            # completed no matter what, or every later response on this
+            # connection queues behind it forever.
+            status, payload = error_response(e)
+            result = (status, "application/json", payload)
+        if isinstance(result, DeferredResponse):
+            # Capture per-REQUEST state now: by resolve time this
+            # handler object is parsing the connection's next request.
+            cors_origin = self._cors_origin()
+            vary = bool(self.handler.allowed_origins)
+            result.on_ready(
+                lambda status, ctype, payload: seq.complete(
+                    slot,
+                    self._render_response(
+                        status, ctype, payload, cors_origin, vary
+                    ),
+                )
+            )
+        else:
+            status, ctype, payload = result
+            seq.complete(
+                slot,
+                self._render_response(
+                    status,
+                    ctype,
+                    payload,
+                    self._cors_origin(),
+                    bool(self.handler.allowed_origins),
+                ),
+            )
+        if self.close_connection:
+            # The last response of the connection may still be in
+            # flight; the socket must not close under it.
+            seq.drain(self.DRAIN_TIMEOUT)
+
+    def finish(self):
+        seq = getattr(self, "_seq", None)
+        if seq is not None:
+            seq.drain(self.DRAIN_TIMEOUT)
+            seq.kill()
+        super().finish()
 
     def do_GET(self):
         self._dispatch("GET")
@@ -672,19 +902,22 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
         """CORS preflight (http/handler.go:83 handlers.CORS: allowed
         methods + the Content-Type header).  Without a matching Origin
         the preflight answers 200 with no allow headers — the browser
-        then blocks, same as gorilla's middleware."""
+        then blocks, same as gorilla's middleware.  Routed through the
+        sequencer like every other response so a preflight pipelined
+        behind a deferred query stays in order."""
         origin = self._cors_origin()
-        self.send_response(200)
+        head = [f"{self.protocol_version} 200 OK".encode()]
         if self.handler.allowed_origins:
-            self.send_header("Vary", "Origin")
+            head.append(b"Vary: Origin")
         if origin is not None:
-            self.send_header("Access-Control-Allow-Origin", origin)
-            self.send_header(
-                "Access-Control-Allow-Methods", "GET, POST, DELETE, OPTIONS"
+            head.append(b"Access-Control-Allow-Origin: " + origin.encode())
+            head.append(
+                b"Access-Control-Allow-Methods: GET, POST, DELETE, OPTIONS"
             )
-            self.send_header("Access-Control-Allow-Headers", "Content-Type")
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+            head.append(b"Access-Control-Allow-Headers: Content-Type")
+        head.append(b"Content-Length: 0")
+        seq = self._sequencer()
+        seq.complete(seq.open_slot(), b"\r\n".join(head) + b"\r\n\r\n")
 
 
 def make_server_ssl_context(certfile: str, keyfile: str):

@@ -1,4 +1,4 @@
-"""Cross-request Count micro-batcher.
+"""Cross-request Count micro-batcher: a bounded multi-batch pipeline.
 
 The reference amortizes small queries with goroutines over shared mmap'd
 fragments (executor.go mapReduce :2183) — concurrency is nearly free, so
@@ -10,23 +10,68 @@ single-Count HTTP requests executed one dispatch each would serialize
 kernels.count_batch_tree dispatch: K answers for one floor + one
 readback.
 
+Round 5 ran exactly one fused batch at a time (plus 2 pipelined
+readbacks), so device compute, host lowering, and the readback RTT
+serialized — QPS was capped at batch_size x readbacks_per_second
+(0.67x baseline).  This version decouples the path into STAGES with
+their own worker loops and a bounded number of fused batches in flight:
+
+  accumulate  submit() queues arrivals; the drain worker gives
+              concurrent arrivals a short window to pile into one drain
+              (submit threads + ``count-batch-drain``)
+  lower +     the drain worker groups a drain by (index, structure)
+  dispatch    signature and hands groups to ``count-batch-dispatch``,
+              which lowers + enqueues each group as one fused device
+              program WITHOUT waiting for the device (the engine's
+              donation contract serializes lower+enqueue under its
+              dispatch lock, so they share one loop — the point is they
+              overlap every OTHER batch's device execution and readback)
+  collect     a pool of ``count-batch-collect-N`` workers block in
+              jax.device_get, decode the answer vector, and resolve the
+              submitters' futures (HTTP completion callbacks fire here)
+
+In-flight depth is bounded by a semaphore (``max_inflight``, default
+DEFAULT_INFLIGHT, env PILOSA_PIPELINE_DEPTH): the dispatch worker BLOCKS
+on the (depth+1)'th batch, so under overload the queue accumulates a
+full readback period of arrivals and batch size self-tunes to
+arrival_rate x readback_time / depth, while depth batches overlap in the
+transport + device.  Per-stage timings, in-flight depth, and batch
+occupancy are tracked in a util.stats.PipelineStats (``pipeline``
+attribute; surfaced by /debug/vars and bench.py).
+
 Policy: pass-through when idle (a lone query runs on its own thread with
-zero added latency — exactly the unbatched path), batch under load (while
-a dispatch is in flight, arrivals queue; the worker drains the whole
-queue into one fused program when the device frees up).  This is
-batching-by-backpressure: no artificial delay window, batch size adapts
-to the actual concurrency.
+zero added latency — exactly the unbatched path), batch under load.
+This is batching-by-backpressure: no artificial delay window, batch size
+adapts to the actual concurrency.
 """
 
 from __future__ import annotations
 
+import os
+import queue as queue_mod
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
+
+from ..util.stats import PipelineStats
 
 
 class _Item:
-    __slots__ = ("index", "call", "shards", "event", "result", "error")
+    """One submitted Count: a future resolved by the collect stage (or
+    inline on the direct path).  ``add_done_callback`` lets the HTTP
+    layer resolve a pending response without parking a thread in
+    ``wait``."""
+
+    __slots__ = (
+        "index",
+        "call",
+        "shards",
+        "event",
+        "result",
+        "error",
+        "t_submit",
+        "_callbacks",
+    )
 
     def __init__(self, index, call, shards):
         self.index = index
@@ -35,10 +80,41 @@ class _Item:
         self.event = threading.Event()
         self.result: Optional[int] = None
         self.error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+        self._callbacks: List[Callable] = []
+
+    def done(self) -> bool:
+        return self.event.is_set()
+
+    def add_done_callback(self, fn: Callable[["_Item"], None]):
+        """Run ``fn(self)`` when the item resolves (immediately if it
+        already has).  Callbacks run on the resolving thread (a collect
+        worker) — keep them short.  Append-then-claim over the GIL-atomic
+        list keeps registration lock-free against a concurrent resolve:
+        whichever side removes the callback from the list runs it."""
+        self._callbacks.append(fn)
+        if self.event.is_set():
+            try:
+                self._callbacks.remove(fn)
+            except ValueError:
+                return  # the resolver claimed (and ran) it
+            fn(self)
+
+    def _resolve(self):
+        self.event.set()
+        while self._callbacks:
+            try:
+                fn = self._callbacks.pop()
+            except IndexError:
+                break
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — a bad callback must not
+                pass  # poison its batchmates' completions
 
 
 class CountBatcher:
-    # Bail out of a wait after this long — the worker catches all
+    # Bail out of a wait after this long — the workers catch all
     # exceptions, so a hit means the engine itself wedged (e.g. a stuck
     # collective); surface an error instead of blocking the HTTP thread
     # forever.
@@ -51,70 +127,6 @@ class CountBatcher:
     # triggers it — size-1 drains don't refresh the window — so idle
     # latency is untouched.
     HOT_WINDOW = 0.25
-
-    def __init__(self, engine, max_batch: int = 512):
-        self.engine = engine
-        self.max_batch = max_batch
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        self._queue: List[_Item] = []
-        self._busy = False
-        self._inflight = threading.Semaphore(self.MAX_INFLIGHT)
-        self._last_fused = 0.0  # monotonic time of the last >=2 batch
-        self._worker: Optional[threading.Thread] = None
-        # Telemetry the QPS bench and tests assert on.
-        self.batches = 0
-        self.batched_queries = 0
-
-    def submit(self, index: str, call, shards) -> int:
-        """Count one tree; returns the count.  Lone callers run directly
-        (no handoff); callers arriving while a dispatch is in flight —
-        or within the hot window after a fused batch — are queued and
-        answered from the next fused batch.
-
-        There is no unbatched "overlap mode" for slow transports any
-        more (round 4 had one): with completion threads pipelining up to
-        MAX_INFLIGHT batch readbacks, the batch cycle no longer
-        serializes on the readback RTT, and fusing K queries per
-        dispatch is what keeps the per-request host cost (jit-call
-        overhead, GIL) sublinear at high client counts — the axis round
-        4 left 8x under target."""
-        with self._lock:
-            hot = time.monotonic() - self._last_fused < self.HOT_WINDOW
-            if not self._busy and not self._queue and not hot:
-                self._busy = True
-                direct = True
-            else:
-                item = _Item(index, call, list(shards))
-                self._queue.append(item)
-                self._ensure_worker()
-                # Wake the worker on the empty->non-empty transition
-                # only (it polls during accumulation): per-submit
-                # notify_all was measurable lock churn at ~1k
-                # submits/s on a single-core host.
-                if len(self._queue) == 1:
-                    self._cond.notify_all()
-                direct = False
-        if direct:
-            try:
-                return self.engine.count(index, call, shards)
-            finally:
-                with self._lock:
-                    self._busy = False
-                    if self._queue:
-                        self._cond.notify_all()
-        if not item.event.wait(self.WAIT_TIMEOUT):
-            raise RuntimeError("batched count timed out (engine wedged?)")
-        if item.error is not None:
-            raise item.error
-        return item.result
-
-    def _ensure_worker(self):
-        if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(
-                target=self._worker_loop, daemon=True, name="count-batcher"
-            )
-            self._worker.start()
 
     # Accumulation window: once the queue is non-empty, give concurrent
     # arrivals this long to pile into the SAME drain before dispatching.
@@ -130,53 +142,289 @@ class CountBatcher:
     ACCUM_WINDOW = 0.15
     ACCUM_POLL = 0.005
 
-    def _worker_loop(self):
-        while True:
+    # Fused batches allowed in flight at once (the pipeline depth): the
+    # dispatch worker blocks on the (depth+1)'th batch, so the queue
+    # accumulates while depth batches overlap lowering, device
+    # execution, and readback.  Round 5's value of 2 left the device
+    # idle whenever both readbacks were in the transport; >=4 keeps a
+    # batch in every stage of the pipe.  Tunable per deployment via
+    # PILOSA_PIPELINE_DEPTH or the constructor.
+    DEFAULT_INFLIGHT = 4
+
+    def __init__(self, engine, max_batch: int = 512, max_inflight: Optional[int] = None):
+        self.engine = engine
+        self.max_batch = max_batch
+        if max_inflight is None:
+            max_inflight = int(
+                os.environ.get("PILOSA_PIPELINE_DEPTH", self.DEFAULT_INFLIGHT)
+            )
+        self.max_inflight = max(1, int(max_inflight))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[_Item] = []
+        self._busy = False
+        self._inflight = threading.Semaphore(self.max_inflight)
+        self._last_fused = 0.0  # monotonic time of the last >=2 batch
+        self._workers_started = False
+        # Grouped batches ready to lower+dispatch, and dispatched device
+        # futures awaiting readback.
+        self._dispatch_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._collect_q: "queue_mod.Queue" = queue_mod.Queue()
+        # Telemetry the QPS bench and tests assert on.
+        self.batches = 0
+        self.batched_queries = 0
+        self._stopped = False
+        self.pipeline = PipelineStats()
+        self.pipeline.gauge("depth_configured", self.max_inflight)
+
+    # -- accumulate stage ---------------------------------------------------
+
+    def submit(self, index: str, call, shards) -> int:
+        """Count one tree; returns the count.  Lone callers run directly
+        (no handoff); callers arriving while a dispatch is in flight —
+        or within the hot window after a fused batch — are queued and
+        answered from the next fused batch."""
+        item = self._submit(index, call, shards, allow_direct=True)
+        if item is None:
+            return self._direct(index, call, shards)
+        if not item.event.wait(self.WAIT_TIMEOUT):
+            raise RuntimeError("batched count timed out (engine wedged?)")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def submit_async(self, index: str, call, shards) -> _Item:
+        """Queue one Count into the pipeline and return its future
+        (_Item).  Never takes the direct path — the caller is handing
+        off completion (an HTTP deferral), so blocking here would defeat
+        it; a lone async query pays ~one accumulation poll."""
+        return self._submit(index, call, shards, allow_direct=False)
+
+    def _submit(self, index, call, shards, allow_direct: bool):
+        with self._lock:
+            hot = time.monotonic() - self._last_fused < self.HOT_WINDOW
+            if allow_direct and not self._busy and not self._queue and not hot:
+                self._busy = True
+                return None  # caller runs the direct path
+            item = _Item(index, call, list(shards))
+            self._queue.append(item)
+            self._ensure_workers()
+            # Wake the drain worker on the empty->non-empty transition
+            # only (it polls during accumulation): per-submit notify_all
+            # was measurable lock churn at ~1k submits/s on a
+            # single-core host.
+            if len(self._queue) == 1:
+                self._cond.notify_all()
+        return item
+
+    def _direct(self, index, call, shards) -> int:
+        try:
+            return self.engine.count(index, call, shards)
+        finally:
             with self._lock:
-                while self._busy or not self._queue:
+                self._busy = False
+                if self._queue:
+                    self._cond.notify_all()
+
+    def _ensure_workers(self):
+        if self._workers_started:
+            return
+        self._workers_started = True
+        threading.Thread(
+            target=self._drain_loop, daemon=True, name="count-batch-drain"
+        ).start()
+        threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="count-batch-dispatch"
+        ).start()
+        for i in range(self.max_inflight):
+            threading.Thread(
+                target=self._collect_loop,
+                daemon=True,
+                name=f"count-batch-collect-{i}",
+            ).start()
+
+    # -- drain stage (accumulate -> grouped batches) ------------------------
+
+    def stop(self):
+        """Shut down the stage workers (drain/dispatch/collect).  Used
+        when a batcher is REPLACED (bench --depth-sweep rebuilds one per
+        depth) — without it each discarded batcher leaks 2+depth daemon
+        threads for the life of the process.  In-queue items resolve
+        before the workers exit; new submits after stop() would queue
+        forever, so only call on a batcher no longer reachable from the
+        engine."""
+        self._stopped = True
+        with self._lock:
+            self._cond.notify_all()
+        if self._workers_started:
+            self._dispatch_q.put(None)
+            for _ in range(self.max_inflight):
+                self._collect_q.put(None)
+
+    def _drain_loop(self):
+        while not self._stopped:
+            with self._lock:
+                while not self._queue:
+                    if self._stopped:
+                        return
                     self._cond.wait(timeout=60.0)
-            deadline = time.monotonic() + self.ACCUM_WINDOW
-            prev = -1
-            while time.monotonic() < deadline:
-                with self._lock:
-                    depth = len(self._queue)
-                if depth >= self.max_batch or depth == prev:
-                    break  # full drain ready, or arrivals went quiet
-                prev = depth
-                time.sleep(self.ACCUM_POLL)
+                depth0 = len(self._queue)
+            # A lone queued query outside the hot window (an idle
+            # deferred submit) dispatches immediately: the accumulation
+            # window exists to fuse CONCURRENT arrivals, and a lone
+            # caller paying a poll sleep would tax idle latency for
+            # nothing.
+            if depth0 > 1 or (
+                time.monotonic() - self._last_fused < self.HOT_WINDOW
+            ):
+                deadline = time.monotonic() + self.ACCUM_WINDOW
+                prev = -1
+                while time.monotonic() < deadline:
+                    with self._lock:
+                        depth = len(self._queue)
+                    if depth >= self.max_batch or depth == prev:
+                        break  # full drain ready, or arrivals went quiet
+                    prev = depth
+                    time.sleep(self.ACCUM_POLL)
             with self._lock:
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]
-                self._busy = True
-            try:
-                self._run_batch(batch)
-            finally:
-                with self._lock:
-                    self._busy = False
-                    if self._queue:
-                        self._cond.notify_all()
+            # One dispatch per (index, structure) group in the drain
+            # (operand lists are per-index; mixed structures would
+            # compile distinct padded programs, so each structure fuses
+            # separately).
+            by_sig = {}
+            for it in batch:
+                by_sig.setdefault(self._signature(it.index, it.call), []).append(it)
+            for (index, _sig), items in by_sig.items():
+                self._dispatch_q.put((index, items, False))
 
-    # In-flight readbacks allowed to overlap: the worker dispatches
-    # batch N+1 while N's results are still in transit — otherwise the
-    # readback round-trip floors the batch cycle time.  DELIBERATELY
-    # small: device_get round trips serialize in the transport (~11/s
-    # measured through the relay regardless of concurrency), so an
-    # eager worker fragments the load into many small batches that each
-    # burn a serialized readback slot.  With 2 slots the worker BLOCKS
-    # on the third dispatch and the queue accumulates a full readback
-    # period of arrivals — batch size self-tunes to
-    # arrival_rate x readback_time, and throughput approaches
-    # slots x K / readback (measured 105 -> ~1900 qps at 384 clients).
-    MAX_INFLIGHT = 2
+    # -- lower+dispatch stage -----------------------------------------------
+
+    def _dispatch_loop(self):
+        while True:
+            got = self._dispatch_q.get()
+            if got is None:
+                return  # stop() sentinel
+            index, items, retried = got
+            # Blocks when ``max_inflight`` batches are already in the
+            # pipe — the backpressure that lets the accumulate stage
+            # self-tune batch size under overload.
+            self._inflight.acquire()
+            self.pipeline.add_delta("inflight", 1)
+            if not retried:
+                now = time.monotonic()
+                for it in items:
+                    self.pipeline.record("queue_wait", now - it.t_submit)
+            try:
+                t0 = time.monotonic()
+                dev = self.engine.count_many_async(
+                    index,
+                    [it.call for it in items],
+                    [it.shards for it in items],
+                )
+                self.pipeline.record("lower_dispatch", time.monotonic() - t0)
+            except BaseException as batch_err:  # noqa: BLE001 — the loop
+                # must survive anything; a dead dispatch worker wedges
+                # every later submit at WAIT_TIMEOUT.
+                self.pipeline.add_delta("inflight", -1)
+                self._inflight.release()
+                self._handle_batch_failure(index, items, retried, batch_err)
+                continue
+            self.batches += 1
+            self.batched_queries += len(items)
+            self.pipeline.incr("batches")
+            self.pipeline.incr("batched_queries", len(items))
+            self.pipeline.gauge_max("max_batch_occupancy", len(items))
+            if len(items) >= 2:
+                self._last_fused = time.monotonic()
+            self._collect_q.put((dev, items, time.monotonic()))
+
+    def _handle_batch_failure(self, index, items: List[_Item], retried, batch_err):
+        """One bad tree (unlowerable argument shape, unknown field) must
+        not fail its batchmates — but a serial per-item retry would
+        stall the pipeline for minutes on a 512-item group (each retry
+        pays a full readback).  Instead split FAST: probe each item's
+        LOWERING (host work, no dispatch) to attribute the error, then
+        re-enqueue the survivors as ONE batch (marked ``retried`` so a
+        dispatch-level failure can't loop forever).  The failed group's
+        in-flight slot is released BEFORE this runs — re-enqueueing
+        while holding it would deadlock a depth-1 pipeline."""
+        if retried:
+            for it in items:
+                if it.error is None:
+                    it.error = batch_err
+                it._resolve()
+            return
+        good = []
+        for it in items:
+            try:
+                from .engine import _Lowering
+
+                lw = _Lowering(
+                    self.engine,
+                    self.engine.canonical_shards(it.index),
+                    slot_vector=True,
+                )
+                self.engine._lower(it.index, it.call, lw)
+                good.append(it)
+            except Exception as e:  # noqa: BLE001
+                it.error = e
+                it._resolve()
+        if good and len(good) < len(items):
+            self._dispatch_q.put((index, good, True))
+        else:
+            # Nothing attributable (a dispatch-level failure): fail the
+            # whole group with the batch error.
+            for it in good or items:
+                if it.error is None:
+                    it.error = batch_err
+                it._resolve()
+
+    # -- collect stage ------------------------------------------------------
+
+    def _collect_loop(self):
+        import jax
+        import numpy as np
+
+        while True:
+            got = self._collect_q.get()
+            if got is None:
+                return  # stop() sentinel
+            dev, items, t_dispatched = got
+            try:
+                out = np.asarray(jax.device_get(dev))
+                t_ready = time.monotonic()
+                self.pipeline.record("device_readback", t_ready - t_dispatched)
+                for i, it in enumerate(items):
+                    it.result = int(out[i])
+                self.pipeline.record("decode", time.monotonic() - t_ready)
+            except BaseException as e:  # noqa: BLE001
+                for it in items:
+                    it.error = e
+            finally:
+                self.pipeline.add_delta("inflight", -1)
+                self._inflight.release()
+                for it in items:
+                    it._resolve()
+
+    # -- signatures / telemetry ---------------------------------------------
 
     @staticmethod
     def _signature(index, call) -> tuple:
-        """Batch-group key: index + the call tree with integer literals
+        """Batch-group key: index + the call tree with integer LITERALS
         masked.  Entries of one fused dispatch must share a STRUCTURE
         (field names, operators, nesting) so the padded batch program's
         compile key is independent of which rows/values were asked —
         row ids are traced operands (engine slot vector), so any batch
         of the same signature and tier reuses one executable.
+
+        Only digits in ARGUMENT position (preceded by '=', '(', ',',
+        '[', '<', '>', or whitespace) are masked: digit runs inside
+        identifiers are part of the structure — masking them made
+        ``Row(f1=3)`` and ``Row(f2=3)`` collide into one group, whose
+        mixed field stacks then compiled per-drain programs (silently
+        defeating fixed-tier reuse for digit-bearing field names).
 
         Timestamp literals (segments touching '-'/':'/'T') are NOT
         masked: a time Range lowers to one leaf per covered view, so
@@ -191,84 +439,19 @@ class CountBatcher:
                 return m.group()
             return "#"
 
-        return (index, re.sub(r"\d+", mask, str(call)))
+        return (
+            index,
+            re.sub(r"(?<=[=(,\[<>\s])\d+", mask, str(call)),
+        )
 
-    def _run_batch(self, batch: List[_Item]):
-        # One dispatch per (index, structure) group in the drain
-        # (operand lists are per-index; mixed structures would compile
-        # distinct padded programs, so each structure fuses separately).
-        by_index = {}
-        for it in batch:
-            by_index.setdefault(self._signature(it.index, it.call), []).append(it)
-        for (index, _sig), items in by_index.items():
-            try:
-                self._inflight.acquire()
-                try:
-                    dev = self.engine.count_many_async(
-                        index,
-                        [it.call for it in items],
-                        [it.shards for it in items],
-                    )
-                    # Readback on its own thread: the worker is free to
-                    # drain + dispatch the next batch immediately.  The
-                    # slot is released by _complete; a start() failure
-                    # ("can't start new thread" under load) must release
-                    # it here or the pool drains permanently.
-                    threading.Thread(
-                        target=self._complete, args=(dev, items), daemon=True
-                    ).start()
-                except BaseException:
-                    self._inflight.release()
-                    raise
-                self.batches += 1
-                self.batched_queries += len(items)
-                if len(items) >= 2:
-                    self._last_fused = time.monotonic()
-            except Exception as batch_err:
-                # One bad tree (unlowerable argument shape, unknown
-                # field) must not fail its batchmates — but a serial
-                # per-item retry would stall the worker for minutes on a
-                # 512-item group (each retry pays a full readback).
-                # Instead split FAST: probe each item's LOWERING (host
-                # work, no dispatch) to attribute the error, then
-                # re-dispatch the survivors as ONE batch.
-                good = []
-                for it in items:
-                    try:
-                        from .engine import _Lowering
-
-                        lw = _Lowering(
-                            self.engine,
-                            self.engine.canonical_shards(it.index),
-                            slot_vector=True,
-                        )
-                        self.engine._lower(it.index, it.call, lw)
-                        good.append(it)
-                    except Exception as e:  # noqa: BLE001
-                        it.error = e
-                        it.event.set()
-                if good and len(good) < len(items):
-                    self._run_batch(good)  # one re-dispatch, same path
-                else:
-                    # Nothing attributable (a dispatch-level failure):
-                    # fail the whole group with the batch error.
-                    for it in good or items:
-                        if it.error is None:
-                            it.error = batch_err
-                        it.event.set()
-
-    def _complete(self, dev, items: List[_Item]):
-        import jax
-        import numpy as np
-
-        try:
-            out = np.asarray(jax.device_get(dev))
-            for i, it in enumerate(items):
-                it.result = int(out[i])
-        except BaseException as e:  # noqa: BLE001
-            for it in items:
-                it.error = e
-        finally:
-            self._inflight.release()
-            for it in items:
-                it.event.set()
+    def pipeline_snapshot(self) -> dict:
+        """Stage timings + depth gauges + occupancy, for /debug/vars and
+        bench.py."""
+        snap = self.pipeline.snapshot()
+        snap["depth"] = self.max_inflight
+        snap["batches"] = self.batches
+        snap["batchedQueries"] = self.batched_queries
+        snap["avgOccupancy"] = (
+            round(self.batched_queries / self.batches, 2) if self.batches else 0.0
+        )
+        return snap

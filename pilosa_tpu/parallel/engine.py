@@ -35,7 +35,11 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6 keeps shard_map in experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.view import VIEW_STANDARD, view_bsi_name
@@ -1025,17 +1029,36 @@ class MeshEngine:
             return False
         return all(self.lowerable(ch) for ch in c.children)
 
-    def batched_count(self, index: str, c: Call, shards) -> int:
-        """Count(tree) through the cross-request micro-batcher: lone
-        callers run the plain fused path; concurrent callers drain into
-        one count_batch_tree dispatch (parallel/batcher.py)."""
+    def batcher(self):
+        """The lazily-built cross-request micro-batcher
+        (parallel/batcher.py)."""
         if self._batcher is None:
             with self._batcher_lock:
                 if self._batcher is None:
                     from .batcher import CountBatcher
 
                     self._batcher = CountBatcher(self)
-        return self._batcher.submit(index, c, shards)
+        return self._batcher
+
+    def batched_count(self, index: str, c: Call, shards) -> int:
+        """Count(tree) through the cross-request micro-batcher: lone
+        callers run the plain fused path; concurrent callers drain into
+        one count_batch_tree dispatch (parallel/batcher.py)."""
+        return self.batcher().submit(index, c, shards)
+
+    def batched_count_async(self, index: str, c: Call, shards):
+        """Count(tree) queued into the batcher's bounded pipeline;
+        returns the future (_Item: wait/result/error/add_done_callback)
+        WITHOUT blocking — callers thread completion through instead of
+        parking a thread per in-flight query (the HTTP deferral path)."""
+        return self.batcher().submit_async(index, c, shards)
+
+    def pipeline_snapshot(self):
+        """Batcher pipeline telemetry (None before the first batched
+        query builds the batcher)."""
+        if self._batcher is None:
+            return None
+        return self._batcher.pipeline_snapshot()
 
     def count_many(self, index: str, calls, shards_list) -> List[int]:
         """K Count(tree) queries in ONE fused dispatch + ONE readback

@@ -1,6 +1,12 @@
 from . import stats, tracing
 from .logger import Logger, NopLogger, StandardLogger, VerboseLogger
-from .stats import ExpvarStatsClient, MultiStatsClient, NopStatsClient, StatsClient
+from .stats import (
+    ExpvarStatsClient,
+    MultiStatsClient,
+    NopStatsClient,
+    PipelineStats,
+    StatsClient,
+)
 from .tracing import NopTracer, ProfilerTracer, Span, Tracer
 
 __all__ = [
@@ -10,6 +16,7 @@ __all__ = [
     "NopLogger",
     "NopStatsClient",
     "NopTracer",
+    "PipelineStats",
     "ProfilerTracer",
     "Span",
     "StandardLogger",

@@ -107,6 +107,71 @@ class ExpvarStatsClient(StatsClient):
             }
 
 
+class PipelineStats:
+    """Per-stage telemetry for the pipelined query path
+    (parallel/batcher.py): stage timings (queue wait, lower+dispatch,
+    device+readback, decode), the live/high-water in-flight batch depth,
+    and batch-occupancy counters.  Thread-safe; ``snapshot()`` is what
+    bench.py and /debug/vars surface so the pipeline's fill rate is
+    measurable, not inferred."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # stage -> [count, total_seconds, max_seconds]
+        self._stages: Dict[str, list] = {}
+        self._gauges: Dict[str, float] = {}
+        self._counters: Dict[str, int] = {}
+
+    def record(self, stage: str, seconds: float, n: int = 1):
+        with self._lock:
+            s = self._stages.setdefault(stage, [0, 0.0, 0.0])
+            s[0] += n
+            s[1] += seconds
+            s[2] = max(s[2], seconds)
+
+    def gauge(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_max(self, name: str, value: float):
+        """Keep the high-water mark (e.g. max observed in-flight depth)."""
+        with self._lock:
+            if value > self._gauges.get(name, float("-inf")):
+                self._gauges[name] = value
+
+    def incr(self, name: str, value: int = 1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def add_delta(self, name: str, delta: int):
+        """Adjust a gauge by ``delta`` and track its high-water twin
+        (``<name>_max``) in the same critical section — the pattern for
+        in-flight depth counters."""
+        with self._lock:
+            v = self._gauges.get(name, 0) + delta
+            self._gauges[name] = v
+            if v > self._gauges.get(name + "_max", 0):
+                self._gauges[name + "_max"] = v
+            return v
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            stages = {
+                k: {
+                    "count": c,
+                    "totalSeconds": round(t, 6),
+                    "meanSeconds": round(t / c, 6) if c else 0.0,
+                    "maxSeconds": round(m, 6),
+                }
+                for k, (c, t, m) in self._stages.items()
+            }
+            return {
+                "stages": stages,
+                "gauges": dict(self._gauges),
+                "counters": dict(self._counters),
+            }
+
+
 class MultiStatsClient(StatsClient):
     """Fan out to several backends (stats/stats.go:217-283)."""
 

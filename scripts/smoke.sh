@@ -1,0 +1,13 @@
+#!/bin/sh
+# Fast smoke path for the serving-tier pipeline: the pipeline + batcher +
+# HTTP tests only, non-slow marker, CPU backend — ~40 s, vs ~3 min for
+# the full tier-1 sweep.  Run before/after touching parallel/batcher.py,
+# parallel/engine.py, executor/executor.py, api.py, or net/server.py.
+#
+#   sh scripts/smoke.sh            # pipeline smoke
+#   sh scripts/smoke.sh tests/     # full non-slow suite, same flags
+set -e
+cd "$(dirname "$0")/.."
+TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py}"
+exec env JAX_PLATFORMS=cpu python -m pytest $TARGETS -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly

@@ -612,7 +612,7 @@ def test_remove_node_aborted_job_raises(tmp_path, monkeypatch):
         client.create_field("i", "f")
         client.import_bits("i", "f", 0, [10], [1])
         monkeypatch.setattr(
-            Cluster, "_run_resize", lambda self, old, new: "ABORTED"
+            Cluster, "_run_resize", lambda self, old, new, *a, **kw: "ABORTED"
         )
         with pytest.raises(RuntimeError, match="not removed"):
             h[0].cluster.remove_node("node1")

@@ -18,6 +18,7 @@ def certpair(tmp_path_factory):
     """Self-signed localhost cert via the cryptography package."""
     import datetime as dt
 
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
