@@ -260,6 +260,55 @@ def report_pipeline(eng):
         )
 
 
+def report_observability(api):
+    """Emit the always-on histogram surface (observability tentpole):
+    pipeline-stage and query-op p50/p99 from the process registry — the
+    engine-side latency numbers ROADMAP says the LATENCY axis is judged
+    on — plus a sample trace id so a device-time number can be joined to
+    its span tree at /debug/traces."""
+    from pilosa_tpu.util.stats import (
+        METRIC_PIPELINE_STAGE,
+        METRIC_QUERY,
+        METRIC_QUERY_OP,
+        REGISTRY,
+    )
+
+    for stage in ("queue_wait", "lower_dispatch", "device_readback", "decode"):
+        h = REGISTRY.get_histogram(METRIC_PIPELINE_STAGE, stage=stage)
+        if h is not None and h.count:
+            emit_raw(f"pipeline_{stage}_p50", h.quantile(0.50) * 1e6, "us", 1.0)
+            emit_raw(f"pipeline_{stage}_p99", h.quantile(0.99) * 1e6, "us", 1.0)
+    for path in ("sync", "pipelined"):
+        h = REGISTRY.get_histogram(METRIC_QUERY, path=path)
+        if h is not None and h.count:
+            emit_raw(f"query_{path}_p50", h.quantile(0.50) * 1e6, "us", 1.0)
+            emit_raw(f"query_{path}_p99", h.quantile(0.99) * 1e6, "us", 1.0)
+    h = REGISTRY.get_histogram(METRIC_QUERY_OP, op="Count")
+    if h is not None and h.count:
+        emit_raw("query_op_count_p50", h.quantile(0.50) * 1e6, "us", 1.0)
+    spans = api.tracer.finished_spans() if api is not None else []
+    if spans:
+        s = spans[-1]
+        print(
+            json.dumps(
+                {
+                    "metric": "sample_trace",
+                    "traceID": s.trace_id,
+                    "rootSpan": s.name,
+                    "value": round((s.duration or 0.0) * 1e6, 1),
+                    "unit": "us",
+                    "vs_baseline": 1.0,
+                }
+            ),
+            flush=True,
+        )
+        progress(
+            f"  sample trace {s.trace_id}: {s.name} "
+            f"{(s.duration or 0.0) * 1e3:.2f}ms, {len(s.children)} child spans "
+            f"(join at /debug/traces)"
+        )
+
+
 def main(depth_sweep=False):
     progress("importing jax")
     import jax
@@ -848,6 +897,7 @@ print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
             f"(avg {batcher.batched_queries / batcher.batches:.1f}/batch)"
         )
     report_pipeline(eng)
+    report_observability(api)
     progress(f"http timed ({qps:.1f} qps over {n_total} requests)")
 
     # Mixed-kind QPS (round-4 VERDICT #1): Count + TopN + Sum

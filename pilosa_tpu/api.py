@@ -16,6 +16,8 @@ import time
 from typing import Dict, List, Optional
 
 from . import __version__, pql
+from .util import tracing
+from .util.stats import METRIC_QUERY, REGISTRY
 from .core import timequantum
 from .core.field import FieldOptions
 from .core.fragment import SHARD_WIDTH
@@ -47,6 +49,7 @@ class QueryRequest:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
         remote: bool = False,
+        trace_context=None,
     ):
         self.index = index
         self.query = query
@@ -55,6 +58,10 @@ class QueryRequest:
         self.exclude_row_attrs = exclude_row_attrs
         self.exclude_columns = exclude_columns
         self.remote = remote
+        # Incoming tracing.TraceContext (X-Trace-Id/X-Span-Id headers):
+        # the handler sets it so a remote fan-out joins the caller's
+        # trace instead of rooting a fresh one.
+        self.trace_context = trace_context
 
 
 class ImportRequest:
@@ -112,10 +119,26 @@ class API:
         long_query_time: float = 0.0,
         logger=None,
     ):
-        from .util import NopLogger
+        from .util import NopLogger, Tracer
 
         self.long_query_time = long_query_time
         self.logger = logger if logger is not None else NopLogger()
+        # Tracing is always-on at the serving tier: the default is a
+        # real span tracer (cheap — a few object allocations per query)
+        # so /debug/traces works out of the box; pass a NopTracer to
+        # opt out explicitly.
+        if tracer is None:
+            tracer = Tracer()
+        self.tracer = tracer
+        # Whole-query latency series, registered at boot (so /metrics
+        # always exposes them) with the handles cached — the per-query
+        # path must pay only the per-series lock, not the registry's.
+        self._h_query_sync = REGISTRY.histogram(
+            METRIC_QUERY, help="Whole-query latency (seconds)", path="sync"
+        )
+        self._h_query_pipelined = REGISTRY.histogram(
+            METRIC_QUERY, path="pipelined"
+        )
         self.holder = holder if holder is not None else Holder()
         if not self.holder.opened:
             self.holder.open()
@@ -181,16 +204,24 @@ class API:
             column_attrs=req.column_attrs,
         )
         start = time.monotonic()
-        resp = self.executor.execute(req.index, req.query, req.shards, opt)
-        # Long-query logging (api.go:1021, server LongQueryTime).
+        parent = getattr(req, "trace_context", None)
+        with self.tracer.start_span(
+            "api.Query", parent=parent, index=req.index, remote=req.remote
+        ) as span:
+            resp = self.executor.execute(req.index, req.query, req.shards, opt)
         elapsed = time.monotonic() - start
+        self._h_query_sync.observe(elapsed)
+        if span is not None:
+            resp.trace_id = span.trace_id
+        # Long-query logging (api.go:1021, server LongQueryTime).
         if self.long_query_time and elapsed > self.long_query_time:
             self.logger.printf(
-                "%.3fs > %.1fs: %s %s",
+                "%.3fs > %.1fs: %s %s (trace %s)",
                 elapsed,
                 self.long_query_time,
                 req.index,
                 req.query[:200],
+                span.trace_id if span is not None else "-",
             )
         return resp
 
@@ -208,23 +239,46 @@ class API:
             column_attrs=req.column_attrs,
         )
         start = time.monotonic()
-        fut = self.executor.execute_async(req.index, req.query, req.shards, opt)
+        parent = getattr(req, "trace_context", None)
+        # Deferred span: begun here, finished by the completion callback
+        # on a collect worker.  attach() makes it the submit path's
+        # current span so the batcher items capture it (the explicit
+        # handoff across the pipeline's thread hops).
+        span = self.tracer.begin(
+            "api.Query", parent=parent, index=req.index, pipelined=True
+        )
+        with tracing.attach(span):
+            fut = self.executor.execute_async(
+                req.index, req.query, req.shards, opt
+            )
         if fut is None:
+            # Declined (sync fallback): discard the provisional span —
+            # left attached it would sit unfinished in a live parent's
+            # tree, and query() roots its own span for the retry.
+            if span is not None and span.parent is not None:
+                try:
+                    span.parent.children.remove(span)
+                except ValueError:
+                    pass
             return None
-        if self.long_query_time:
+        fut.trace_span = span
 
-            def _log_long(_f):
-                elapsed = time.monotonic() - start
-                if elapsed > self.long_query_time:
-                    self.logger.printf(
-                        "%.3fs > %.1fs: %s %s",
-                        elapsed,
-                        self.long_query_time,
-                        req.index,
-                        str(req.query)[:200],
-                    )
+        def _finish(_f):
+            elapsed = time.monotonic() - start
+            if span is not None:
+                span.finish()
+            self._h_query_pipelined.observe(elapsed)
+            if self.long_query_time and elapsed > self.long_query_time:
+                self.logger.printf(
+                    "%.3fs > %.1fs: %s %s (trace %s)",
+                    elapsed,
+                    self.long_query_time,
+                    req.index,
+                    str(req.query)[:200],
+                    span.trace_id if span is not None else "-",
+                )
 
-            fut.add_done_callback(_log_long)
+        fut.add_done_callback(_finish)
         return fut
 
     # -- schema (api.go :129-386, 625-687) ---------------------------------

@@ -64,8 +64,10 @@ class Config:
         # Latest-release source for the diagnostics version check
         # (diagnostics.go:102: defaultVersionCheckURL); empty disables.
         self.diagnostics_version_url = ""
-        # tracing
-        self.tracing_sampler_type = "none"  # profiler | span | none
+        # tracing: span tracing is always-on by default (cheap in-memory
+        # span trees feeding /debug/traces); "none" opts out, "profiler"
+        # additionally brackets spans with jax.profiler annotations.
+        self.tracing_sampler_type = "span"  # profiler | span | none
         self.tracing_sampler_param = 0.001
         # translation
         self.translation_primary_url = ""

@@ -26,10 +26,12 @@ Re-design of the reference's fragment (fragment.go:87-2492) for TPU:
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import json
 import os
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -37,6 +39,31 @@ import numpy as np
 from .. import ops
 from ..ops import bitops
 from ..roaring import codec
+from ..util.stats import METRIC_FRAGMENT_OP, REGISTRY
+
+
+def _timed(op: str):
+    """Record the wrapped fragment op's latency in the process metrics
+    registry (pilosa_fragment_op_seconds{op=...}) — the always-on
+    fragment-level histogram surface.  The series handle is resolved
+    ONCE at decoration time so the hot path pays only the per-series
+    histogram lock, never the global registry lock."""
+    hist = REGISTRY.histogram(
+        METRIC_FRAGMENT_OP, help="Fragment-level op latency (seconds)", op=op
+    )
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.monotonic()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                hist.observe(time.monotonic() - t0)
+
+        return wrapper
+
+    return deco
 from . import cache as cache_mod
 from .row import Row
 from .rowstore import RowStore
@@ -374,6 +401,7 @@ class Fragment:
             return self._version, out
 
     @_locked
+    @_timed("set_bit")
     def set_bit(self, row_id: int, column_id: int) -> bool:
         self._check_open()
         if self.mutex:
@@ -417,6 +445,7 @@ class Fragment:
         return True
 
     @_locked
+    @_timed("clear_bit")
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         self._check_open()
         return self._clear_bit(row_id, column_id)
@@ -453,6 +482,7 @@ class Fragment:
         """Host bytes held by row payloads (sparse-economics test hook)."""
         return self._store.nbytes()
 
+    @_timed("row")
     def row(self, row_id: int) -> Row:
         return Row({self.shard: self.device_row(row_id)})
 
@@ -550,6 +580,7 @@ class Fragment:
     # -- bulk import -------------------------------------------------------
 
     @_locked
+    @_timed("bulk_import")
     def bulk_import(
         self,
         row_ids: Iterable[int],
@@ -702,6 +733,7 @@ class Fragment:
         self._touch(row_id)
 
     @_locked
+    @_timed("import_roaring")
     def import_roaring(self, data: bytes, clear: bool = False) -> int:
         """Union (or with ``clear``, subtract) a serialized roaring bitmap
         straight into storage — the fast ingest path

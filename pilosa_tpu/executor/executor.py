@@ -19,10 +19,29 @@ from __future__ import annotations
 
 import datetime as dt
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..util.stats import METRIC_QUERY_OP, REGISTRY
+
+# Per-op histogram handles, cached so the dispatch path never takes the
+# global registry lock (GIL-atomic dict ops; a racing first-call for the
+# same op resolves to the same registry series either way).
+_OP_HISTS: Dict[str, object] = {}
+
+
+def _op_hist(op: str):
+    h = _OP_HISTS.get(op)
+    if h is None:
+        h = _OP_HISTS[op] = REGISTRY.histogram(
+            METRIC_QUERY_OP,
+            help="Per-PQL-op execution latency (seconds)",
+            op=op,
+        )
+    return h
 
 from .. import ops, pql
 from ..parallel.errors import PeerlessMeshError
@@ -215,11 +234,14 @@ class ColumnAttrSet:
 
 
 class QueryResponse:
-    __slots__ = ("results", "column_attr_sets")
+    __slots__ = ("results", "column_attr_sets", "trace_id")
 
     def __init__(self, results=None, column_attr_sets=None):
         self.results = results if results is not None else []
         self.column_attr_sets = column_attr_sets
+        # Stamped by the API layer when tracing is on, surfaced as the
+        # response's "traceID" so clients can join /debug/traces.
+        self.trace_id: Optional[str] = None
 
 
 def _merge_row_ids(a: List[int], b: List[int], limit: int) -> List[int]:
@@ -321,9 +343,11 @@ class _QueryFuture:
         "_callbacks",
         "_pending",
         "_lock",
+        "trace_span",
     )
 
     def __init__(self, executor, index, query, shards, opt, slots, items):
+        self.trace_span = None  # set by api.query_async for stamping
         self._executor = executor
         self._index = index
         self._query = query
@@ -437,6 +461,10 @@ class Executor:
 
         self.stats = stats if stats is not None else NopStatsClient()
         self.tracer = tracer if tracer is not None else NopTracer()
+        # Pre-register the core op series so /metrics exposes it from
+        # boot (Counts routed through the batch pipeline are timed by
+        # the pipeline-stage series, not this one).
+        _op_hist("Count")
         # Parsed-query LRU: a hot query stream re-sends the same PQL text,
         # and for the O(1) small-query path the parse would dominate.
         # Only side-effect-free numeric read queries are cached (string/
@@ -706,12 +734,14 @@ class Executor:
                 while j < n and query.calls[j].name == "Count":
                     j += 1
                 if j - i >= 2:
+                    t0 = time.monotonic()
                     with self.tracer.start_span(
                         "executor.Count", index=index, batch=j - i
                     ):
                         batch = self._mesh_count_many(
                             index, query.calls[i:j], shards, opt
                         )
+                    _op_hist("Count").observe(time.monotonic() - t0)
                     if batch is not None:
                         results.extend(batch)
                     else:
@@ -731,8 +761,12 @@ class Executor:
     # -- dispatch (executor.go executeCall :245-295) -----------------------
 
     def _execute_call(self, index: str, c: Call, shards, opt):
-        with self.tracer.start_span(f"executor.{c.name}", index=index):
-            return self._dispatch_call(index, c, shards, opt)
+        t0 = time.monotonic()
+        try:
+            with self.tracer.start_span(f"executor.{c.name}", index=index):
+                return self._dispatch_call(index, c, shards, opt)
+        finally:
+            _op_hist(c.name).observe(time.monotonic() - t0)
 
     def _dispatch_call(self, index: str, c: Call, shards, opt):
         self._validate_call_args(c)
@@ -836,9 +870,12 @@ class Executor:
                     result = reduce_fn(result, map_fn(shard))
                 continue
             try:
-                doc = self.cluster.client(node).query(
-                    index, str(call), shards=node_shards, remote=True
-                )
+                with self.tracer.start_span(
+                    "executor.RemoteQuery", node=node_id, shards=len(node_shards)
+                ):
+                    doc = self.cluster.client(node).query(
+                        index, str(call), shards=node_shards, remote=True
+                    )
             except Exception:
                 # Retry this node's shards on other replicas.
                 self.cluster.node_failed(node_id)

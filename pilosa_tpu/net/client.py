@@ -13,6 +13,8 @@ from typing import List, Optional
 from urllib.error import HTTPError, URLError
 from urllib.request import Request, urlopen
 
+from ..util import tracing
+
 
 class ClientError(Exception):
     """HTTP client failure.  ``code`` carries the response status (None
@@ -53,11 +55,17 @@ class InternalClient:
         content_type: str = "application/json",
         raw: bool = False,
     ):
+        headers = {"Content-Type": content_type} if body is not None else {}
+        # Propagate the ambient trace context (trace id + this hop's
+        # span id) so a remote shard fan-out joins the caller's trace —
+        # the wire half of the explicit capture/attach protocol in
+        # util.tracing.
+        tracing.inject_headers(headers)
         req = Request(
             self.uri + path,
             data=body,
             method=method,
-            headers={"Content-Type": content_type} if body is not None else {},
+            headers=headers,
         )
         try:
             with urlopen(

@@ -22,7 +22,10 @@ from ..api import API, ApiError, ImportRequest, ImportValueRequest, NotFoundErro
 from ..executor.executor import Error as ExecError, FieldNotFoundError, IndexNotFoundError
 from ..executor.translate import TranslateError
 from ..pql import ParseError
+from ..util.stats import REGISTRY
 from .wire import response_to_json
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class DeferredResponse:
@@ -131,7 +134,9 @@ class Handler:
         r("POST", "/cluster/resize/abort", self._resize_abort)
         r("POST", "/cluster/resize/remove-node", self._remove_node)
         r("POST", "/cluster/resize/set-coordinator", self._set_coordinator)
+        r("GET", "/metrics", self._metrics)
         r("GET", "/debug/vars", self._debug_vars)
+        r("GET", "/debug/traces", self._debug_traces)
         r("GET", "/debug/pprof", self._debug_pprof)
         r("GET", "/debug/pprof/goroutine", self._debug_pprof)
         r("GET", "/debug/pprof/profile", self._debug_pprof_profile)
@@ -230,6 +235,8 @@ class Handler:
                 return status, "application/json", payload
             if isinstance(result, DeferredResponse):
                 return result
+            if isinstance(result, tuple) and len(result) == 3:
+                return result  # (status, content-type, payload bytes)
             if isinstance(result, bytes):
                 return 200, "application/octet-stream", result
             if isinstance(result, str):
@@ -366,6 +373,12 @@ class Handler:
             exclude_columns=_qbool(q, "excludeColumns")
             or doc.get("excludeColumns", False),
             remote=_qbool(q, "remote") or doc.get("remote", False),
+            # Join the caller's trace when the request carries one
+            # (X-Trace-Id from a coordinator's shard fan-out, or an
+            # external client propagating its own trace).
+            trace_context=self.api.tracer.extract_headers(
+                kw.get("_headers", {})
+            ),
         )
         fut = self.api.query_async(req)
         if fut is not None:
@@ -376,17 +389,22 @@ class Handler:
 
             def _done(f):
                 try:
-                    payload = json.dumps(
-                        response_to_json(f.result(0))
-                    ).encode()
-                    d.resolve(200, "application/json", payload)
+                    out = response_to_json(f.result(0))
+                    span = getattr(f, "trace_span", None)
+                    if span is not None:
+                        out["traceID"] = span.trace_id
+                    d.resolve(200, "application/json", json.dumps(out).encode())
                 except Exception as e:  # noqa: BLE001
                     status, payload = error_response(e)
                     d.resolve(status, "application/json", payload)
 
             fut.add_done_callback(_done)
             return d
-        return response_to_json(self.api.query(req))
+        resp = self.api.query(req)
+        out = response_to_json(resp)
+        if getattr(resp, "trace_id", None):
+            out["traceID"] = resp.trace_id
+        return out
 
     def _post_import(self, q, b, *, index, field, **kw):
         doc = json.loads(b)
@@ -458,6 +476,35 @@ class Handler:
         old, new = self.api.set_coordinator(doc.get("id", ""))
         return {"old": old, "new": new}
 
+    def _metrics(self, q, b, **kw):
+        """GET /metrics: the process registry (latency histograms per
+        pipeline stage / query op / fragment op, counters, gauges) in
+        Prometheus text exposition format."""
+        # Fold the live pipeline gauges in so scrape-time depth/occupancy
+        # need no separate surface.
+        eng = getattr(self.api, "mesh_engine", None)
+        if eng is not None and hasattr(eng, "pipeline_snapshot"):
+            snap = eng.pipeline_snapshot()
+            if snap is not None:
+                REGISTRY.set_gauge(
+                    "pilosa_pipeline_depth_configured", snap.get("depth", 0)
+                )
+                for name, value in snap.get("gauges", {}).items():
+                    REGISTRY.set_gauge("pilosa_pipeline_" + name, value)
+                REGISTRY.set_gauge(
+                    "pilosa_pipeline_batches_total", snap.get("batches", 0)
+                )
+        return 200, PROMETHEUS_CONTENT_TYPE, REGISTRY.prometheus_text().encode()
+
+    def _debug_traces(self, q, b, **kw):
+        """GET /debug/traces: recent + slow span trees (JSON), each node
+        carrying traceID/spanID/parentSpanID — the join surface for the
+        traceID stamped into query responses and the long-query log."""
+        tracer = getattr(self.api, "tracer", None)
+        if tracer is None or not hasattr(tracer, "traces"):
+            return {"recent": [], "slow": []}
+        return tracer.traces()
+
     def _debug_vars(self, q, b, **kw):
         stats = getattr(self.api.executor, "stats", None)
         out = (
@@ -472,6 +519,9 @@ class Handler:
             snap = eng.pipeline_snapshot()
             if snap is not None:
                 out["pipeline"] = snap
+        # The histogram registry's JSON view: same data /metrics serves,
+        # merged here so one curl shows counters + stages + quantiles.
+        out["metrics"] = REGISTRY.snapshot()
         return out
 
     def _debug_pprof(self, q, b, **kw):

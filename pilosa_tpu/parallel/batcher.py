@@ -53,6 +53,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..util import tracing
 from ..util.stats import PipelineStats
 
 
@@ -60,7 +61,9 @@ class _Item:
     """One submitted Count: a future resolved by the collect stage (or
     inline on the direct path).  ``add_done_callback`` lets the HTTP
     layer resolve a pending response without parking a thread in
-    ``wait``."""
+    ``wait``.  The submitter's current span is captured here — the
+    explicit trace handoff across the accumulate/dispatch/collect
+    thread hops (stage workers stamp their timings onto it)."""
 
     __slots__ = (
         "index",
@@ -70,6 +73,7 @@ class _Item:
         "result",
         "error",
         "t_submit",
+        "span",
         "_callbacks",
     )
 
@@ -81,6 +85,7 @@ class _Item:
         self.result: Optional[int] = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
+        self.span = tracing.current_span()
         self._callbacks: List[Callable] = []
 
     def done(self) -> bool:
@@ -316,6 +321,12 @@ class CountBatcher:
                 now = time.monotonic()
                 for it in items:
                     self.pipeline.record("queue_wait", now - it.t_submit)
+                    if it.span is not None:
+                        it.span.record(
+                            "pipeline.queue_wait",
+                            start=it.t_submit,
+                            duration=now - it.t_submit,
+                        )
             try:
                 t0 = time.monotonic()
                 dev = self.engine.count_many_async(
@@ -323,7 +334,16 @@ class CountBatcher:
                     [it.call for it in items],
                     [it.shards for it in items],
                 )
-                self.pipeline.record("lower_dispatch", time.monotonic() - t0)
+                t1 = time.monotonic()
+                self.pipeline.record("lower_dispatch", t1 - t0)
+                for it in items:
+                    if it.span is not None:
+                        it.span.record(
+                            "pipeline.lower_dispatch",
+                            start=t0,
+                            duration=t1 - t0,
+                            batch=len(items),
+                        )
             except BaseException as batch_err:  # noqa: BLE001 — the loop
                 # must survive anything; a dead dispatch worker wedges
                 # every later submit at WAIT_TIMEOUT.
@@ -398,7 +418,20 @@ class CountBatcher:
                 self.pipeline.record("device_readback", t_ready - t_dispatched)
                 for i, it in enumerate(items):
                     it.result = int(out[i])
-                self.pipeline.record("decode", time.monotonic() - t_ready)
+                t_done = time.monotonic()
+                self.pipeline.record("decode", t_done - t_ready)
+                for it in items:
+                    if it.span is not None:
+                        it.span.record(
+                            "pipeline.device_readback",
+                            start=t_dispatched,
+                            duration=t_ready - t_dispatched,
+                        )
+                        it.span.record(
+                            "pipeline.decode",
+                            start=t_ready,
+                            duration=t_done - t_ready,
+                        )
             except BaseException as e:  # noqa: BLE001
                 for it in items:
                     it.error = e

@@ -88,7 +88,15 @@ class Server:
         if t == "profiler":
             return ProfilerTracer()
         if t == "span":
-            return Tracer(keep_finished=64)
+            # The default: always-on span tracer with the recent + slow
+            # /debug/traces rings enabled out of the box.
+            return Tracer()
+        # "none" — and any unrecognized value: an operator's typo for
+        # "none" must not silently enable span retention.
+        if t not in ("none", "nop", ""):
+            self.logger.printf(
+                "unknown tracing.sampler-type %r: tracing disabled", t
+            )
         return NopTracer()
 
     def _load_node_id(self) -> str:

@@ -1,26 +1,33 @@
 from . import stats, tracing
 from .logger import Logger, NopLogger, StandardLogger, VerboseLogger
 from .stats import (
+    REGISTRY,
     ExpvarStatsClient,
+    Histogram,
+    MetricsRegistry,
     MultiStatsClient,
     NopStatsClient,
     PipelineStats,
     StatsClient,
 )
-from .tracing import NopTracer, ProfilerTracer, Span, Tracer
+from .tracing import NopTracer, ProfilerTracer, Span, TraceContext, Tracer
 
 __all__ = [
     "ExpvarStatsClient",
+    "Histogram",
     "Logger",
+    "MetricsRegistry",
     "MultiStatsClient",
     "NopLogger",
     "NopStatsClient",
     "NopTracer",
     "PipelineStats",
     "ProfilerTracer",
+    "REGISTRY",
     "Span",
     "StandardLogger",
     "StatsClient",
+    "TraceContext",
     "Tracer",
     "VerboseLogger",
     "stats",

@@ -9,8 +9,271 @@ default.
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+
+# Fixed log-spaced latency buckets (seconds), 100 us .. 60 s: wide enough
+# for the O(1) cardinality lane at the bottom and a wedged collective at
+# the top.  Fixed buckets (not reservoirs) keep observe() O(log B) with
+# bounded memory — the always-on requirement.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with Prometheus-style cumulative
+    export and linear-interpolation quantile estimation.  Thread-safe;
+    observe() is a bisect + one locked increment."""
+
+    __slots__ = ("buckets", "_counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def counts(self) -> List[int]:
+        with self._lock:
+            return list(self._counts)
+
+    def export(self) -> Tuple[List[int], float, int]:
+        """One consistent (counts, sum, count) triple taken under the
+        lock — the Prometheus exposition must not mix bucket counts from
+        one instant with a _count from another (le="+Inf" == _count is
+        an invariant consumers validate)."""
+        with self._lock:
+            return list(self._counts), self.sum, self.count
+
+    def cumulative(self) -> List[int]:
+        """Cumulative per-bucket counts (Prometheus ``le`` semantics):
+        entry i counts observations <= buckets[i]; the final entry is
+        the total (le="+Inf")."""
+        out = []
+        total = 0
+        for c in self.counts():
+            total += c
+            out.append(total)
+        return out
+
+    def _quantile_of(self, counts: List[int], total: int, q: float) -> float:
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c > 0:
+                hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                frac = (rank - (cum - c)) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        return self.buckets[-1]
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0..1) by linear interpolation inside
+        the containing bucket — the standard Prometheus histogram_quantile
+        estimate.  Returns 0.0 on an empty histogram; observations in
+        the +Inf bucket clamp to the top finite bound."""
+        counts, _, total = self.export()
+        return self._quantile_of(counts, total, q)
+
+    def snapshot(self) -> dict:
+        counts, total_sum, count = self.export()  # one consistent view
+        return {
+            "count": count,
+            "sumSeconds": round(total_sum, 6),
+            "meanSeconds": round(total_sum / count, 6) if count else 0.0,
+            "p50": round(self._quantile_of(counts, count, 0.50), 6),
+            "p95": round(self._quantile_of(counts, count, 0.95), 6),
+            "p99": round(self._quantile_of(counts, count, 0.99), 6),
+        }
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_float(v: float) -> str:
+    """Prometheus number formatting: shortest round-trippable text."""
+    return f"{v:.10g}"
+
+
+class MetricsRegistry:
+    """Name + labels -> histogram/counter/gauge, exported as Prometheus
+    text (the /metrics surface) and as a JSON snapshot (merged into
+    /debug/vars).  Label sets are sorted tuples so label order never
+    splits a series."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {sorted-label-tuple: Histogram}
+        self._hists: Dict[str, Dict[tuple, Histogram]] = {}
+        self._counters: Dict[str, Dict[tuple, float]] = {}
+        self._gauges: Dict[str, Dict[tuple, float]] = {}
+        self._help: Dict[str, str] = {}
+
+    @staticmethod
+    def _labelkey(labels: dict) -> tuple:
+        return tuple(sorted(labels.items()))
+
+    def histogram(self, name: str, help: str = "", **labels) -> Histogram:
+        """Get-or-create the histogram series (registering it makes the
+        series visible at /metrics even before the first observation)."""
+        key = self._labelkey(labels)
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            series = self._hists.setdefault(name, {})
+            h = series.get(key)
+            if h is None:
+                h = series[key] = Histogram()
+            return h
+
+    def observe(self, name: str, seconds: float, **labels):
+        self.histogram(name, **labels).observe(seconds)
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        key = self._labelkey(labels)
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        key = self._labelkey(labels)
+        with self._lock:
+            self._gauges.setdefault(name, {})[key] = value
+
+    def get_histogram(self, name: str, **labels) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(name, {}).get(self._labelkey(labels))
+
+    @staticmethod
+    def _fmt_labels(key: tuple, extra: str = "") -> str:
+        def esc(v) -> str:
+            return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+        parts = [f'{_prom_name(k)}="{esc(v)}"' for k, v in key]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def prometheus_text(self) -> str:
+        """The whole registry in Prometheus text exposition format."""
+        with self._lock:
+            hists = {n: dict(s) for n, s in self._hists.items()}
+            counters = {n: dict(s) for n, s in self._counters.items()}
+            gauges = {n: dict(s) for n, s in self._gauges.items()}
+            helps = dict(self._help)
+        lines: List[str] = []
+        for name in sorted(hists):
+            pname = _prom_name(name)
+            lines.append(f"# HELP {pname} {helps.get(name, name)}")
+            lines.append(f"# TYPE {pname} histogram")
+            for key in sorted(hists[name]):
+                h = hists[name][key]
+                counts, h_sum, h_count = h.export()  # one consistent view
+                cum, running = [], 0
+                for c in counts:
+                    running += c
+                    cum.append(running)
+                for i, bound in enumerate(h.buckets):
+                    le = self._fmt_labels(key, f'le="{_prom_float(bound)}"')
+                    lines.append(f"{pname}_bucket{le} {cum[i]}")
+                le = self._fmt_labels(key, 'le="+Inf"')
+                lines.append(f"{pname}_bucket{le} {cum[-1]}")
+                lbl = self._fmt_labels(key)
+                lines.append(f"{pname}_sum{lbl} {_prom_float(h_sum)}")
+                lines.append(f"{pname}_count{lbl} {h_count}")
+        for name in sorted(counters):
+            pname = _prom_name(name)
+            lines.append(f"# HELP {pname} {helps.get(name, name)}")
+            lines.append(f"# TYPE {pname} counter")
+            for key in sorted(counters[name]):
+                lbl = self._fmt_labels(key)
+                lines.append(f"{pname}{lbl} {_prom_float(counters[name][key])}")
+        for name in sorted(gauges):
+            pname = _prom_name(name)
+            lines.append(f"# HELP {pname} {helps.get(name, name)}")
+            lines.append(f"# TYPE {pname} gauge")
+            for key in sorted(gauges[name]):
+                lbl = self._fmt_labels(key)
+                lines.append(f"{pname}{lbl} {_prom_float(gauges[name][key])}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON view (histograms as count/sum/quantiles) for /debug/vars."""
+        with self._lock:
+            hists = {n: dict(s) for n, s in self._hists.items()}
+            counters = {n: dict(s) for n, s in self._counters.items()}
+            gauges = {n: dict(s) for n, s in self._gauges.items()}
+
+        def label_str(key: tuple) -> str:
+            return ",".join(f"{k}={v}" for k, v in key) or "_"
+
+        return {
+            "histograms": {
+                n: {label_str(k): h.snapshot() for k, h in s.items()}
+                for n, s in hists.items()
+            },
+            "counters": {
+                n: {label_str(k): v for k, v in s.items()}
+                for n, s in counters.items()
+            },
+            "gauges": {
+                n: {label_str(k): v for k, v in s.items()}
+                for n, s in gauges.items()
+            },
+        }
+
+
+# The process-wide metrics registry: always-on, exported at GET /metrics
+# and merged into /debug/vars.  Series names:
+#   pilosa_query_seconds{path=...}          whole-query latency
+#   pilosa_query_op_seconds{op=...}         per-PQL-op latency
+#   pilosa_pipeline_stage_seconds{stage=...} batch-pipeline stage latency
+#   pilosa_fragment_op_seconds{op=...}      fragment-level op latency
+REGISTRY = MetricsRegistry()
+
+METRIC_QUERY = "pilosa_query_seconds"
+METRIC_QUERY_OP = "pilosa_query_op_seconds"
+METRIC_PIPELINE_STAGE = "pilosa_pipeline_stage_seconds"
+METRIC_FRAGMENT_OP = "pilosa_fragment_op_seconds"
+
+PIPELINE_STAGES = ("queue_wait", "lower_dispatch", "device_readback", "decode")
+
+# Pre-register the always-on surface so /metrics exposes every required
+# series (with zero counts) from process start — scrape checks must not
+# depend on traffic having flowed first.
+for _stage in PIPELINE_STAGES:
+    REGISTRY.histogram(
+        METRIC_PIPELINE_STAGE,
+        help="Batch-pipeline stage latency (seconds)",
+        stage=_stage,
+    )
+REGISTRY.histogram(
+    METRIC_FRAGMENT_OP, help="Fragment-level op latency (seconds)", op="row"
+)
+del _stage
 
 
 class StatsClient:
@@ -85,8 +348,13 @@ class ExpvarStatsClient(StatsClient):
             self._root["gauges"][self._scope(name)] = value
 
     def histogram(self, name, value: float, rate: float = 1.0):
+        # Fixed-bucket Histogram, not an unbounded list: timing series
+        # on a serving tier grow forever otherwise.
         with self._root["lock"]:
-            self._root["timings"].setdefault(self._scope(name), []).append(value)
+            h = self._root["timings"].get(self._scope(name))
+            if h is None:
+                h = self._root["timings"][self._scope(name)] = Histogram()
+        h.observe(value)
 
     def set(self, name, value: str, rate: float = 1.0):
         with self._root["lock"]:
@@ -97,13 +365,13 @@ class ExpvarStatsClient(StatsClient):
 
     def snapshot(self) -> Dict[str, dict]:
         with self._root["lock"]:
+            timings = dict(self._root["timings"])
             return {
                 "counters": dict(self._root["counters"]),
                 "gauges": dict(self._root["gauges"]),
                 "sets": dict(self._root["sets"]),
-                "timingCounts": {
-                    k: len(v) for k, v in self._root["timings"].items()
-                },
+                "timingCounts": {k: h.count for k, h in timings.items()},
+                "timings": {k: h.snapshot() for k, h in timings.items()},
             }
 
 
@@ -121,6 +389,14 @@ class PipelineStats:
         self._stages: Dict[str, list] = {}
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[str, int] = {}
+        # stage -> per-instance Histogram (quantiles in snapshot());
+        # observations also land in the process REGISTRY for /metrics.
+        # Registry handles are cached per stage: resolving through
+        # REGISTRY.observe would take the process-global registry lock
+        # on every record() — a contention point on the per-item
+        # queue_wait path.
+        self._hists: Dict[str, Histogram] = {}
+        self._reg_hists: Dict[str, Histogram] = {}
 
     def record(self, stage: str, seconds: float, n: int = 1):
         with self._lock:
@@ -128,6 +404,16 @@ class PipelineStats:
             s[0] += n
             s[1] += seconds
             s[2] = max(s[2], seconds)
+            h = self._hists.get(stage)
+            if h is None:
+                h = self._hists[stage] = Histogram()
+            rh = self._reg_hists.get(stage)
+            if rh is None:
+                rh = self._reg_hists[stage] = REGISTRY.histogram(
+                    METRIC_PIPELINE_STAGE, stage=stage
+                )
+        h.observe(seconds)
+        rh.observe(seconds)
 
     def gauge(self, name: str, value: float):
         with self._lock:
@@ -165,11 +451,20 @@ class PipelineStats:
                 }
                 for k, (c, t, m) in self._stages.items()
             }
-            return {
-                "stages": stages,
-                "gauges": dict(self._gauges),
-                "counters": dict(self._counters),
-            }
+            hists = dict(self._hists)
+            gauges = dict(self._gauges)
+            counters = dict(self._counters)
+        for k, h in hists.items():
+            if k in stages:
+                snap = h.snapshot()
+                stages[k]["p50Seconds"] = snap["p50"]
+                stages[k]["p95Seconds"] = snap["p95"]
+                stages[k]["p99Seconds"] = snap["p99"]
+        return {
+            "stages": stages,
+            "gauges": gauges,
+            "counters": counters,
+        }
 
 
 class MultiStatsClient(StatsClient):

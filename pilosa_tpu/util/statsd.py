@@ -63,7 +63,13 @@ class StatsdClient(StatsClient):
         self._emit(name, value, "s", rate)
 
     def timing(self, name, value_seconds: float, rate: float = 1.0):
-        self._emit(name, int(value_seconds * 1e3), "ms", rate)
+        # Callers pass SECONDS (the StatsClient contract); DogStatsD's
+        # |ms type expects milliseconds — convert at this emit boundary.
+        # Sub-millisecond timings keep their fraction (int() truncated a
+        # 500 us timing to "0|ms", erasing the whole engine tier).
+        ms = value_seconds * 1e3
+        value = int(ms) if ms == int(ms) else round(ms, 3)
+        self._emit(name, value, "ms", rate)
 
     def close(self):
         self._sock.close()

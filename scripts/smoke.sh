@@ -1,13 +1,81 @@
 #!/bin/sh
 # Fast smoke path for the serving-tier pipeline: the pipeline + batcher +
-# HTTP tests only, non-slow marker, CPU backend — ~40 s, vs ~3 min for
-# the full tier-1 sweep.  Run before/after touching parallel/batcher.py,
-# parallel/engine.py, executor/executor.py, api.py, or net/server.py.
+# HTTP + observability tests only, non-slow marker, CPU backend — ~1 min,
+# vs ~3 min for the full tier-1 sweep.  Run before/after touching
+# parallel/batcher.py, parallel/engine.py, executor/executor.py, api.py,
+# net/server.py, or util/{stats,tracing}.py.
 #
-#   sh scripts/smoke.sh            # pipeline smoke
+#   sh scripts/smoke.sh            # pipeline + observability smoke
 #   sh scripts/smoke.sh tests/     # full non-slow suite, same flags
 set -e
 cd "$(dirname "$0")/.."
-TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py}"
-exec env JAX_PLATFORMS=cpu python -m pytest $TARGETS -q -m 'not slow' \
+TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_observability.py}"
+env JAX_PLATFORMS=cpu python -m pytest $TARGETS -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Metrics smoke: boot a real server stack, run a query, scrape /metrics,
+# and FAIL if the required query/pipeline series are missing — the guard
+# that keeps the Prometheus surface wired end to end.
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import urllib.request
+
+from pilosa_tpu.api import API
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.net import serve
+from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+holder = Holder()
+holder.open()
+idx = holder.create_index("smoke")
+f = idx.create_field("f")
+f.import_bulk([1, 1, 1], [0, 5, 9])
+eng = MeshEngine(holder, make_mesh(1))
+api = API(holder=holder, mesh_engine=eng)
+srv, _ = serve(api, port=0)
+port = srv.server_address[1]
+
+req = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/query",
+    data=b"Count(Row(f=1))",
+    method="POST",
+)
+doc = json.loads(urllib.request.urlopen(req, timeout=60).read())
+assert doc["results"][0] == 3, doc
+assert "traceID" in doc, f"query response carries no traceID: {doc}"
+
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+required = [
+    "pilosa_query_seconds_bucket",
+    "pilosa_query_op_seconds_bucket",
+    "pilosa_pipeline_stage_seconds_bucket",
+    "pilosa_fragment_op_seconds_bucket",
+]
+missing = [s for s in required if s not in text]
+assert not missing, f"/metrics is missing required series: {missing}"
+assert 'le="+Inf"' in text, "histogram export lacks the +Inf bucket"
+
+# The root span registers from a completion callback moments after the
+# response is written; poll briefly instead of racing it.
+import time
+
+deadline = time.monotonic() + 10
+while True:
+    traces = json.loads(
+        urllib.request.urlopen(
+            f"http://localhost:{port}/debug/traces", timeout=30
+        ).read()
+    )
+    assert "recent" in traces and "slow" in traces, traces
+    if any(t["traceID"] == doc["traceID"] for t in traces["recent"]):
+        break
+    assert time.monotonic() < deadline, (
+        "query's traceID not found in /debug/traces"
+    )
+    time.sleep(0.05)
+
+srv.shutdown()
+print("observability smoke OK: /metrics + /debug/traces wired")
+EOF
