@@ -1029,6 +1029,142 @@ def __rand(rng, words64):
     )
 
 
+# ---- sparsity: density sweep + result-memo shape (--density-sweep) -------
+
+SWEEP_SHARDS = 64
+SWEEP_BLOCKS = (1, 2, 6, 32)  # occupied occupancy-blocks per row (of 64)
+SWEEP_REPS = 16
+
+
+def density_sweep():
+    """Sparse-row shapes at ~0.78%/1.6%/4.7%/25% bit density (1/2/6/32
+    half-filled occupancy blocks of 64 — block-clustered, the
+    distribution roaring exists for): each shape is
+    counted through the occupancy-guided sparse path AND the dense
+    sweep on the SAME data, emitting per-shape ``*_p50``,
+    ``implied_gbs``, ``bytes_skipped``, and the speedup — plus a
+    repeated-query shape that exercises the versioned result memo
+    (hits > 0, device dispatch count flat).  Standalone build (~64
+    shards); lines join the main bench's JSONL stream format, so
+    scripts/bench_guard.py diffs them like any other metric."""
+    progress("importing jax (density sweep)")
+    import jax
+
+    from pilosa_tpu import pql
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.ops import bitops
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+    rng = np.random.default_rng(7)
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("sweep")
+    f = idx.create_field("sf")
+    view = f.view_if_not_exists("standard")
+
+    host = {}  # row -> {shard: words}
+    shards = list(range(SWEEP_SHARDS))
+    for k, nb in enumerate(SWEEP_BLOCKS):
+        for r in (2 * k, 2 * k + 1):
+            host[r] = {}
+            for s in shards:
+                words = np.zeros(bitops.WORDS64, dtype=np.uint64)
+                # Half-fill the first nb occupancy blocks: block-level
+                # clustering with realistic in-block density (measured
+                # ~55% — __rand is ~74% dense, the AND of two ~55% — so
+                # the d-labels' /2 assumption is accurate to ~10%).
+                w64_per_block = bitops.OCC_BLOCK_WORDS // 2
+                blk = __rand(rng, nb * w64_per_block) & __rand(
+                    rng, nb * w64_per_block
+                )
+                words[: nb * w64_per_block] = blk
+                view.fragment_if_not_exists(s).load_row_words(r, words)
+                host[r][s] = words
+    for frag in view.fragments.values():
+        frag.cache.invalidate()
+    progress("sweep build done")
+
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh)
+    eng_dense = MeshEngine(holder, mesh)
+    eng_dense.sparse_enabled = False
+
+    def pc(x):
+        return int(np.sum(np.bitwise_count(x)))
+
+    memo_call = None
+    for k, nb in enumerate(SWEEP_BLOCKS):
+        ra, rb = 2 * k, 2 * k + 1
+        call = pql.parse(f"Intersect(Row(sf={ra}), Row(sf={rb}))").calls[0]
+        if memo_call is None:
+            memo_call = call
+        want = sum(pc(host[ra][s] & host[rb][s]) for s in shards)
+        c_cpu = cpu_time(
+            lambda: sum(pc(host[ra][s] & host[rb][s]) for s in shards)
+        )
+        density = nb * bitops.OCC_BLOCK_BITS / 2 / (1 << 20)
+        label = f"d{density * 100:.2g}pct"
+        dense_bytes = 2 * SWEEP_SHARDS * ROW_BYTES
+
+        # Memo off while timing: every rep must really dispatch.
+        eng.result_memo.maxsize = 0
+        eng_dense.result_memo.maxsize = 0
+        skipped0 = eng.device_bytes_skipped
+        got = eng.count("sweep", call, shards)
+        assert got == want, (label, got, want)
+        per_query_skipped = eng.device_bytes_skipped - skipped0
+        sparse_bytes = dense_bytes - per_query_skipped
+        assert eng_dense.count("sweep", call, shards) == want
+
+        t_sparse, _ = device_p50(
+            lambda i: eng.count_async("sweep", call, shards), reps=SWEEP_REPS
+        )
+        t_dense, _ = device_p50(
+            lambda i: eng_dense.count_async("sweep", call, shards),
+            reps=SWEEP_REPS,
+        )
+        emit(f"sparse_count_{label}_p50", t_sparse, c_cpu,
+             bytes_read=max(sparse_bytes, 1))
+        emit(f"dense_count_{label}_p50", t_dense, c_cpu,
+             bytes_read=dense_bytes)
+        print(json.dumps({
+            "metric": f"sparse_count_{label}_bytes_skipped",
+            "value": per_query_skipped,
+            "unit": "bytes",
+            "vs_baseline": round(dense_bytes / max(sparse_bytes, 1), 2),
+        }), flush=True)
+        emit_raw(f"sparse_speedup_{label}", t_dense / t_sparse, "x",
+                 t_dense / t_sparse)
+        progress(
+            f"{label}: sparse {t_sparse * 1e6:.1f}us dense "
+            f"{t_dense * 1e6:.1f}us skipped {per_query_skipped} B/query"
+        )
+
+    # Repeated-query shape: the versioned result memo answers replays
+    # with NO device dispatch — hits advance, dispatches stay flat.
+    eng.result_memo.maxsize = 4096
+    base = eng.count("sweep", memo_call, shards)  # miss: populates
+    hits0, disp0 = eng.result_memo.hits, eng.fused_dispatches
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        assert eng.count("sweep", memo_call, shards) == base
+    t_memo = (time.perf_counter() - t0) / reps
+    hits = eng.result_memo.hits - hits0
+    dispatched = eng.fused_dispatches - disp0
+    assert hits == reps and dispatched == 0, (hits, dispatched)
+    ra, rb = 0, 1
+    c_cpu = cpu_time(lambda: sum(pc(host[ra][s] & host[rb][s]) for s in shards))
+    emit("repeated_count_memo_p50", t_memo, c_cpu)
+    emit_raw("result_memo_hits", hits, "hits", 1.0)
+    emit_raw("result_memo_dispatches", dispatched, "dispatches", 1.0)
+    snap = eng.cache_snapshot()
+    progress(
+        f"memo shape: {hits} hits, {dispatched} dispatches, "
+        f"bytes_skipped_total={snap['deviceBytesSkipped']}"
+    )
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -1039,5 +1175,16 @@ if __name__ == "__main__":
         help="also sweep the batch pipeline's in-flight depth (1/2/4/8) "
         "and emit http_count_qps_depthN lines (the QPS-vs-depth curve)",
     )
+    ap.add_argument(
+        "--density-sweep",
+        action="store_true",
+        help="run the sparsity density sweep + result-memo shape ONLY "
+        "(standalone ~64-shard build; emits sparse/dense *_p50, "
+        "bytes_skipped, speedup, and memo-hit lines in the same JSONL "
+        "format — docs/sparsity.md)",
+    )
     args = ap.parse_args()
-    main(depth_sweep=args.depth_sweep)
+    if args.density_sweep:
+        density_sweep()
+    else:
+        main(depth_sweep=args.depth_sweep)

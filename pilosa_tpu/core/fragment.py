@@ -375,10 +375,15 @@ class Fragment:
         rebuild required; ordinary writes and bulk imports of ANY size
         are covered by the per-row log.
 
-        Each dirty row maps to either ``("row", words)`` (full uint32
-        row) or ``("words", widxs, vals)`` — just the changed device
-        words, when the word log covers the span (point writes sync as
-        a few bytes instead of 128 KiB/row)."""
+        Each dirty row maps to either ``("row", words, occ)`` (full
+        uint32 row) or ``("words", widxs, vals, occ)`` — just the
+        changed device words, when the word log covers the span (point
+        writes sync as a few bytes instead of 128 KiB/row).  ``occ`` is
+        the row's EXACT block-occupancy bitmap (bitops.occupancy64),
+        read under the same lock as the words so the engine's stack
+        occupancy summary can never disagree with the words it ships —
+        an occupancy false-negative would make the block-skipping
+        kernels silently drop set bits (docs/sparsity.md)."""
         with self._mu:
             if version >= self._version:
                 return self._version, {}
@@ -388,16 +393,17 @@ class Fragment:
             for r, rv in self._mutlog.items():
                 if rv <= version:
                     continue
+                occ = self._store.occupancy64(r)
                 wlog = self._word_log.get(r)
                 if version < self._word_floor.get(r, 0) or wlog is None:
-                    out[r] = ("row", self.row_words(r))
+                    out[r] = ("row", self.row_words(r), occ)
                     continue
                 widxs = np.asarray(
                     sorted(w for w, wv in wlog.items() if wv > version),
                     dtype=np.int32,
                 )
                 words = self.row_words(r)
-                out[r] = ("words", widxs, words[widxs])
+                out[r] = ("words", widxs, words[widxs], occ)
             return self._version, out
 
     @_locked
@@ -477,6 +483,11 @@ class Fragment:
     def row_positions(self, row_id: int) -> np.ndarray:
         """Sorted uint32 in-row positions of a row."""
         return self._store.positions(row_id)
+
+    def row_occupancy(self, row_id: int) -> int:
+        """Exact block-occupancy bitmap of a row (bitops.occupancy64) —
+        the sparsity summary the mesh engine keeps per resident stack."""
+        return self._store.occupancy64(row_id)
 
     def host_bytes(self) -> int:
         """Host bytes held by row payloads (sparse-economics test hook)."""

@@ -240,6 +240,18 @@ class RowStore:
     def words_u32(self, row_id: int) -> np.ndarray:
         return self.words_u64(row_id).view("<u4")
 
+    def occupancy64(self, row_id: int) -> int:
+        """Block-occupancy bitmap of a row (bitops.occupancy64): bit b
+        set iff occupancy block b holds a set bit.  Sparse rows compute
+        it from their position array (no densify)."""
+        sp = self.sparse.get(row_id)
+        if sp is not None:
+            return bitops.occupancy64_from_positions(sp)
+        d = self.dense.get(row_id)
+        if d is None:
+            return 0
+        return bitops.occupancy64(d)
+
     def compact(self) -> None:
         """Demote dense rows that shrank below the hysteresis threshold."""
         for r in [r for r, d in self.dense.items() if self.counts.get(r, 0) <= DEMOTE_AT]:

@@ -519,6 +519,12 @@ class Handler:
             snap = eng.pipeline_snapshot()
             if snap is not None:
                 out["pipeline"] = snap
+        # Engine cache/sparsity telemetry (hit/miss tallies, resident
+        # bytes, bytes skipped, CSE/memo counters) — the JSON twin of the
+        # pilosa_engine_cache_* and pilosa_device_bytes_skipped_total
+        # series.
+        if eng is not None and hasattr(eng, "cache_snapshot"):
+            out["engineCaches"] = eng.cache_snapshot()
         # The histogram registry's JSON view: same data /metrics serves,
         # merged here so one curl shows counters + stages + quantiles.
         out["metrics"] = REGISTRY.snapshot()

@@ -26,6 +26,40 @@ SHARD_WIDTH = 1 << SHARD_WIDTH_EXP  # columns per shard (fragment.go:50-51)
 WORDS = SHARD_WIDTH // 32  # 32768 uint32 words per shard row
 WORDS64 = SHARD_WIDTH // 64  # host-side uint64 words per shard row
 
+# Block-occupancy geometry (the sparsity summary the mesh engine keeps
+# per resident field stack; docs/sparsity.md).  A shard row's 32768
+# device words split into 64 fixed blocks of 512 uint32 words (2 KiB,
+# 16384 bit positions) — one uint64 summarizes a whole (row, shard):
+# bit b set <=> block b contains at least one set bit.  64 blocks is
+# fine enough to skip real clustering (ingest order, key ranges) while
+# keeping the per-stack summary R*S*8 bytes — noise next to the
+# R*S*128 KiB it describes.
+OCC_BLOCK_WORDS = 512  # uint32 words per occupancy block
+OCC_BLOCKS = WORDS // OCC_BLOCK_WORDS  # 64 blocks per (row, shard)
+OCC_BLOCK_BITS = OCC_BLOCK_WORDS * 32  # 16384 bit positions per block
+
+
+def occupancy64(words: np.ndarray) -> int:
+    """Block-occupancy bitmap of one dense row: bit b set iff any of the
+    row's words in block b is nonzero.  Accepts the uint32[WORDS] device
+    view or the uint64[WORDS64] host view (same bytes)."""
+    w = np.ascontiguousarray(words).view("<u4")
+    nz = w.reshape(OCC_BLOCKS, OCC_BLOCK_WORDS).any(axis=1)
+    return int(np.packbits(nz, bitorder="little").view("<u8")[0])
+
+
+def occupancy64_from_positions(positions: np.ndarray) -> int:
+    """Block-occupancy bitmap from sorted in-row bit positions (the
+    sparse-row fast path: no densify)."""
+    if len(positions) == 0:
+        return 0
+    blocks = np.unique(
+        np.asarray(positions, dtype=np.int64) >> np.int64(14)
+    )  # 2^14 = OCC_BLOCK_BITS
+    out = np.zeros(OCC_BLOCKS, dtype=bool)
+    out[blocks] = True
+    return int(np.packbits(out, bitorder="little").view("<u8")[0])
+
 
 # -- host conversions ------------------------------------------------------
 

@@ -74,6 +74,7 @@ class _Item:
         "error",
         "t_submit",
         "span",
+        "memo_key",
         "_callbacks",
     )
 
@@ -86,6 +87,10 @@ class _Item:
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
         self.span = tracing.current_span()
+        # Result-memo key computed at SUBMIT time (engine.memo_probe):
+        # the collect stage stores the answer under the version tokens
+        # the query began with, never newer ones.
+        self.memo_key = None
         self._callbacks: List[Callable] = []
 
     def done(self) -> bool:
@@ -185,13 +190,20 @@ class CountBatcher:
     # -- accumulate stage ---------------------------------------------------
 
     def submit(self, index: str, call, shards) -> int:
-        """Count one tree; returns the count.  Lone callers run directly
-        (no handoff); callers arriving while a dispatch is in flight —
-        or within the hot window after a fused batch — are queued and
-        answered from the next fused batch."""
-        item = self._submit(index, call, shards, allow_direct=True)
+        """Count one tree; returns the count.  A result-memo hit (same
+        query + shards, no intervening write — engine.memo_probe)
+        answers here with no queue, no device, no thread handoff.
+        Otherwise lone callers run directly (no handoff); callers
+        arriving while a dispatch is in flight — or within the hot
+        window after a fused batch — are queued and answered from the
+        next fused batch."""
+        probed = getattr(self.engine, "memo_probe", None) is not None
+        key, hit = self._memo_probe(index, call, shards)
+        if hit is not None:
+            return int(hit)
+        item = self._submit(index, call, shards, allow_direct=True, memo_key=key)
         if item is None:
-            return self._direct(index, call, shards)
+            return self._direct(index, call, shards, key, probed)
         if not item.event.wait(self.WAIT_TIMEOUT):
             raise RuntimeError("batched count timed out (engine wedged?)")
         if item.error is not None:
@@ -202,16 +214,32 @@ class CountBatcher:
         """Queue one Count into the pipeline and return its future
         (_Item).  Never takes the direct path — the caller is handing
         off completion (an HTTP deferral), so blocking here would defeat
-        it; a lone async query pays ~one accumulation poll."""
-        return self._submit(index, call, shards, allow_direct=False)
+        it; a lone async query pays ~one accumulation poll.  A memo hit
+        returns an already-resolved future."""
+        key, hit = self._memo_probe(index, call, shards)
+        if hit is not None:
+            item = _Item(index, call, list(shards))
+            item.result = int(hit)
+            item._resolve()
+            return item
+        return self._submit(index, call, shards, allow_direct=False, memo_key=key)
 
-    def _submit(self, index, call, shards, allow_direct: bool):
+    def _memo_probe(self, index, call, shards):
+        """engine.memo_probe, duck-typed: the batcher also runs against
+        stub engines (tests) that predate the result memo."""
+        probe = getattr(self.engine, "memo_probe", None)
+        if probe is None:
+            return None, None
+        return probe(index, call, shards)
+
+    def _submit(self, index, call, shards, allow_direct: bool, memo_key=None):
         with self._lock:
             hot = time.monotonic() - self._last_fused < self.HOT_WINDOW
             if allow_direct and not self._busy and not self._queue and not hot:
                 self._busy = True
                 return None  # caller runs the direct path
             item = _Item(index, call, list(shards))
+            item.memo_key = memo_key
             self._queue.append(item)
             self._ensure_workers()
             # Wake the drain worker on the empty->non-empty transition
@@ -222,8 +250,13 @@ class CountBatcher:
                 self._cond.notify_all()
         return item
 
-    def _direct(self, index, call, shards) -> int:
+    def _direct(self, index, call, shards, memo_key=None, probed=False) -> int:
         try:
+            if probed:
+                # submit() already probed (and missed): hand the key
+                # through so count_async stores the result without a
+                # second key walk or a double-counted miss.
+                return self.engine.count(index, call, shards, memo_key=memo_key)
             return self.engine.count(index, call, shards)
         finally:
             with self._lock:
@@ -418,6 +451,10 @@ class CountBatcher:
                 self.pipeline.record("device_readback", t_ready - t_dispatched)
                 for i, it in enumerate(items):
                     it.result = int(out[i])
+                    # Populate the result memo under the tokens read at
+                    # submit time (engine.memo_probe's ordering note).
+                    if it.memo_key is not None:
+                        self.engine.memo_store(it.memo_key, it.result)
                 t_done = time.monotonic()
                 self.pipeline.record("decode", t_done - t_ready)
                 for it in items:

@@ -27,6 +27,7 @@ explicit HBM residency manager.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import weakref
 from collections import OrderedDict
@@ -45,7 +46,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
 from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
+from ..util.stats import (
+    ENGINE_CACHES,
+    METRIC_DEVICE_BYTES_SKIPPED,
+    METRIC_ENGINE_CACHE_HITS,
+    METRIC_ENGINE_CACHE_MISSES,
+    REGISTRY,
+)
 from . import kernels
+from . import sparse as sparse_mod
 from .mesh import SHARD_AXIS, pad_shards, put_global
 
 
@@ -55,10 +64,13 @@ class _FieldStack:
     contiguous per-device HBM blocks (middle-axis slicing measured ~7x
     slower on v5e: 95 vs 705 GB/s effective)."""
 
-    __slots__ = ("matrix", "row_index", "versions", "shards", "pos", "frag_sync")
+    __slots__ = (
+        "matrix", "row_index", "versions", "shards", "pos", "frag_sync",
+        "occ",
+    )
 
     def __init__(self, matrix, row_index: Dict[int, int], versions, shards,
-                 frag_sync=None):
+                 frag_sync=None, occ=None):
         self.matrix = matrix
         self.row_index = row_index
         self.versions = versions
@@ -68,6 +80,16 @@ class _FieldStack:
         # version): the scatter-update reconciliation point (see
         # MeshEngine._try_incremental_sync).
         self.frag_sync = frag_sync or []
+        # EXACT host-side block-occupancy summary, uint64[R, S]: bit b of
+        # occ[r, s] set iff occupancy block b of (row r, shard s) holds a
+        # set bit (bitops.OCC_BLOCKS blocks per row; docs/sparsity.md).
+        # Built at residency time, kept exact by the scatter-sync write
+        # path (fragment.sync_snapshot computes the per-dirty-row bitmap
+        # under the same lock as the words it ships).  The sparse count
+        # dispatch combines these through the query tree to decide which
+        # device blocks to read at all.  None only on multi-process
+        # meshes (the sparse path is local-only there anyway).
+        self.occ = occ
 
 
 class _TopNCandidates:
@@ -114,6 +136,10 @@ class _Lowering:
         self._mat_ids: Dict[int, int] = {}
         self._stacks: dict = {}
         self.scalar_values: Optional[list] = None
+        # operand index -> host int for scalar_ref operands (non-slot
+        # mode): the sparse planner reads row-index VALUES back out of a
+        # lowered prog to combine occupancy host-side (_sparse_plan).
+        self.scalar_value_of: Dict[int, int] = {}
         if slot_vector:
             self.scalar_values = []
             self.operands.append(None)  # slot vector, filled by finish()
@@ -125,7 +151,9 @@ class _Lowering:
         if self.scalar_values is not None:
             self.scalar_values.append(int(value))
             return ("sv", len(self.scalar_values) - 1)
-        return self.add_replicated(self.engine._scalar(value))
+        i = self.add_replicated(self.engine._scalar(value))
+        self.scalar_value_of[i] = int(value)
+        return i
 
     def finish(self):
         """Materialize the slot vector (ONE tiny device put per batch)."""
@@ -179,7 +207,70 @@ class _Lowering:
         return i
 
 
+class _ResultMemo:
+    """Bounded LRU of fused-Count results keyed by (lowered prog
+    signature, stack version tokens, mask bits) — engine._memo_key.
+
+    The version tokens ARE the invalidation: every fragment write bumps
+    its view's version (view._bump_version via fragment._touch), a key
+    computed after the write carries the new token and simply misses,
+    and the stale entry ages out of the LRU.  No write-path hook, no
+    sweep — invalidation is free, which is why a stale hit after a
+    write is structurally impossible rather than merely tested for
+    (tests/test_sparsity.py pins it anyway: it would be a correctness
+    bug, not a perf bug).
+
+    Values are either host ints (stored by the batcher's collect stage)
+    or tiny replicated device scalars (stored by count_async before
+    readback) — both satisfy int()/jax.device_get, so a hit returns
+    "replicated results" with zero device dispatch either way."""
+
+    __slots__ = ("maxsize", "_od", "_lock", "hits", "misses")
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._od: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+    def get(self, key):
+        if self.maxsize <= 0 or key is None:
+            return None
+        with self._lock:
+            v = self._od.get(key)
+            if v is None:
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            self.hits += 1
+            return v
+
+    def put(self, key, value):
+        if self.maxsize <= 0 or key is None or value is None:
+            return
+        with self._lock:
+            self._od[key] = value
+            self._od.move_to_end(key)
+            while len(self._od) > self.maxsize:
+                self._od.popitem(last=False)
+
+    def clear(self):
+        with self._lock:
+            self._od.clear()
+
+
 DEFAULT_RESIDENCY_BYTES = 8 << 30  # HBM budget for resident field stacks
+
+# Result-memo capacity (entries); PILOSA_RESULT_MEMO=0 disables it.
+DEFAULT_RESULT_MEMO = 4096
+
+# Sentinel distinguishing "caller did not probe the memo" from "caller
+# probed and the key was None" (count_async's memo_key parameter).
+_MEMO_UNSET = object()
 
 
 def _scatter_rows_impl(mesh, matrix, rows, poss, vals):
@@ -267,6 +358,10 @@ def _scatter_words_impl(mesh, matrix, rows, poss, widxs, vals):
 
 def _scatter_words_donated(mesh, *args):
     return _scatter_jits(mesh)["words_donated"](mesh, *args)
+
+
+class _NotSparse(Exception):
+    """Internal: a lowered tree has no occupancy-guided form."""
 
 
 # Re-exported for back-compat; the class lives in errors.py so it has an
@@ -357,6 +452,61 @@ class MeshEngine:
         # scatter syncs (tests assert writes do NOT force rebuilds).
         self.stack_rebuilds = 0
         self.stack_updates = 0
+        # -- sparsity / reuse layers (docs/sparsity.md) -------------------
+        # Occupancy-guided block skipping: per-dispatch the count path
+        # combines the resident stacks' occupancy summaries through the
+        # query tree and, when the surviving block fraction is at or
+        # under this threshold, dispatches the block-gather kernel
+        # instead of the dense sweep.  The default came out of the
+        # density sweep (bench.py --density-sweep): the sparse form's
+        # gather overhead crosses the dense roofline around 50% block
+        # occupancy, so 25% keeps a 2x bytes margin.
+        self.sparse_threshold = float(
+            os.environ.get("PILOSA_SPARSE_THRESHOLD", "0.25")
+        )
+        self.sparse_enabled = os.environ.get("PILOSA_SPARSE", "1") != "0"
+        # Pallas block-DMA form: TPU backends only; permanently falls
+        # back to the XLA gather form on the first failure (logged).
+        self._sparse_pallas = (
+            os.environ.get("PILOSA_SPARSE_PALLAS", "1") != "0"
+            and jax.default_backend() == "tpu"
+        )
+        self.sparse_dispatches = 0
+        self.device_bytes_skipped = 0
+        # Versioned result memo: fused Counts repeated against unchanged
+        # data are answered with NO device dispatch (_ResultMemo).
+        self.result_memo = _ResultMemo(
+            int(os.environ.get("PILOSA_RESULT_MEMO", DEFAULT_RESULT_MEMO))
+        )
+        # Batched-count CSE: identical (query, shards) entries of one
+        # drained batch evaluate ONCE (_dispatch_count_batch); this
+        # counts the collapsed duplicates.
+        self.batch_cse_deduped = 0
+        # Engine-local cache hit/miss tallies plus cached process-metric
+        # handles (one resolve per engine, per-series locks only on the
+        # hot path — never the registry lock).
+        self.cache_stats: Dict[str, List[int]] = {
+            name: [0, 0] for name in ENGINE_CACHES
+        }
+        self._cache_counters = {
+            name: (
+                REGISTRY.counter(METRIC_ENGINE_CACHE_HITS, cache=name),
+                REGISTRY.counter(METRIC_ENGINE_CACHE_MISSES, cache=name),
+            )
+            for name in ENGINE_CACHES
+        }
+        self._bytes_skipped_counter = REGISTRY.counter(
+            METRIC_DEVICE_BYTES_SKIPPED
+        )
+        self._closed = False
+
+    def _cache_hit(self, name: str):
+        self.cache_stats[name][0] += 1
+        self._cache_counters[name][0].inc()
+
+    def _cache_miss(self, name: str):
+        self.cache_stats[name][1] += 1
+        self._cache_counters[name][1].inc()
 
     def _log(self, msg: str):
         """Engine-level operational log: the configured server logger,
@@ -382,8 +532,11 @@ class MeshEngine:
         dominant dispatch cost through high-latency transports)."""
         s = self._scalars.get(v)
         if s is None:
+            self._cache_miss("scalar")
             s = put_global(self.mesh, np.int32(v), P())
             self._scalars[v] = s
+        else:
+            self._cache_hit("scalar")
         return s
 
     def _bits_arr(self, value: int, depth: int):
@@ -406,7 +559,9 @@ class MeshEngine:
         epoch = self.holder.shard_epoch(index)
         cached = self._canonical.get(index)
         if cached is not None and cached[0] == epoch:
+            self._cache_hit("canonical")
             return cached[1]
+        self._cache_miss("canonical")
         shards = self.holder.local_shards(index)
         self._canonical[index] = (epoch, shards)
         return shards
@@ -421,6 +576,7 @@ class MeshEngine:
         key = (S, bits)
         m = self._masks.get(key)
         if m is None:
+            self._cache_miss("mask")
             host = np.zeros((S, 1), dtype=np.uint32)
             for i, s in enumerate(canonical):
                 if s in req:
@@ -430,6 +586,7 @@ class MeshEngine:
             while len(self._masks) > 1024:  # tiny buffers, but bounded
                 self._masks.popitem(last=False)
         else:
+            self._cache_hit("mask")
             self._masks.move_to_end(key)
         return m
 
@@ -469,6 +626,7 @@ class MeshEngine:
             and cached.versions == token
             and cached.shards == canonical
         ):
+            self._cache_hit("stack")
             self._stacks.move_to_end(key)
             return cached
         if cached is not None:
@@ -481,11 +639,15 @@ class MeshEngine:
                 cached, index, field, view, canonical, token
             )
             if updated is not None:
+                # Incremental sync counts as a hit: the resident HBM
+                # matrix was reused, only deltas moved.
+                self._cache_hit("stack")
                 self._stacks.move_to_end(key)
                 return updated
             self._evict(key)
         if not canonical:
             return None
+        self._cache_miss("stack")
 
         frags = [self.holder.fragment(index, field, view, s) for s in canonical]
         # Sync points are captured BEFORE reading any row words: a write
@@ -504,6 +666,13 @@ class MeshEngine:
         row_index = {r: i for i, r in enumerate(row_ids)}
         S = pad_shards(len(canonical), self.mesh)
         mat = np.zeros((len(row_ids), S, bitops.WORDS), dtype=np.uint32)
+        # Exact block-occupancy summary alongside the matrix (8 bytes per
+        # row-shard vs its 128 KiB of words).  Multi-process builds fill
+        # only owned positions, so the summary would be partial — and the
+        # sparse path is local-only anyway — so it stays None there.
+        occ = None if self.multiproc else np.zeros(
+            (len(row_ids), S), dtype=np.uint64
+        )
         # Multi-process: materialize row WORDS only for the canonical
         # positions this process's devices own (multihost.owned_positions)
         # — put_global's callback never reads the rest, so each host pays
@@ -520,6 +689,16 @@ class MeshEngine:
                 continue
             for r in f.row_ids():
                 mat[row_index[r], si] = f.row_words(r)
+                if occ is not None:
+                    # From the words JUST COPIED — not a second fragment
+                    # read: a clear landing between row_words and a
+                    # separate occupancy read would drop a bit the
+                    # matrix still has set (sparse-path false negative).
+                    # The later write is caught by the version delta and
+                    # repaired by the next incremental sync.
+                    occ[row_index[r], si] = bitops.occupancy64(
+                        mat[row_index[r], si]
+                    )
         while (
             self._resident_bytes + self._pending_bytes() + mat.nbytes
             > self.max_resident_bytes
@@ -533,6 +712,7 @@ class MeshEngine:
             token,
             list(canonical),
             frag_sync=frag_sync,
+            occ=occ,
         )
         self._stacks[key] = stack
         self._resident_bytes += mat.nbytes
@@ -574,6 +754,12 @@ class MeshEngine:
         # per-word tuples — a near-cap sync can carry ~500k words):
         # (row_idx, pos, widxs int32[], vals uint32[]).
         word_updates: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
+        # Occupancy refreshes riding the same snapshot: (row_idx, pos,
+        # occ64).  The bitmap comes out of sync_snapshot's lock, so it
+        # exactly describes the words being scattered — never newer
+        # (a clear between snapshot and here could otherwise drop a bit
+        # the matrix still has set: a sparse-path false negative).
+        occ_updates: List[Tuple[int, int, int]] = []
         n_words = 0
         new_sync = list(cached.frag_sync)
         for si, s in enumerate(canonical):
@@ -598,11 +784,13 @@ class MeshEngine:
                 if row_idx is None:
                     return None  # brand-new row: shape change
                 if upd[0] == "words":
-                    _, widxs, vals = upd
+                    _, widxs, vals, occ64 = upd
                     word_updates.append((row_idx, si, widxs, vals))
                     n_words += len(widxs)
                 else:
                     updates.append((row_idx, si, upd[1]))
+                    occ64 = upd[2]
+                occ_updates.append((row_idx, si, occ64))
             if dirty:
                 new_sync[si] = (fref, new_version)
         if updates or word_updates:
@@ -618,6 +806,12 @@ class MeshEngine:
                 if self._stacks.get(key) is cached:
                     self._evict(key)
                 raise
+            # Occupancy lands only after the words did: a mid-chain
+            # failure must not leave a summary describing words that
+            # never reached the device.
+            if cached.occ is not None:
+                for row_idx, si, occ64 in occ_updates:
+                    cached.occ[row_idx, si] = np.uint64(occ64)
         cached.versions = token
         cached.frag_sync = new_sync
         return cached
@@ -708,12 +902,15 @@ class MeshEngine:
         S = pad_shards(len(canonical), self.mesh)
         z = self._zeros.get(S)
         if z is None:
+            self._cache_miss("zeros")
             z = put_global(
                 self.mesh,
                 np.zeros((1, S, bitops.WORDS), dtype=np.uint32),
                 P(None, SHARD_AXIS),
             )
             self._zeros[S] = z
+        else:
+            self._cache_hit("zeros")
         return z
 
     # -- call-tree lowering -------------------------------------------------
@@ -893,12 +1090,19 @@ class MeshEngine:
 
     # -- fused evaluation ---------------------------------------------------
 
-    def count(self, index: str, c: Call, shards: List[int]) -> int:
+    def count(
+        self, index: str, c: Call, shards: List[int], memo_key=_MEMO_UNSET
+    ) -> int:
         """Count(tree): one fused dispatch, one psum."""
-        return int(self.count_async(index, c, shards))
+        return int(self.count_async(index, c, shards, memo_key=memo_key))
 
     def count_async(
-        self, index: str, c: Call, shards: List[int], broadcast: bool = True
+        self,
+        index: str,
+        c: Call,
+        shards: List[int],
+        broadcast: bool = True,
+        memo_key=_MEMO_UNSET,
     ):
         """Count(tree) returning the device scalar without host sync —
         callers pipeline query streams and fetch results in one transfer
@@ -911,13 +1115,133 @@ class MeshEngine:
             return jnp.int32(0)
         if broadcast and self._peerless_multiproc:
             raise PeerlessMeshError("multi-process mesh without peer broadcast")
-        return self._collective(
+        # Versioned result memo: a repeat of this (query, shards) against
+        # unchanged stacks is answered with NO device dispatch (and no
+        # peer broadcast — peers simply never hear about it).  Two hard
+        # gates: replays (broadcast=False) must NEVER consult the memo
+        # (a replaying peer that skipped its dispatch would strand the
+        # initiator's psum), and neither may a MULTI-PROCESS mesh in any
+        # role — the version tokens are process-local, so a write
+        # applied on a peer would not stale this process's key and a
+        # repeat would serve a stale psum result.  ``memo_key`` lets a
+        # caller that already probed (CountBatcher.submit) hand its key
+        # through instead of paying the key walk and a second counted
+        # miss.
+        if not broadcast or self.multiproc:
+            key = None
+        elif memo_key is not _MEMO_UNSET:
+            key = memo_key  # caller probed already: a known miss
+        else:
+            key = self._memo_key(index, c, shards)
+            if key is not None:
+                hit = self.result_memo.get(key)
+                if hit is not None:
+                    self._cache_hit("result_memo")
+                    # Entries stored by the batcher's collect stage are
+                    # host ints; this path's contract is a device
+                    # scalar (callers pipeline and block on it), so
+                    # normalize — a tiny put, on hits only.
+                    if isinstance(hit, (int, np.integer)):
+                        return jnp.int32(hit)
+                    return hit
+                self._cache_miss("result_memo")
+        dev = self._collective(
             "count",
             {"index": index, "query": str(c), "shards": list(shards),
              "canon": [int(x) for x in canonical]},
             lambda: self._dispatch_count(index, c, shards, canonical),
             broadcast,
         )
+        # The stored value is the tiny replicated device scalar itself —
+        # later hits hand the SAME buffer back and the caller's
+        # device_get is the only transfer.
+        self.result_memo.put(key, dev)
+        return dev
+
+    # Call names whose referenced fields _collect_fields can enumerate —
+    # the memo-eligible subset (matches _LOWERABLE: only lowerable trees
+    # reach the fused count paths anyway).
+    _MEMO_CALLS = frozenset(
+        ("Row", "Union", "Intersect", "Difference", "Xor", "Not", "Range")
+    )
+
+    def _collect_fields(self, c: Call, out=None):
+        """Every field a tree reads, or None when the tree has a shape
+        the walk doesn't understand (no memo then — correctness first)."""
+        if out is None:
+            out = set()
+        if c.name not in self._MEMO_CALLS:
+            return None
+        if c.name in ("Row", "Range"):
+            try:
+                fname = c.field_arg()
+            except ValueError:
+                return None
+            out.add(fname)
+        if c.name == "Not":
+            from ..core.index import EXISTENCE_FIELD_NAME
+
+            out.add(EXISTENCE_FIELD_NAME)
+        for ch in c.children:
+            if self._collect_fields(ch, out) is None:
+                return None
+        return out
+
+    def _memo_key(self, index: str, c: Call, shards):
+        """Result-memo key: (index, query text, shard set, version
+        tokens of EVERY view of every referenced field).  The tokens
+        mirror _field_stack_locked's invalidation token — (shard epoch,
+        view identity, view version) — so any write that would stale a
+        resident stack also stales every memo entry over it, at zero
+        write-path cost.  Returns None when the tree isn't walkable or
+        the memo is disabled (callers then just dispatch)."""
+        if self.result_memo.maxsize <= 0:
+            return None
+        fields = self._collect_fields(c)
+        if fields is None:
+            return None
+        idx_obj = self.holder.index(index)
+        if idx_obj is None:
+            return None
+        toks: list = [self.holder.shard_epoch(index)]
+        try:
+            for fname in sorted(fields):
+                f = idx_obj.field(fname)
+                if f is None:
+                    toks.append((fname, None))
+                    continue
+                for vname in sorted(f.views):
+                    v = f.views[vname]
+                    toks.append((fname, vname, id(v), v.version))
+        except RuntimeError:
+            # A concurrent writer grew a view dict mid-walk (first write
+            # to a new time view): skip the memo for this query rather
+            # than surface an iteration error on the read path.
+            return None
+        return (index, str(c), tuple(sorted(set(shards))), tuple(toks))
+
+    def memo_probe(self, index: str, c: Call, shards):
+        """(key, value-or-None) for the batcher's submit fast path: a
+        hit answers the Count before it ever touches the queue or the
+        device.  The key is handed back so the collect stage can store
+        the eventual result under the tokens READ AT SUBMIT TIME — a
+        write landing mid-flight keys its readers to new tokens, so the
+        entry can only ever be served to queries that began before the
+        write (the same ordering the direct path gives them)."""
+        if self.multiproc:
+            return None, None
+        key = self._memo_key(index, c, shards)
+        if key is None:
+            return None, None
+        v = self.result_memo.get(key)
+        if v is not None:
+            self._cache_hit("result_memo")
+            return key, v
+        self._cache_miss("result_memo")
+        return key, None
+
+    def memo_store(self, key, value):
+        self.result_memo.put(key, value)
 
     @property
     def _peerless_multiproc(self) -> bool:
@@ -1009,10 +1333,138 @@ class MeshEngine:
         lw = _Lowering(self, canonical)
         prog = self._lower(index, c, lw)
         mask = self._mask_words(shards, canonical)
+        plan = self._sparse_plan(prog, lw, shards, canonical)
         self.fused_dispatches += 1
+        if plan is not None:
+            return self._dispatch_sparse(plan, mask)
         return kernels.count_tree(
             self.mesh, prog, tuple(lw.specs), mask, *lw.operands
         )
+
+    def _dispatch_sparse(self, plan, mask):
+        """Dispatch an occupancy-guided plan (_sparse_plan) on the
+        Pallas block-DMA kernel (TPU) or the XLA block-gather form."""
+        sprog, mats, rowvec, blk_idx, blk_n, skipped = plan
+        self.sparse_dispatches += 1
+        self.device_bytes_skipped += skipped
+        self._bytes_skipped_counter.inc(skipped)
+        if self._sparse_pallas:
+            try:
+                return sparse_mod.count_tree_blocks_pallas(
+                    self.mesh, sprog, False, mask, blk_idx, blk_n,
+                    rowvec, *mats,
+                )
+            except Exception as e:  # noqa: BLE001 — permanent fallback
+                self._sparse_pallas = False
+                self._log(
+                    "sparse Pallas kernel unavailable; using the XLA "
+                    f"block-gather form from now on: {e!r}"
+                )
+        return sparse_mod.count_tree_blocks(
+            self.mesh, sprog, mask, blk_idx, blk_n, rowvec, *mats
+        )
+
+    def _sparse_plan(self, prog, lw: _Lowering, shards, canonical):
+        """Occupancy-guided dispatch plan for a lowered count tree, or
+        None to take the dense path.  Combines the resident stacks'
+        block-occupancy summaries through the tree HOST-side (AND
+        intersects, OR/XOR unions, ANDNOT keeps its left side — the
+        right can only clear bits), gates by the requested shards, and
+        when the surviving block fraction is at or under
+        ``sparse_threshold`` emits the normalized sparse program +
+        per-shard block lists for parallel/sparse.py.  Dense rows keep
+        the existing XLA count_tree path: at high occupancy the gather
+        form reads nearly everything anyway and loses to the fused
+        dense sweep's roofline."""
+        if not self.sparse_enabled or self.multiproc:
+            return None
+        stacks_by_mat = {}
+        for st in lw._stacks.values():
+            if st is not None and st.occ is not None:
+                stacks_by_mat[id(st.matrix)] = st
+        S = pad_shards(len(canonical), self.mesh)
+        mats: list = []
+        mat_slots: Dict[int, int] = {}
+        rowvals: List[int] = []
+
+        def norm(p):
+            kind = p[0]
+            if kind == "zero":
+                return ("zero",), np.zeros(S, dtype=np.uint64)
+            if kind == "row":
+                ref = p[2]
+                st = stacks_by_mat.get(id(lw.operands[p[1]]))
+                ridx = (
+                    None if isinstance(ref, tuple)
+                    else lw.scalar_value_of.get(ref)
+                )
+                if st is None or ridx is None or ridx >= st.occ.shape[0]:
+                    raise _NotSparse
+                mkey = id(st.matrix)
+                mslot = mat_slots.get(mkey)
+                if mslot is None:
+                    mslot = mat_slots[mkey] = len(mats)
+                    mats.append(st.matrix)
+                rslot = len(rowvals)
+                rowvals.append(ridx)
+                return ("row", mslot, rslot), st.occ[ridx]
+            if kind in ("and", "or", "andnot", "xor"):
+                subs = [norm(q) for q in p[1:]]
+                sprog = (kind,) + tuple(s[0] for s in subs)
+                occ = subs[0][1]
+                for _, so in subs[1:]:
+                    if kind == "and":
+                        occ = occ & so
+                    elif kind != "andnot":  # or / xor widen; andnot keeps left
+                        occ = occ | so
+                return sprog, occ
+            raise _NotSparse  # range/between/rowm: dense path
+
+        try:
+            sprog, occ = norm(prog)
+        except _NotSparse:
+            return None
+        if not rowvals:
+            return None
+        req = np.zeros(S, dtype=bool)
+        pos = {s: i for i, s in enumerate(canonical)}
+        for s in shards:
+            i = pos.get(s)
+            if i is not None:
+                req[i] = True
+        n_req = int(req.sum())
+        if n_req == 0:
+            return None
+        occ = np.where(req, occ, np.uint64(0))
+        bits = np.unpackbits(
+            occ.view(np.uint8).reshape(S, 8), axis=1, bitorder="little"
+        )  # [S, OCC_BLOCKS] 0/1
+        blk_n_np = bits.sum(axis=1).astype(np.int32)
+        total_blocks = int(blk_n_np.sum())
+        denom = n_req * bitops.OCC_BLOCKS
+        if total_blocks / denom > self.sparse_threshold:
+            return None
+        # Occupied block ids first (stable argsort keeps ascending
+        # order), padded with block 0 — a cached re-read whose count the
+        # kernel zero-weights.  Kb pads to power-of-two tiers so the
+        # compile key is (structure, tier), never the block pattern.
+        kmax = max(1, int(blk_n_np.max()))
+        Kb = 1 << (kmax - 1).bit_length()
+        order = np.argsort(~bits.astype(bool), axis=1, kind="stable")
+        blk_idx_np = np.where(
+            np.arange(Kb, dtype=np.int64)[None, :] < blk_n_np[:, None],
+            order[:, :Kb],
+            0,
+        ).astype(np.int32)
+        n_leaves = len(rowvals)
+        block_bytes = bitops.OCC_BLOCK_WORDS * 4
+        skipped = n_leaves * (denom - total_blocks) * block_bytes
+        rowvec = put_global(
+            self.mesh, np.asarray(rowvals, dtype=np.int32), P()
+        )
+        blk_idx = put_global(self.mesh, blk_idx_np, P(SHARD_AXIS))
+        blk_n = put_global(self.mesh, blk_n_np, P(SHARD_AXIS))
+        return sprog, mats, rowvec, blk_idx, blk_n, skipped
 
     # -- batched multi-query dispatch ---------------------------------------
 
@@ -1102,9 +1554,63 @@ class MeshEngine:
     BATCH_TIERS = (8, 64, 256, 512)
 
     def _dispatch_count_batch(self, index, calls, shards_list, canonical):
+        # Batch-level CSE: identical (query text, shard set) entries of
+        # the drain — the micro-batcher fuses O(100) queries/batch and
+        # repeated dashboards/pollers make duplicates the common case —
+        # lower to ONE slot and evaluate once; the answer fans back out
+        # through a tiny replicated take at the end.  Dedup happens
+        # BEFORE tier padding, and unique entries lower in first-seen
+        # order, so the padded program stays byte-identical for every
+        # batch of the same structure + tier: slot indices depend only
+        # on the unique sequence, and the pad entries re-lower entry 0
+        # exactly as before (the compile-key property the fixed tiers
+        # exist for — see the round-4 note below).
+        uniq: Dict[tuple, int] = {}
+        mapping = np.empty(len(calls), dtype=np.int32)
+        u_calls: list = []
+        u_shards: list = []
+        for i, (c, shards) in enumerate(zip(calls, shards_list)):
+            k = (str(c), tuple(shards))
+            j = uniq.get(k)
+            if j is None:
+                j = uniq[k] = len(u_calls)
+                u_calls.append(c)
+                u_shards.append(shards)
+                self._cache_miss("batch_cse")
+            else:
+                self._cache_hit("batch_cse")
+            mapping[i] = j
+        deduped = len(calls) - len(u_calls)
+        self.batch_cse_deduped += deduped
+        # A drain that CSE'd down to ONE unique query — the lone-query
+        # HTTP pipeline and repeated-dashboard drains both land here —
+        # takes the scalar count program: ONE lowering (not the
+        # slot-vector batch build), the same per-structure count_tree
+        # executable the direct path already compiled, and the
+        # occupancy-guided block-skipping plan where it applies (the
+        # slot-vector batch program is dense by construction).  The
+        # answer broadcasts back to every caller slot (a tiny
+        # replicated op).  Multi-process meshes stay on the batch
+        # program: the count_batch collective replays on peers and both
+        # sides must pick the same branch for the same payload — they
+        # do (the dedup is deterministic) — but the sparse plan is
+        # local-only there, so the scalar detour buys nothing.
+        if len(u_calls) == 1 and not self.multiproc:
+            lw1 = _Lowering(self, canonical)
+            prog1 = self._lower(index, u_calls[0], lw1)
+            mask1 = self._mask_words(u_shards[0], canonical)
+            plan = self._sparse_plan(prog1, lw1, u_shards[0], canonical)
+            self.fused_dispatches += 1
+            if plan is not None:
+                dev = self._dispatch_sparse(plan, mask1)
+            else:
+                dev = kernels.count_tree(
+                    self.mesh, prog1, tuple(lw1.specs), mask1, *lw1.operands
+                )
+            return jnp.broadcast_to(dev, (len(calls),))
         lw = _Lowering(self, canonical, slot_vector=True)
         progs = []
-        for c, shards in zip(calls, shards_list):
+        for c, shards in zip(u_calls, u_shards):
             prog = self._lower(index, c, lw)
             i_mask = lw.add_mask(self._mask_words(shards, canonical))
             progs.append((prog, i_mask))
@@ -1121,14 +1627,20 @@ class MeshEngine:
             max(1, 1 << (K - 1).bit_length()),
         )
         for _ in range(K_pad - K):
-            prog = self._lower(index, calls[0], lw)
-            i_mask = lw.add_mask(self._mask_words(shards_list[0], canonical))
+            prog = self._lower(index, u_calls[0], lw)
+            i_mask = lw.add_mask(self._mask_words(u_shards[0], canonical))
             progs.append((prog, i_mask))
         lw.finish()
         self.fused_dispatches += 1
-        return kernels.count_batch_tree(
+        dev = kernels.count_batch_tree(
             self.mesh, tuple(progs), tuple(lw.specs), *lw.operands
         )
+        if deduped:
+            # Fan the U unique answers back out to the K callers (a
+            # trivial replicated gather — microseconds against the
+            # dispatch floor the dedup just saved K-U times over).
+            return jnp.take(dev, jnp.asarray(mapping))
+        return dev
 
     def bitmap_stack(
         self,
@@ -1797,6 +2309,63 @@ class MeshEngine:
         if dev is None:
             return None
         return np.asarray(dev)
+
+    # -- lifecycle / telemetry ----------------------------------------------
+
+    def close(self):
+        """Release every device-buffer cache deterministically: resident
+        field stacks, masks, zero stacks, scalars, BSI bit vectors, TopN
+        candidates, the result memo — and stop the batcher's worker
+        threads.  Without this, teardown returned HBM only when the
+        engine object happened to be garbage-collected, which on a
+        long-lived process (server restart-in-place, bench sweeps, test
+        suites sharing a runtime) is 'never': the OrderedDict caches
+        keep every buffer reachable.  Wired from server.close().
+        Idempotent; a closed engine can still serve (caches simply
+        rebuild) but deployments shouldn't."""
+        batcher = self._batcher
+        if batcher is not None:
+            try:
+                batcher.stop()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+            self._batcher = None
+        with self._dispatch_lock, self._stacks_lock:
+            for key in list(self._stacks):
+                self._evict(key)
+            # _evict parks weakrefs in _pending_free for admission
+            # accounting; on close nothing will admit again — drop them.
+            self._pending_free = []
+            self._resident_bytes = 0
+            self._masks.clear()
+            self._zeros.clear()
+            self._scalars.clear()
+            self._bits.clear()
+            self._canonical.clear()
+            self._topn_cands.clear()
+            self.result_memo.clear()
+            self._closed = True
+
+    def cache_snapshot(self) -> dict:
+        """Cache/skip telemetry for /debug/vars: per-cache hit/miss
+        tallies (the same counts the pilosa_engine_cache_* series
+        export), live cache sizes, and the sparsity counters."""
+        return {
+            "caches": {
+                name: {"hits": hm[0], "misses": hm[1]}
+                for name, hm in self.cache_stats.items()
+            },
+            "residentBytes": self._resident_bytes,
+            "stacks": len(self._stacks),
+            "masks": len(self._masks),
+            "zeros": len(self._zeros),
+            "scalars": len(self._scalars),
+            "resultMemoEntries": len(self.result_memo),
+            "sparseDispatches": self.sparse_dispatches,
+            "deviceBytesSkipped": self.device_bytes_skipped,
+            "batchCseDeduped": self.batch_cse_deduped,
+            "closed": self._closed,
+        }
 
 
 # Back-compat aliases: the production programs live in kernels.py (one

@@ -1,0 +1,249 @@
+"""Occupancy-guided sparse query kernels: read only occupied blocks.
+
+The reference's whole reason for roaring bitmaps is to never touch empty
+regions (SURVEY §2.1: container ops skip absent containers).  Our dense
+``uint32[R, S, WORDS]`` device layout lost that: the dense sweep reads
+every word of every operand row, and BENCH_r05 shows those kernels
+already at the HBM roofline (~750 GB/s implied) — the only remaining
+device-side lever is reading FEWER BYTES.
+
+This module is that lever for the dominant count/intersect sweep.  The
+engine keeps an EXACT per-(row, shard) block-occupancy bitmap on every
+resident stack (``bitops.OCC_BLOCKS`` fixed blocks of
+``OCC_BLOCK_WORDS`` uint32 words; built at residency time, maintained by
+the scatter-sync write path — engine._FieldStack.occ).  At dispatch the
+engine combines the leaves' occupancy through the query tree host-side
+(AND intersects, OR/XOR unions, ANDNOT keeps the left side), and when
+the surviving block fraction is under a density threshold it ships tiny
+per-shard block lists and dispatches one of the kernels here instead of
+the dense ``kernels.count_tree``:
+
+- ``count_tree_blocks``: plain-XLA block gather — each leaf row is
+  re-indexed ``[S, OCC_BLOCKS, BW]`` and only the listed blocks are
+  gathered before the fused popcount.  This is also the portable
+  fallback (CPU meshes, ``JAX_PLATFORMS=cpu`` tier-1, pods).
+- ``count_tree_blocks_pallas``: a TPU Pallas kernel that scalar-
+  prefetches the block lists and explicitly DMAs ONLY the occupied
+  2 KiB blocks HBM->VMEM (grid over (local shard, block slot); the
+  operand stacks stay in HBM/ANY memory space and are never streamed
+  wholesale).  Selected on TPU backends; any failure to trace/compile
+  permanently falls back to the XLA form (engine logs once).
+
+The earlier "Pallas was deleted" note in kernels.py applies only to the
+DENSE sweep, where a hand pipeline tied XLA's fusion at the same
+roofline; block skipping is a different roofline — the win is bytes not
+touched, which XLA's dense fusion cannot express.
+
+Program form: ``prog`` is a NORMALIZED static tree (engine._sparse_plan)
+— leaves ``("row", mat_slot, row_slot)`` / ``("zero",)``, interior nodes
+``("and"|"or"|"andnot"|"xor", ...)``.  Row indices travel in ONE traced
+int32 vector (``rowvec``), block lists as traced ``int32[S, Kb]`` +
+``int32[S]`` (padded to power-of-two Kb tiers), so the compile key is
+(structure, Kb tier) — never the row ids or the block pattern.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6 keeps shard_map in experimental
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops.bitops import OCC_BLOCK_WORDS, OCC_BLOCKS
+from .mesh import SHARD_AXIS
+
+
+def _pc(x):
+    return jax.lax.population_count(x).astype(jnp.int32)
+
+
+def _apply_blocks(prog, rowvec, bidx, mats, S_local, Kb):
+    """Evaluate a normalized sparse prog over gathered blocks only:
+    each leaf materializes ``uint32[S_local, Kb, BW]`` — the listed
+    blocks of its row, nothing else."""
+    kind = prog[0]
+    if kind == "zero":
+        return jnp.zeros((S_local, Kb, OCC_BLOCK_WORDS), jnp.uint32)
+    if kind == "row":
+        mat = mats[prog[1]]
+        R = mat.shape[0]
+        matr = mat.reshape(R, S_local, OCC_BLOCKS, OCC_BLOCK_WORDS)
+        row = jax.lax.dynamic_index_in_dim(
+            matr, rowvec[prog[2]], axis=0, keepdims=False
+        )  # [S_local, OCC_BLOCKS, BW]
+        return jnp.take_along_axis(row, bidx[:, :, None], axis=1)
+    subs = [_apply_blocks(p, rowvec, bidx, mats, S_local, Kb) for p in prog[1:]]
+    out = subs[0]
+    for s in subs[1:]:
+        if kind == "or":
+            out = jnp.bitwise_or(out, s)
+        elif kind == "and":
+            out = jnp.bitwise_and(out, s)
+        elif kind == "andnot":
+            out = jnp.bitwise_and(out, jnp.bitwise_not(s))
+        elif kind == "xor":
+            out = jnp.bitwise_xor(out, s)
+        else:
+            raise ValueError(f"bad sparse op {kind}")
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def count_tree_blocks(mesh, prog, mask, blk_idx, blk_n, rowvec, *mats):
+    """Count(tree) over OCCUPIED blocks only (XLA form): gather the
+    per-shard listed blocks of every leaf row, fuse the set algebra +
+    popcount over just those, and psum.  ``blk_idx int32[S, Kb]`` lists
+    block ids per canonical shard (slots >= ``blk_n[s]`` are padding:
+    they gather block 0 — a cached re-read — and their counts are
+    zeroed).  ``mask`` is the requested-shard uint32[S, 1] gate (block
+    lists for unrequested shards are already empty; the gate keeps the
+    dense-path contract anyway)."""
+
+    def body(m, bidx, bn, rv, *ms):
+        S_local, Kb = bidx.shape
+        out = _apply_blocks(prog, rv, bidx, ms, S_local, Kb)
+        pc = jnp.sum(_pc(out), axis=-1)  # [S_local, Kb]
+        valid = jnp.arange(Kb, dtype=jnp.int32)[None, :] < bn[:, None]
+        pc = jnp.where(valid, pc, 0)
+        per_shard = jnp.where(m[:, 0] != 0, jnp.sum(pc, axis=1), 0)
+        return jax.lax.psum(jnp.sum(per_shard), SHARD_AXIS)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P())
+        + (P(None, SHARD_AXIS),) * len(mats),
+        out_specs=P(),
+    )(mask, blk_idx, blk_n, rowvec, *mats)
+
+
+# -- Pallas TPU kernel ------------------------------------------------------
+
+
+def _prog_leaves(prog, out=None):
+    """Static (mat_slot, row_slot) leaf list in evaluation order."""
+    if out is None:
+        out = []
+    if prog[0] == "row":
+        out.append((prog[1], prog[2]))
+    elif prog[0] not in ("zero",):
+        for p in prog[1:]:
+            _prog_leaves(p, out)
+    return out
+
+
+def _combine_from_scratch(prog, scratch, leaf_counter):
+    """Trace-time tree combine over the DMA'd leaf blocks in VMEM."""
+    kind = prog[0]
+    if kind == "zero":
+        return jnp.zeros((OCC_BLOCK_WORDS,), jnp.uint32)
+    if kind == "row":
+        i = leaf_counter[0]
+        leaf_counter[0] += 1
+        return scratch[i, :]
+    subs = [_combine_from_scratch(p, scratch, leaf_counter) for p in prog[1:]]
+    out = subs[0]
+    for s in subs[1:]:
+        if kind == "or":
+            out = out | s
+        elif kind == "and":
+            out = out & s
+        elif kind == "andnot":
+            out = out & ~s
+        elif kind == "xor":
+            out = out ^ s
+    return out
+
+
+def _pallas_shard_count(prog, bidx, bn, rowvec, mats, interpret=False):
+    """Per-device block-skipping count: Pallas kernel over one local
+    shard block.  Grid = (S_local, Kb); the block lists and row indices
+    are SCALAR-PREFETCH operands (available before the body runs, per
+    the Pallas TPU scalar-prefetch contract), the stacks stay in ANY
+    (HBM) memory space, and each grid step DMAs exactly the listed
+    2 KiB block of each leaf row into VMEM scratch before the combine +
+    popcount.  Padding slots (j >= bn[s]) and unrequested shards
+    (bn == 0) do no DMA and add nothing."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    leaves = tuple(_prog_leaves(prog))
+    n_leaf = max(1, len(leaves))
+    S_local, Kb = bidx.shape
+
+    def kernel(bidx_ref, bn_ref, rv_ref, *rest):
+        mats_refs = rest[: len(mats)]
+        out_ref = rest[len(mats)]
+        scratch = rest[len(mats) + 1]
+        sems = rest[len(mats) + 2]
+        s = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when((s == 0) & (j == 0))
+        def _init():
+            out_ref[0, 0] = 0
+
+        @pl.when(j < bn_ref[s])
+        def _work():
+            b = bidx_ref[s, j]
+            copies = []
+            for li, (mslot, rslot) in enumerate(leaves):
+                cp = pltpu.make_async_copy(
+                    mats_refs[mslot].at[
+                        rv_ref[rslot], s, pl.ds(b * OCC_BLOCK_WORDS, OCC_BLOCK_WORDS)
+                    ],
+                    scratch.at[li, :],
+                    sems.at[li],
+                )
+                cp.start()
+                copies.append(cp)
+            for cp in copies:
+                cp.wait()
+            val = _combine_from_scratch(prog, scratch, [0])
+            out_ref[0, 0] += jnp.sum(
+                jax.lax.population_count(val).astype(jnp.int32)
+            )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # bidx, bn, rowvec
+        grid=(S_local, Kb),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY) for _ in mats],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[
+            pltpu.VMEM((n_leaf, OCC_BLOCK_WORDS), jnp.uint32),
+            pltpu.SemaphoreType.DMA((n_leaf,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(bidx, bn, rowvec, *mats)
+    return out[0, 0]
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def count_tree_blocks_pallas(mesh, prog, interpret, mask, blk_idx, blk_n, rowvec, *mats):
+    """Count(tree) over occupied blocks with the DMAs hand-issued
+    (TPU).  Same contract as ``count_tree_blocks``; ``mask`` folds into
+    the block counts so gated shards do zero DMA."""
+
+    def body(m, bidx, bn, rv, *ms):
+        bn = jnp.where(m[:, 0] != 0, bn, 0)
+        total = _pallas_shard_count(prog, bidx, bn, rv, ms, interpret=interpret)
+        return jax.lax.psum(total, SHARD_AXIS)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P())
+        + (P(None, SHARD_AXIS),) * len(mats),
+        out_specs=P(),
+    )(mask, blk_idx, blk_n, rowvec, *mats)

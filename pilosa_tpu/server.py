@@ -592,5 +592,14 @@ class Server:
                 # failed mid-way) deadlocks (socketserver.BaseServer).
                 self._http.shutdown()
             self._http.server_close()
+        # Release the mesh engine's device-buffer caches (resident field
+        # stacks, masks, scalars, result memo) BEFORE the holder closes:
+        # HBM is returned deterministically at shutdown instead of
+        # whenever the engine object happens to be collected.
+        if self.api is not None and getattr(self.api, "mesh_engine", None) is not None:
+            try:
+                self.api.mesh_engine.close()
+            except Exception as e:  # noqa: BLE001 — teardown must not raise
+                self.logger.printf("mesh engine close failed: %s", e)
         self.holder.close()
         self.translate_store.close()

@@ -103,6 +103,27 @@ class Histogram:
         }
 
 
+class Counter:
+    """One monotonic counter series with a cached handle: ``inc()`` is a
+    single locked add on the series' OWN lock, so hot paths (engine
+    cache probes, per-dispatch byte accounting) resolve the series once
+    and never touch the global registry lock again."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0):
+        with self._lock:
+            self.value += value
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
+
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -128,7 +149,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         # name -> {sorted-label-tuple: Histogram}
         self._hists: Dict[str, Dict[tuple, Histogram]] = {}
-        self._counters: Dict[str, Dict[tuple, float]] = {}
+        self._counters: Dict[str, Dict[tuple, Counter]] = {}
         self._gauges: Dict[str, Dict[tuple, float]] = {}
         self._help: Dict[str, str] = {}
 
@@ -152,11 +173,24 @@ class MetricsRegistry:
     def observe(self, name: str, seconds: float, **labels):
         self.histogram(name, **labels).observe(seconds)
 
-    def inc(self, name: str, value: float = 1.0, **labels):
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        """Get-or-create the counter series handle (registering it makes
+        the series visible at /metrics with value 0 before the first
+        increment).  Resolve ONCE per hot path and call ``inc()`` on the
+        handle — that pays only the per-series lock, never this
+        registry lock."""
         key = self._labelkey(labels)
         with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
             series = self._counters.setdefault(name, {})
-            series[key] = series.get(key, 0.0) + value
+            c = series.get(key)
+            if c is None:
+                c = series[key] = Counter()
+            return c
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        self.counter(name, **labels).inc(value)
 
     def set_gauge(self, name: str, value: float, **labels):
         key = self._labelkey(labels)
@@ -210,7 +244,9 @@ class MetricsRegistry:
             lines.append(f"# TYPE {pname} counter")
             for key in sorted(counters[name]):
                 lbl = self._fmt_labels(key)
-                lines.append(f"{pname}{lbl} {_prom_float(counters[name][key])}")
+                lines.append(
+                    f"{pname}{lbl} {_prom_float(counters[name][key].get())}"
+                )
         for name in sorted(gauges):
             pname = _prom_name(name)
             lines.append(f"# HELP {pname} {helps.get(name, name)}")
@@ -236,7 +272,7 @@ class MetricsRegistry:
                 for n, s in hists.items()
             },
             "counters": {
-                n: {label_str(k): v for k, v in s.items()}
+                n: {label_str(k): c.get() for k, c in s.items()}
                 for n, s in counters.items()
             },
             "gauges": {
@@ -258,8 +294,21 @@ METRIC_QUERY = "pilosa_query_seconds"
 METRIC_QUERY_OP = "pilosa_query_op_seconds"
 METRIC_PIPELINE_STAGE = "pilosa_pipeline_stage_seconds"
 METRIC_FRAGMENT_OP = "pilosa_fragment_op_seconds"
+#   pilosa_engine_cache_hits_total{cache=...}   engine cache hits
+#   pilosa_engine_cache_misses_total{cache=...} engine cache misses
+#   pilosa_device_bytes_skipped_total           HBM bytes the sparse path skipped
+METRIC_ENGINE_CACHE_HITS = "pilosa_engine_cache_hits_total"
+METRIC_ENGINE_CACHE_MISSES = "pilosa_engine_cache_misses_total"
+METRIC_DEVICE_BYTES_SKIPPED = "pilosa_device_bytes_skipped_total"
 
 PIPELINE_STAGES = ("queue_wait", "lower_dispatch", "device_readback", "decode")
+
+# Engine cache names labelling the hit/miss counter series (engine.py
+# resolves one handle pair per name at construction).
+ENGINE_CACHES = (
+    "stack", "mask", "zeros", "scalar", "canonical", "result_memo",
+    "batch_cse",
+)
 
 # Pre-register the always-on surface so /metrics exposes every required
 # series (with zero counts) from process start — scrape checks must not
@@ -273,7 +322,18 @@ for _stage in PIPELINE_STAGES:
 REGISTRY.histogram(
     METRIC_FRAGMENT_OP, help="Fragment-level op latency (seconds)", op="row"
 )
-del _stage
+for _cache in ENGINE_CACHES:
+    REGISTRY.counter(
+        METRIC_ENGINE_CACHE_HITS, help="Engine cache hits", cache=_cache
+    )
+    REGISTRY.counter(
+        METRIC_ENGINE_CACHE_MISSES, help="Engine cache misses", cache=_cache
+    )
+REGISTRY.counter(
+    METRIC_DEVICE_BYTES_SKIPPED,
+    help="Device HBM bytes skipped by occupancy-guided sparse dispatches",
+)
+del _stage, _cache
 
 
 class StatsClient:

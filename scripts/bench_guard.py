@@ -1,0 +1,180 @@
+#!/usr/bin/env python3
+"""Bench regression guard: diff a bench JSONL run against a baseline.
+
+CI/tooling companion to ``bench.py``: a perf PR must show its wins
+WITHOUT regressing the dense-path metrics, and "within tolerance" should
+be a command's exit code, not a reviewer eyeballing two JSON blobs.
+
+    python scripts/bench_guard.py BENCH_NEW.jsonl --baseline BENCH_OLD.jsonl
+    python scripts/bench_guard.py BENCH_NEW.jsonl --tolerance 0.15 \
+        --metric-tolerance http_count_qps=0.3 --require count_intersect_1B_cols_p50
+
+Inputs accepted for both sides:
+- bench.py output: one JSON object per line, ``{"metric", "value",
+  "unit", ...}`` (stderr progress lines are skipped);
+- a bench-runner capture like BENCH_r05.json (the JSONL lives in its
+  ``tail`` field);
+- a snapshot written by ``--write-baseline`` (``{"metrics": {...}}``) —
+  the shape BASELINE.json's ``published`` uses.
+
+Direction is unit-aware: ``us``/``ms``/``s`` regress UP, ``qps``/
+``GB/s`` regress DOWN.  Dimensionless telemetry (``queries/batch``,
+``batches``) is reported but never fails the run.  Metrics present in
+only one file are reported as added/missing; ``--require`` names
+metrics whose ABSENCE from the new run is itself a failure (a deleted
+headline metric must not pass silently).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+LOWER_BETTER = {"us", "ms", "s", "seconds"}
+HIGHER_BETTER = {"qps", "GB/s", "gbs"}
+
+
+def parse_jsonl(text: str) -> dict:
+    """{metric: record} from bench JSONL text (non-metric lines skipped)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            out[rec["metric"]] = rec
+    return out
+
+
+def load_metrics(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        if isinstance(doc.get("metrics"), dict):  # --write-baseline shape
+            return {
+                k: v for k, v in doc["metrics"].items()
+                if isinstance(v, dict) and "value" in v
+            }
+        if isinstance(doc.get("published"), dict) and doc["published"]:
+            return {
+                k: v for k, v in doc["published"].items()
+                if isinstance(v, dict) and "value" in v
+            }
+        if isinstance(doc.get("tail"), str):  # bench-runner capture
+            return parse_jsonl(doc["tail"])
+        if "metric" in doc and "value" in doc:  # single-record file
+            return {doc["metric"]: doc}
+    return parse_jsonl(text)
+
+
+def check(current: dict, baseline: dict, tolerance: float,
+          per_metric: dict, require=()) -> tuple:
+    """(failures, notes, checked): tolerance violations, informational
+    lines, and how many metrics were actually compared."""
+    failures, notes, checked = [], [], 0
+    for name in sorted(baseline):
+        base = baseline[name]
+        cur = current.get(name)
+        bv = base.get("value")
+        if not isinstance(bv, (int, float)) or bv <= 0:
+            continue
+        if cur is None:
+            (failures if name in require else notes).append(
+                f"{name}: missing from the new run (baseline {bv})"
+            )
+            continue
+        cv = float(cur["value"])
+        unit = str(base.get("unit", ""))
+        tol = per_metric.get(name, tolerance)
+        checked += 1
+        delta = cv / float(bv) - 1.0
+        line = f"{name}: {cv:g} vs {bv:g} {unit} ({delta:+.1%}, tol {tol:.0%})"
+        if unit in LOWER_BETTER and delta > tol:
+            failures.append(line)
+        elif unit in HIGHER_BETTER and -delta > tol:
+            failures.append(line)
+        else:
+            notes.append("ok " + line)
+    for name in sorted(set(current) - set(baseline)):
+        notes.append(f"{name}: new metric (no baseline)")
+    for name in require:
+        if name not in current:
+            failures.append(f"{name}: required metric missing from the new run")
+    return failures, notes, checked
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("current", help="new bench JSONL (or runner capture)")
+    ap.add_argument(
+        "--baseline", default="BASELINE.json",
+        help="baseline file (bench JSONL, runner capture, or snapshot; "
+        "default: BASELINE.json)",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.15,
+        help="default relative regression tolerance (default 0.15)",
+    )
+    ap.add_argument(
+        "--metric-tolerance", action="append", default=[],
+        metavar="NAME=TOL", help="per-metric tolerance override",
+    )
+    ap.add_argument(
+        "--require", action="append", default=[], metavar="NAME",
+        help="metric that MUST appear in the new run",
+    )
+    ap.add_argument(
+        "--write-baseline", metavar="PATH",
+        help="also snapshot the new run's metrics to PATH",
+    )
+    ap.add_argument("--quiet", action="store_true", help="failures only")
+    args = ap.parse_args(argv)
+
+    per_metric = {}
+    for spec in args.metric_tolerance:
+        name, sep, tol = spec.partition("=")
+        try:
+            if not sep:
+                raise ValueError
+            per_metric[name] = float(tol)
+        except ValueError:
+            ap.error(
+                f"--metric-tolerance expects NAME=FLOAT, got {spec!r}"
+            )
+
+    current = load_metrics(args.current)
+    baseline = load_metrics(args.baseline)
+    failures, notes, checked = check(
+        current, baseline, args.tolerance, per_metric, tuple(args.require)
+    )
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as f:
+            json.dump({"metrics": current}, f, indent=2, sort_keys=True)
+    if not args.quiet:
+        for line in notes:
+            print(line)
+    for line in failures:
+        print("REGRESSION " + line, file=sys.stderr)
+    print(
+        f"bench_guard: {checked} compared, {len(failures)} regressions",
+        file=sys.stderr,
+    )
+    if not baseline:
+        print(
+            "bench_guard: baseline has no metrics — nothing enforced",
+            file=sys.stderr,
+        )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

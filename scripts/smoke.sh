@@ -52,10 +52,44 @@ required = [
     "pilosa_query_op_seconds_bucket",
     "pilosa_pipeline_stage_seconds_bucket",
     "pilosa_fragment_op_seconds_bucket",
+    "pilosa_engine_cache_hits_total",
+    "pilosa_engine_cache_misses_total",
+    "pilosa_device_bytes_skipped_total",
 ]
 missing = [s for s in required if s not in text]
 assert not missing, f"/metrics is missing required series: {missing}"
 assert 'le="+Inf"' in text, "histogram export lacks the +Inf bucket"
+
+# Result-memo smoke: a REPEATED fused Count must be served from the
+# versioned result memo — the hit counter increments and the engine
+# dispatches nothing new (docs/sparsity.md).
+def memo_hits():
+    t = urllib.request.urlopen(
+        f"http://localhost:{port}/metrics", timeout=30
+    ).read().decode()
+    for line in t.splitlines():
+        if line.startswith("pilosa_engine_cache_hits_total") and \
+                'cache="result_memo"' in line:
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError("result_memo hit series missing from /metrics")
+
+def count_intersect():
+    # Intersect dodges the O(1) cardinality lane, so the Count flows
+    # through the fused engine path the memo fronts.
+    r = urllib.request.Request(
+        f"http://localhost:{port}/index/smoke/query",
+        data=b"Count(Intersect(Row(f=1), Row(f=1)))",
+        method="POST",
+    )
+    return json.loads(urllib.request.urlopen(r, timeout=60).read())
+
+h0 = memo_hits()
+assert count_intersect()["results"][0] == 3
+assert count_intersect()["results"][0] == 3  # repeat: memo serves it
+disp0 = eng.fused_dispatches
+assert count_intersect()["results"][0] == 3
+assert memo_hits() > h0, "repeated Count did not hit the result memo"
+assert eng.fused_dispatches == disp0, "memo hit still dispatched the device"
 
 # The root span registers from a completion callback moments after the
 # response is written; poll briefly instead of racing it.

@@ -155,6 +155,10 @@ def test_batcher_concurrent_submits_fuse(holder, mesh):
     batched program (batching-by-backpressure)."""
     eng = MeshEngine(holder, mesh)
     _force_batch_mode(eng)
+    # Memo off: this test is about FUSING, and with the result memo on
+    # the repeated queries below would (correctly) never reach the
+    # batcher at all (tests/test_sparsity.py covers that path).
+    eng.result_memo.maxsize = 0
     calls = [_call(q) for q in QUERIES]
     shards = list(range(8))
     want = {str(c): eng.count("i", c, shards) for c in calls}
@@ -398,8 +402,17 @@ def test_batch_tier_compile_key_stability(holder, mesh):
     base = eng.count("i", c, shards)
 
     def run(k):
-        got = eng.count_many("i", [c] * k, [shards] * k)
-        assert got == [base] * k
+        # DISTINCT queries per slot: identical entries would CSE down
+        # to one unique and take the scalar count path, never building
+        # the batch program this test pins (tests/test_sparsity.py
+        # covers that route).  Missing row ids are fine — presence is
+        # slot-vector data, and the structure is what compiles.
+        calls = [
+            _call(f"Intersect(Row(f=10), Row(f={1000 + i}))")
+            for i in range(k)
+        ]
+        got = eng.count_many("i", calls, [shards] * k)
+        assert got == [0] * k
 
     run(9)  # tier 64: compiles once
     size_after_first = k_mod.count_batch_tree._cache_size()
@@ -409,13 +422,12 @@ def test_batch_tier_compile_key_stability(holder, mesh):
         "a drain size within the tier compiled a new executable"
     )
     # Different ROW IDS in the same structure also reuse it (ids are
-    # slot-vector data), including MISSING rows (presence is data too).
+    # slot-vector data), including PRESENT rows mixed with missing.
     mixed = [
-        _call("Intersect(Row(f=10), Row(f=999))"),
-        _call("Intersect(Row(f=998), Row(f=11))"),
-    ]
-    got = eng.count_many("i", mixed * 6, [shards] * 12)
-    assert got == [0] * 12
+        _call(f"Intersect(Row(f={2000 + i}), Row(f=11))") for i in range(11)
+    ] + [c]
+    got = eng.count_many("i", mixed, [shards] * 12)
+    assert got == [0] * 11 + [base]
     assert k_mod.count_batch_tree._cache_size() == size_after_first
     # A new TIER adds at most one executable (zero when an earlier test
     # in this process already compiled this structure at tier 8 — the

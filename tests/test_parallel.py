@@ -532,11 +532,12 @@ def test_word_level_sync_payload(holder, mesh):
     frag.set_bit(0, 6)
     frag.set_bit(0, 40)
     ver, dirty = frag.sync_snapshot(v0)
-    kind, widxs, vals = dirty[0]
+    kind, widxs, vals, occ = dirty[0]
     assert kind == "words"
     assert widxs.tolist() == [0, 1]  # cols 6 and 40 -> words 0 and 1
     assert vals.dtype == np.uint32 and len(vals) == 2
     assert vals[0] == frag.row_words(0)[0]
+    assert occ == frag.row_occupancy(0) == 1  # all bits in block 0
     # A dense row load is a whole-row event.
     frag.load_row_words(1, np.ones(bitops.WORDS64, dtype=np.uint64))
     _, dirty = frag.sync_snapshot(ver)
