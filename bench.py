@@ -309,7 +309,51 @@ def report_observability(api):
         )
 
 
-def main(depth_sweep=False):
+SCRAPE_SERIES = (
+    "pilosa_engine_resident_bytes",
+    "pilosa_engine_evicted_bytes",
+    "pilosa_engine_compile_total",
+    "pilosa_engine_compile_cache_keys",
+    'pilosa_engine_compile_seconds{phase="compile"}',
+    'pilosa_engine_compile_seconds{phase="trace"}',
+    "pilosa_engine_evictions_total",
+    "pilosa_engine_stack_rebuilds_total",
+    "pilosa_device_bytes_skipped_total",
+)
+
+
+def report_scrape(port):
+    """--scrape: append the post-run /metrics device gauges (HBM
+    residency, compile totals, eviction counters) to the JSONL stream,
+    so a bench record carries the engine's end-state alongside its
+    latency numbers and scripts/bench_guard.py can diff either."""
+    import urllib.request
+
+    text = urllib.request.urlopen(
+        f"http://localhost:{port}/metrics", timeout=30
+    ).read().decode()
+    samples = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name, sep, value = line.rpartition(" ")
+        if sep:
+            samples[name] = value
+    for name in SCRAPE_SERIES:
+        raw = samples.get(name)
+        if raw is None:
+            continue
+        try:
+            v = float(raw)
+        except ValueError:
+            continue
+        # Deliberately dimensionless: cumulative counters and end-state
+        # gauges have no regression direction bench_guard should enforce
+        # by default.
+        emit_raw(name, v, "bytes" if "bytes" in name else "", 1.0)
+
+
+def main(depth_sweep=False, scrape=False):
     progress("importing jax")
     import jax
     import jax.numpy as jnp
@@ -941,6 +985,8 @@ print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
             )
         eng._batcher.stop()
         eng._batcher = None  # back to the default-depth lazy batcher
+    if scrape:
+        report_scrape(port)
     httpd.shutdown()
     emit("http_count_e2e_p50", t_http, c_c2)
     emit_raw("http_count_qps", qps, "qps", qps * c_c2)
@@ -1183,8 +1229,16 @@ if __name__ == "__main__":
         "bytes_skipped, speedup, and memo-hit lines in the same JSONL "
         "format — docs/sparsity.md)",
     )
+    ap.add_argument(
+        "--scrape",
+        action="store_true",
+        help="append the post-run /metrics device gauges (resident "
+        "bytes, compile totals, eviction counters) to the JSONL output "
+        "(diffable with scripts/bench_guard.py --format prom or as "
+        "JSONL)",
+    )
     args = ap.parse_args()
     if args.density_sweep:
         density_sweep()
     else:
-        main(depth_sweep=args.depth_sweep)
+        main(depth_sweep=args.depth_sweep, scrape=args.scrape)

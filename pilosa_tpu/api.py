@@ -13,7 +13,7 @@ import datetime as dt
 import io
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from . import __version__, pql
 from .util import tracing
@@ -118,11 +118,24 @@ class API:
         mesh_engine=None,
         long_query_time: float = 0.0,
         logger=None,
+        journal=None,
     ):
-        from .util import NopLogger, Tracer
+        from .util import NopLogger, Tracer, events as events_mod
 
         self.long_query_time = long_query_time
         self.logger = logger if logger is not None else NopLogger()
+        # Structured event journal served at GET /debug/events.  Default
+        # resolution order: an explicit per-node journal (Server wires
+        # its own through every component), else the engine's (so a
+        # standalone API+engine pair shares one), else the process
+        # global.
+        if journal is None:
+            journal = getattr(mesh_engine, "journal", None) or events_mod.JOURNAL
+        self.journal = journal
+        # Gossip transport handle for the readiness probe's convergence
+        # check; set by the server after _setup_gossip (None when no
+        # gossip is configured).
+        self.gossip = None
         # Tracing is always-on at the serving tier: the default is a
         # real span tracer (cheap — a few object allocations per query)
         # so /debug/traces works out of the box; pass a NopTracer to
@@ -668,6 +681,34 @@ class API:
         if self.cluster is not None:
             return self.cluster.state
         return "NORMAL"
+
+    def readiness(self) -> Tuple[bool, List[str]]:
+        """Readiness verdict with reason strings (the GET /readyz
+        contract): ready iff the holder is open, the engine (when
+        configured) has not been closed, the cluster state is NORMAL,
+        and gossip has converged (no member stuck in SUSPECT).  A node
+        that answers /healthz (alive) but not /readyz should be kept in
+        the pool but taken out of rotation — e.g. while a resize is
+        redistributing fragments."""
+        reasons: List[str] = []
+        if not self.holder.opened:
+            reasons.append("holder not opened")
+        eng = self.mesh_engine
+        if eng is not None and getattr(eng, "_closed", False):
+            reasons.append("engine closed")
+        if self.cluster is not None and self.cluster.state != "NORMAL":
+            reasons.append(f"cluster state {self.cluster.state}")
+        gossip = self.gossip
+        if gossip is not None:
+            suspects = sorted(
+                mid for mid, state in gossip.member_states().items()
+                if state == "suspect"
+            )
+            if suspects:
+                reasons.append(
+                    "gossip not converged: suspect " + ",".join(suspects)
+                )
+        return (not reasons), reasons
 
     def version(self) -> str:
         return __version__

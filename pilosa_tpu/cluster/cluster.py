@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..util import events as events_mod
+
 DEFAULT_PARTITION_N = 256
 
 STATE_STARTING = "STARTING"
@@ -185,6 +187,7 @@ class Cluster:
         path: Optional[str] = None,
         client_factory: Optional[Callable[[str], object]] = None,
         logger=None,
+        journal=None,
     ):
         self.node = node
         self.replica_n = max(replica_n, 1)
@@ -194,6 +197,9 @@ class Cluster:
         self.nodes: List[Node] = [node]
         self._lock = threading.RLock()
         self.logger = logger
+        # Structured event journal: cluster state transitions and resize
+        # job phases append here (/debug/events?type=cluster).
+        self.journal = journal if journal is not None else events_mod.JOURNAL
         self.holder = None  # attached by the server/harness
         # Gossip-piggyback hook for SendAsync (set by server._setup_gossip).
         self.gossip_send_async = None
@@ -402,11 +408,23 @@ class Cluster:
             node.state = "READY"
         self._determine_state()
 
+    def _note_state(self, old: str, new: str, via: str):
+        """Journal one cluster state transition (the phase changes an
+        operator reconstructs an incident from: STARTING/NORMAL/
+        DEGRADED/RESIZING)."""
+        if old == new:
+            return
+        self.journal.append(
+            "cluster.state", node=self.node.id, via=via,
+            **{"from": old, "to": new},
+        )
+
     def _determine_state(self):
         """determineClusterState (cluster.go:522)."""
         with self._lock:
             if self.state == STATE_RESIZING:
                 return
+            old = self.state
             down = sum(1 for n in self.nodes if n.state == "DOWN")
             if down == 0:
                 self.state = STATE_NORMAL
@@ -414,10 +432,14 @@ class Cluster:
                 self.state = STATE_DEGRADED
             else:
                 self.state = STATE_STARTING
+            new = self.state
+        self._note_state(old, new, via="membership")
 
     def set_state(self, state: str):
         with self._lock:
+            old = self.state
             self.state = state
+        self._note_state(old, state, via="set-state")
 
     def _emit(self, kind: str, node: Node):
         for fn in self.event_listeners:
@@ -585,6 +607,10 @@ class Cluster:
                             self.current_job.id,
                             action[0],
                         )
+                    self.journal.append(
+                        "cluster.resize.queued",
+                        behindJob=self.current_job.id, action=action[0],
+                    )
                     return RESIZE_JOB_QUEUED
                 if self.logger:
                     self.logger.printf(
@@ -595,6 +621,11 @@ class Cluster:
             job = ResizeJob([n.id for n in new_nodes], action="diff")
             self.jobs[job.id] = job
             self.current_job = job
+        self.journal.append(
+            "cluster.resize.start", jobId=job.id,
+            action=action[0] if action else "diff",
+            nodes=[n.id for n in new_nodes],
+        )
         self.set_state(STATE_RESIZING)
         self.send_sync({"type": "set-state", "state": STATE_RESIZING})
         try:
@@ -631,6 +662,11 @@ class Cluster:
                 self.logger.printf(
                     "resize job %d aborted: %s", job.id, job.error
                 )
+            self.journal.append(
+                "cluster.resize.done" if state == RESIZE_JOB_DONE
+                else "cluster.resize.abort",
+                jobId=job.id, state=state, error=job.error or "",
+            )
             if state == RESIZE_JOB_DONE and apply_membership is not None:
                 apply_membership()
             return state

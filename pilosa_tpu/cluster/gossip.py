@@ -45,6 +45,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..util import events as events_mod
+from ..util.stats import METRIC_GOSSIP_TRANSITIONS, REGISTRY
+
 ALIVE = "alive"
 SUSPECT = "suspect"
 DEAD = "dead"
@@ -92,6 +95,8 @@ class GossipNode:
         on_leave: Optional[Callable] = None,
         on_message: Optional[Callable] = None,
         logger=None,
+        journal=None,
+        dead_reap_seconds: float = 30.0,
     ):
         self.node_id = node_id
         self.meta = meta or {}
@@ -106,6 +111,16 @@ class GossipNode:
         self.on_leave = on_leave
         self.on_message = on_message
         self.logger = logger
+        # Structured event journal: every membership state transition,
+        # join, and DEAD-member reap lands here (and in the
+        # pilosa_gossip_state_transitions_total{from,to} counter) — a
+        # flapping member is visible at /debug/events?type=gossip
+        # instead of only as silent member-table mutation.
+        self.journal = journal if journal is not None else events_mod.JOURNAL
+        # DEAD members are kept this long (so late updates about them
+        # still rank against their incarnation), then reaped from the
+        # member table — journaled, not silently dropped.
+        self.dead_reap_seconds = dead_reap_seconds
 
         # Shared-port UDP+TCP transport (memberlist's shared transport).
         # With port=0 the kernel picks the UDP port; the matching TCP port
@@ -443,9 +458,15 @@ class GossipNode:
                     me.incarnation = self.incarnation
                     me.state = ALIVE
                 self._queue_update(me.to_update())
+                self.journal.append(
+                    "gossip.refute", member=uid,
+                    suspected_as=u["state"], incarnation=self.incarnation,
+                )
             return
         joined = False
         left = False
+        prev = None
+        new_state = None
         with self._lock:
             m = self.members.get(uid)
             if m is None:
@@ -463,6 +484,9 @@ class GossipNode:
                 if u["inc"] == m.incarnation and rank[u["state"]] <= rank[m.state]:
                     return
                 was_dead = m.state == DEAD
+                if m.state != u["state"]:
+                    prev = m.state
+                    new_state = u["state"]
                 m.state = u["state"]
                 m.incarnation = u["inc"]
                 m.since = time.monotonic()
@@ -471,6 +495,13 @@ class GossipNode:
                 if was_dead and m.state == ALIVE:
                     joined = True
             self._queue_update(m.to_update())
+        if prev is not None:
+            # A transition learned from a peer's update (not our own
+            # probe) still journals + counts: both survivors of a
+            # failure see the SUSPECT -> DEAD sequence in THEIR journal.
+            self._record_transition(uid, prev, new_state, via="update")
+        elif joined:
+            self.journal.append("gossip.join", member=uid, state=m.state)
         if joined and self.on_join:
             self.on_join(m)
         if left and self.on_leave:
@@ -517,6 +548,18 @@ class GossipNode:
         self._acks.pop(seq, None)
         return ok
 
+    def _record_transition(self, uid: str, frm: str, to: str, via: str):
+        """One member state transition: a journal event plus the
+        pilosa_gossip_state_transitions_total{from,to} counter.  ``via``
+        says which mechanism observed it (probe, update, reap) —
+        distinguishing a local failure-detector verdict from a
+        gossip-learned one."""
+        self.journal.append(
+            "gossip.transition", member=uid,
+            **{"from": frm, "to": to, "via": via},
+        )
+        REGISTRY.inc(METRIC_GOSSIP_TRANSITIONS, **{"from": frm, "to": to})
+
     def _mark(self, uid: str, state: str):
         left = False
         with self._lock:
@@ -526,17 +569,22 @@ class GossipNode:
             if m.state == DEAD and state != ALIVE:
                 return
             was_dead = m.state == DEAD
+            prev = m.state
             m.state = state
             m.since = time.monotonic()
             if state == DEAD and not was_dead:
                 left = True
             self._queue_update(m.to_update())
+        self._record_transition(uid, prev, state, via="probe")
         if left and self.on_leave:
             self.on_leave(m)
 
     def _reap_loop(self):
-        """Promote timed-out suspects to dead (suspicion timeout) and
-        expire old broadcast-dedup ids (bounded memory)."""
+        """Promote timed-out suspects to dead (suspicion timeout),
+        remove long-DEAD members from the table (journaled — removal is
+        a membership fact an operator reconstructing a flap needs, not
+        silent bookkeeping), and expire old broadcast-dedup ids
+        (bounded memory)."""
         while not self._closing.wait(self.probe_interval):
             now = time.monotonic()
             with self._lock:
@@ -547,13 +595,26 @@ class GossipNode:
                 ]:
                     del self._seen_bcasts[bid]
             dead = []
+            reaped = []
             with self._lock:
-                for m in self.members.values():
+                for m in list(self.members.values()):
                     if (
                         m.state == SUSPECT
                         and now - m.since > self.suspicion_timeout
                     ):
                         dead.append(m.id)
+                    elif (
+                        m.state == DEAD
+                        and m.id != self.node_id
+                        and now - m.since > self.dead_reap_seconds
+                    ):
+                        del self.members[m.id]
+                        reaped.append(m.id)
+            for uid in reaped:
+                self.journal.append(
+                    "gossip.reap", member=uid,
+                    after_seconds=round(self.dead_reap_seconds, 3),
+                )
             for uid in dead:
                 self._mark(uid, DEAD)
 
@@ -562,6 +623,13 @@ class GossipNode:
     def alive_members(self) -> List[Member]:
         with self._lock:
             return [m for m in self.members.values() if m.state == ALIVE]
+
+    def member_states(self) -> Dict[str, str]:
+        """{member id: state} snapshot — the readiness probe's
+        convergence check reads this without touching the lock-guarded
+        table directly."""
+        with self._lock:
+            return {m.id: m.state for m in self.members.values()}
 
 
 def _read_frame(conn) -> Optional[dict]:

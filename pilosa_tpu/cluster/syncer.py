@@ -10,24 +10,49 @@ payloads; diff row/column attributes by block checksum.
 
 from __future__ import annotations
 
+import time
 from typing import List
 
 import numpy as np
 
 from ..core.fragment import SHARD_WIDTH
 from ..roaring import Bitmap
+from ..util import events as events_mod
 
 
 class HolderSyncer:
-    def __init__(self, holder, cluster, logger=None):
+    def __init__(self, holder, cluster, logger=None, journal=None):
         self.holder = holder
         self.cluster = cluster
         self.logger = logger
+        # Pass start/end (with repair tallies) land in the structured
+        # event journal: anti-entropy progress is an operator-facing
+        # fact (/debug/events?type=antientropy), not just a log line.
+        self.journal = journal if journal is not None else events_mod.JOURNAL
         self.closing = False
+        # Per-pass repair tallies (reset at each sync_holder entry).
+        self._pass = {}
 
     # -- entry (holder.go SyncHolder :659) ---------------------------------
 
     def sync_holder(self):
+        self._pass = {
+            "fragments": 0, "blocksSynced": 0,
+            "bitsSet": 0, "bitsCleared": 0, "errors": 0,
+        }
+        t0 = time.monotonic()
+        self.journal.append("antientropy.start", node=self.cluster.node.id)
+        try:
+            self._sync_all()
+        finally:
+            self.journal.append(
+                "antientropy.end",
+                node=self.cluster.node.id,
+                seconds=round(time.monotonic() - t0, 6),
+                **self._pass,
+            )
+
+    def _sync_all(self):
         for index_name, idx in list(self.holder.indexes.items()):
             self._sync_index_attrs(index_name, idx)
             for field_name, f in list(idx.fields.items()):
@@ -43,10 +68,12 @@ class HolderSyncer:
                         ):
                             continue
                         try:
+                            self._pass["fragments"] += 1
                             self.sync_fragment(
                                 index_name, field_name, view_name, shard
                             )
                         except Exception as e:
+                            self._pass["errors"] += 1
                             if self.logger:
                                 self.logger.printf(
                                     "sync %s/%s/%s/%d failed: %s",
@@ -135,6 +162,13 @@ class HolderSyncer:
                 )
             )
         sets, clears = frag.merge_block(block, peer_pairs)
+        self._pass["blocksSynced"] = self._pass.get("blocksSynced", 0) + 1
+        self._pass["bitsSet"] = (
+            self._pass.get("bitsSet", 0) + sum(len(s) for s in sets)
+        )
+        self._pass["bitsCleared"] = (
+            self._pass.get("bitsCleared", 0) + sum(len(c) for c in clears)
+        )
         # Push per-peer diffs as roaring payloads (bitsToRoaringData).
         for node, s, c in zip(replicas, sets, clears):
             if s:

@@ -18,12 +18,15 @@ from ..util import tracing
 
 class ClientError(Exception):
     """HTTP client failure.  ``code`` carries the response status (None
-    for transport errors) so callers can branch on it instead of
-    string-matching the message."""
+    for transport errors) and ``body`` the decoded response body, so
+    callers can branch on them instead of string-matching the
+    message."""
 
-    def __init__(self, message: str, code: Optional[int] = None):
+    def __init__(self, message: str, code: Optional[int] = None,
+                 body: str = ""):
         super().__init__(message)
         self.code = code
+        self.body = body
 
 
 class InternalClient:
@@ -75,7 +78,8 @@ class InternalClient:
         except HTTPError as e:
             detail = e.read().decode(errors="replace")
             raise ClientError(
-                f"{method} {path}: {e.code}: {detail}", code=e.code
+                f"{method} {path}: {e.code}: {detail}", code=e.code,
+                body=detail,
             ) from e
         except URLError as e:
             raise ClientError(f"{method} {path}: {e.reason}") from e
@@ -267,6 +271,27 @@ class InternalClient:
 
     def status(self) -> dict:
         return self._get("/status")
+
+    def metrics(self) -> str:
+        """The peer's Prometheus exposition (GET /metrics) — what the
+        coordinator's /cluster/metrics federation scrapes per node."""
+        return self._get("/metrics", raw=True).decode()
+
+    def health(self) -> dict:
+        return self._get("/healthz")
+
+    def readiness(self) -> dict:
+        """GET /readyz body regardless of status (a 503 still carries
+        the reasons JSON)."""
+        try:
+            return self._get("/readyz")
+        except ClientError as e:
+            if e.code == 503 and e.body:
+                try:
+                    return json.loads(e.body)
+                except json.JSONDecodeError:
+                    pass  # a proxy's HTML 503: surface the ClientError
+            raise
 
     def max_shards(self) -> dict:
         return self._get("/internal/shards/max")["standard"]

@@ -15,7 +15,7 @@ import threading
 import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..api import API, ApiError, ImportRequest, ImportValueRequest, NotFoundError, QueryRequest
@@ -26,6 +26,52 @@ from ..util.stats import REGISTRY
 from .wire import response_to_json
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Process start reference for /healthz uptime.
+_START_MONOTONIC = time.monotonic()
+
+# Per-node scrape failure marker in the federated /cluster/metrics
+# exposition (NOT registered in the process REGISTRY: it describes the
+# federation attempt, not this node).
+SCRAPE_ERROR_SERIES = "pilosa_node_scrape_error"
+
+
+def _relabel_prometheus(text: str, node_id: str, seen_meta: set) -> List[str]:
+    """Stamp ``node="<id>"`` onto every sample of one node's exposition
+    so the federated output is one valid exposition labeled by origin.
+    # HELP / # TYPE lines are kept the FIRST time a metric name appears
+    (duplicate metadata is a text-format violation); ``seen_meta`` is
+    the cross-node dedup set the caller threads through."""
+    esc = node_id.replace("\\", "\\\\").replace('"', '\\"')
+    label = f'node="{esc}"'
+    out: List[str] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)  # '#', HELP/TYPE, name, rest
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                key = (parts[1], parts[2])
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            out.append(line)
+            continue
+        name_labels, sep, value = line.rpartition(" ")
+        if not sep:
+            continue  # not a sample line; drop rather than corrupt
+        if name_labels.endswith("}"):
+            brace = name_labels.index("{")
+            inner = name_labels[brace + 1 : -1]
+            name_labels = (
+                name_labels[:brace]
+                + "{" + label + ("," + inner if inner else "") + "}"
+            )
+        else:
+            name_labels = name_labels + "{" + label + "}"
+        out.append(f"{name_labels} {value}")
+    return out
 
 
 class DeferredResponse:
@@ -135,8 +181,12 @@ class Handler:
         r("POST", "/cluster/resize/remove-node", self._remove_node)
         r("POST", "/cluster/resize/set-coordinator", self._set_coordinator)
         r("GET", "/metrics", self._metrics)
+        r("GET", "/healthz", self._healthz)
+        r("GET", "/readyz", self._readyz)
+        r("GET", "/cluster/metrics", self._cluster_metrics)
         r("GET", "/debug/vars", self._debug_vars)
         r("GET", "/debug/traces", self._debug_traces)
+        r("GET", "/debug/events", self._debug_events)
         r("GET", "/debug/pprof", self._debug_pprof)
         r("GET", "/debug/pprof/goroutine", self._debug_pprof)
         r("GET", "/debug/pprof/profile", self._debug_pprof_profile)
@@ -476,12 +526,11 @@ class Handler:
         old, new = self.api.set_coordinator(doc.get("id", ""))
         return {"old": old, "new": new}
 
-    def _metrics(self, q, b, **kw):
-        """GET /metrics: the process registry (latency histograms per
-        pipeline stage / query op / fragment op, counters, gauges) in
-        Prometheus text exposition format."""
-        # Fold the live pipeline gauges in so scrape-time depth/occupancy
-        # need no separate surface.
+    def _metrics_text(self) -> str:
+        """The local node's Prometheus exposition: the process registry
+        with live pipeline gauges and the engine's HBM/compile gauges
+        refreshed at pull time (per-node collection, pull-time
+        aggregation — the Monarch pattern)."""
         eng = getattr(self.api, "mesh_engine", None)
         if eng is not None and hasattr(eng, "pipeline_snapshot"):
             snap = eng.pipeline_snapshot()
@@ -494,7 +543,143 @@ class Handler:
                 REGISTRY.set_gauge(
                     "pilosa_pipeline_batches_total", snap.get("batches", 0)
                 )
-        return 200, PROMETHEUS_CONTENT_TYPE, REGISTRY.prometheus_text().encode()
+        # HBM residency + compile-cache gauges (resident bytes, evicted
+        # backlog, distinct compile keys) refresh at scrape time.
+        if eng is not None and hasattr(eng, "refresh_metrics"):
+            eng.refresh_metrics()
+        return REGISTRY.prometheus_text()
+
+    def _metrics(self, q, b, **kw):
+        """GET /metrics: the process registry (latency histograms per
+        pipeline stage / query op / fragment op, counters, gauges) in
+        Prometheus text exposition format."""
+        return 200, PROMETHEUS_CONTENT_TYPE, self._metrics_text().encode()
+
+    def _healthz(self, q, b, **kw):
+        """GET /healthz: liveness — the process is up and the route
+        table answers.  Always 200; readiness (can this node take
+        traffic?) is /readyz's job."""
+        return {
+            "status": "ok",
+            "uptimeSeconds": round(time.monotonic() - _START_MONOTONIC, 3),
+        }
+
+    def _readyz(self, q, b, **kw):
+        """GET /readyz: readiness with reason strings — 200 only when
+        the holder is open, the engine is live, the cluster state is
+        NORMAL, and gossip has converged; 503 with the failing reasons
+        otherwise (the load-balancer / orchestrator contract)."""
+        ready, reasons = self.api.readiness()
+        payload = json.dumps(
+            {"ready": ready, "reasons": reasons, "state": self.api.state()}
+        ).encode()
+        return (200 if ready else 503), "application/json", payload
+
+    def _debug_events(self, q, b, **kw):
+        """GET /debug/events: the node's structured event journal
+        (gossip transitions, resize phases, anti-entropy passes, engine
+        evictions), filterable with ?type= (exact or family prefix) and
+        bounded with ?limit= (newest N)."""
+        journal = getattr(self.api, "journal", None)
+        if journal is None:
+            return {"events": [], "capacity": 0, "dropped": 0, "node": ""}
+        typ = q.get("type", [None])[0]
+        try:
+            limit = int(q.get("limit", ["256"])[0])
+        except ValueError:
+            raise ValueError("limit must be an integer")
+        return journal.to_doc(type=typ, limit=limit)
+
+    # Per-node scrape budget for the federation fan-out.
+    CLUSTER_METRICS_TIMEOUT = 5.0
+    # Shared, bounded scrape pool (lazy): a per-request executor would
+    # leak a straggler thread per unreachable peer per scrape — with a
+    # 15 s Prometheus interval against a blackholed node that
+    # accumulates forever and stalls interpreter exit on the atexit
+    # join.  One bounded pool caps the straggler count for the process.
+    _fed_pool = None
+    _fed_pool_lock = threading.Lock()
+
+    @classmethod
+    def _federation_pool(cls):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with cls._fed_pool_lock:
+            if cls._fed_pool is None:
+                cls._fed_pool = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="fed-scrape"
+                )
+            return cls._fed_pool
+
+    def _cluster_metrics(self, q, b, **kw):
+        """GET /cluster/metrics: federate every cluster node's /metrics
+        into ONE exposition, each sample labeled node="<id>" — a single
+        scrape target for the whole cluster (pull-time federation; no
+        node streams samples anywhere).  The fan-out rides the existing
+        internal clients, is timeout-bounded per request, and a node
+        that cannot be scraped (down, slow, DOWN-state) degrades to
+        pilosa_node_scrape_error{node=...} 1 instead of failing the
+        scrape."""
+        try:
+            timeout = min(
+                max(
+                    float(
+                        q.get(
+                            "timeout", [str(self.CLUSTER_METRICS_TIMEOUT)]
+                        )[0]
+                    ),
+                    0.1,
+                ),
+                30.0,
+            )
+        except ValueError:
+            timeout = self.CLUSTER_METRICS_TIMEOUT
+        local_id = self.api.node()["id"]
+        cluster = getattr(self.api, "cluster", None)
+        seen_meta: set = set()
+        body: List[str] = []
+        errors: Dict[str, int] = {local_id: 0}
+        if cluster is None:
+            body.extend(
+                _relabel_prometheus(self._metrics_text(), local_id, seen_meta)
+            )
+        else:
+            nodes = list(cluster.nodes)
+            remote = [
+                n for n in nodes if n.id != local_id and n.state != "DOWN"
+            ]
+            for n in nodes:
+                if n.id != local_id and n.state == "DOWN":
+                    errors[n.id] = 1
+            pool = self._federation_pool()
+            futures = {
+                n.id: pool.submit(cluster.client(n).metrics) for n in remote
+            }
+            # The local node never scrapes itself over HTTP.
+            body.extend(
+                _relabel_prometheus(self._metrics_text(), local_id, seen_meta)
+            )
+            deadline = time.monotonic() + timeout
+            for n in remote:
+                try:
+                    text = futures[n.id].result(
+                        timeout=max(0.0, deadline - time.monotonic())
+                    )
+                    body.extend(_relabel_prometheus(text, n.id, seen_meta))
+                    errors[n.id] = 0
+                except Exception:  # noqa: BLE001 — degraded, not fatal
+                    errors[n.id] = 1
+                    futures[n.id].cancel()  # drop it if not yet started
+        head = [
+            f"# HELP {SCRAPE_ERROR_SERIES} 1 when the node's /metrics "
+            "could not be federated within the timeout",
+            f"# TYPE {SCRAPE_ERROR_SERIES} gauge",
+        ]
+        for nid in sorted(errors):
+            esc = nid.replace("\\", "\\\\").replace('"', '\\"')
+            head.append(f'{SCRAPE_ERROR_SERIES}{{node="{esc}"}} {errors[nid]}')
+        text = "\n".join(head + body) + "\n"
+        return 200, PROMETHEUS_CONTENT_TYPE, text.encode()
 
     def _debug_traces(self, q, b, **kw):
         """GET /debug/traces: recent + slow span trees (JSON), each node

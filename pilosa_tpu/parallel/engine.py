@@ -46,16 +46,91 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
 from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
+from ..util import events as events_mod
 from ..util.stats import (
+    COMPILE_PHASES,
     ENGINE_CACHES,
     METRIC_DEVICE_BYTES_SKIPPED,
     METRIC_ENGINE_CACHE_HITS,
     METRIC_ENGINE_CACHE_MISSES,
+    METRIC_ENGINE_COMPILE,
+    METRIC_ENGINE_COMPILE_KEYS,
+    METRIC_ENGINE_COMPILE_SECONDS,
+    METRIC_ENGINE_EVICTED_BYTES,
+    METRIC_ENGINE_EVICTIONS,
+    METRIC_ENGINE_REBUILDS,
+    METRIC_ENGINE_RESIDENT_BYTES,
     REGISTRY,
 )
 from . import kernels
 from . import sparse as sparse_mod
 from .mesh import SHARD_AXIS, pad_shards, put_global
+
+
+# -- compile-cache telemetry -------------------------------------------------
+# JAX publishes per-compile durations through jax.monitoring; one
+# process-wide listener turns them into the pilosa_engine_compile_total /
+# pilosa_engine_compile_seconds{phase} counters so a recompile storm —
+# e.g. a compile-key property regression re-lowering every drain — is
+# visible as a counter slope on /metrics instead of only as mysterious
+# tail latency.
+_COMPILE_EVENTS = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "compile",
+}
+_compile_monitor_installed = False
+
+
+def _install_compile_monitor():
+    global _compile_monitor_installed
+    if _compile_monitor_installed:
+        return
+    _compile_monitor_installed = True
+    try:
+        from jax import monitoring as _jax_monitoring
+    except Exception:  # noqa: BLE001 — no monitoring: counters stay 0
+        return
+    total = REGISTRY.counter(METRIC_ENGINE_COMPILE)
+    secs = {
+        phase: REGISTRY.counter(METRIC_ENGINE_COMPILE_SECONDS, phase=phase)
+        for phase in COMPILE_PHASES
+    }
+
+    def _listener(name, duration_secs, **kwargs):
+        phase = _COMPILE_EVENTS.get(name)
+        if phase is None:
+            return
+        try:
+            secs[phase].inc(duration_secs)
+            if phase == "compile":
+                total.inc()
+        except Exception:  # noqa: BLE001 — telemetry must never break jax
+            pass
+
+    try:
+        _jax_monitoring.register_event_duration_secs_listener(_listener)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+_install_compile_monitor()
+
+
+def _compile_cache_keys() -> int:
+    """Distinct live compile keys across the kernel modules' jitted
+    entry points (each static-arg/shape combination is one executable in
+    jit's cache) — the pilosa_engine_compile_cache_keys gauge."""
+    n = 0
+    for mod in (kernels, sparse_mod):
+        for v in vars(mod).values():
+            cache_size = getattr(v, "_cache_size", None)
+            if callable(cache_size):
+                try:
+                    n += cache_size()
+                except Exception:  # noqa: BLE001
+                    pass
+    return n
 
 
 class _FieldStack:
@@ -376,10 +451,17 @@ class MeshEngine:
         mesh: Mesh,
         max_resident_bytes: int = DEFAULT_RESIDENCY_BYTES,
         logger=None,
+        journal=None,
     ):
         self.holder = holder
         self.mesh = mesh
         self.logger = logger
+        # Structured event journal: the residency manager appends stack
+        # evictions, memo resets, and the final shutdown event here
+        # (/debug/events?type=engine).  Events created while a query
+        # span is ambient carry its trace id — an eviction triggered by
+        # a query's admission joins that query's trace.
+        self.journal = journal if journal is not None else events_mod.JOURNAL
         # LRU residency manager: hot field stacks stay dense in HBM up to
         # the budget, cold ones are dropped back to host truth (the
         # explicit replacement for the reference's mmap paging,
@@ -498,7 +580,13 @@ class MeshEngine:
         self._bytes_skipped_counter = REGISTRY.counter(
             METRIC_DEVICE_BYTES_SKIPPED
         )
+        # Residency/compile introspection handles (resolved once).
+        self._evictions_counter = REGISTRY.counter(METRIC_ENGINE_EVICTIONS)
+        self._rebuilds_counter = REGISTRY.counter(METRIC_ENGINE_REBUILDS)
         self._closed = False
+        # True only inside close(): the teardown evict-everything loop
+        # must not flood the journal with one event per stack.
+        self._closing_down = False
 
     def _cache_hit(self, name: str):
         self.cache_stats[name][0] += 1
@@ -706,6 +794,7 @@ class MeshEngine:
         ):
             self._evict(next(iter(self._stacks)))
         self.stack_rebuilds += 1
+        self._rebuilds_counter.inc()
         stack = _FieldStack(
             put_global(self.mesh, mat, P(None, SHARD_AXIS)),
             row_index,
@@ -889,6 +978,15 @@ class MeshEngine:
             self._pending_free.append(
                 (weakref.ref(stack.matrix), stack.matrix.nbytes)
             )
+            self._evictions_counter.inc()
+            if not self._closing_down:
+                index, field, view = key
+                self.journal.append(
+                    "engine.evict",
+                    index=index, field=field, view=view,
+                    bytes=int(stack.matrix.nbytes),
+                    residentBytes=int(self._resident_bytes),
+                )
 
     def _pending_bytes(self) -> int:
         """Purge freed evictees; return bytes of evicted-but-still-live
@@ -2330,32 +2428,78 @@ class MeshEngine:
             except Exception:  # noqa: BLE001 — teardown must not raise
                 pass
             self._batcher = None
+        released = 0
+        stacks = 0
+        memo_entries = 0
         with self._dispatch_lock, self._stacks_lock:
-            for key in list(self._stacks):
-                self._evict(key)
-            # _evict parks weakrefs in _pending_free for admission
-            # accounting; on close nothing will admit again — drop them.
-            self._pending_free = []
-            self._resident_bytes = 0
-            self._masks.clear()
-            self._zeros.clear()
-            self._scalars.clear()
-            self._bits.clear()
-            self._canonical.clear()
-            self._topn_cands.clear()
-            self.result_memo.clear()
-            self._closed = True
+            was_closed = self._closed
+            self._closing_down = True
+            try:
+                stacks = len(self._stacks)
+                released = self._resident_bytes
+                for key in list(self._stacks):
+                    self._evict(key)
+                # _evict parks weakrefs in _pending_free for admission
+                # accounting; on close nothing will admit again — drop them.
+                self._pending_free = []
+                self._resident_bytes = 0
+                self._masks.clear()
+                self._zeros.clear()
+                self._scalars.clear()
+                self._bits.clear()
+                self._canonical.clear()
+                self._topn_cands.clear()
+                memo_entries = len(self.result_memo)
+                self.result_memo.clear()
+                self._closed = True
+            finally:
+                self._closing_down = False
+            # Flush gauge state INSIDE the lock: a /metrics scrape racing
+            # shutdown reads resident-bytes 0, never a stale pre-close
+            # value (the registry itself stays readable until the server
+            # socket closes — server.close() keeps that ordering).
+            REGISTRY.set_gauge(METRIC_ENGINE_RESIDENT_BYTES, 0)
+            REGISTRY.set_gauge(METRIC_ENGINE_EVICTED_BYTES, 0)
+        if not was_closed:
+            if memo_entries:
+                self.journal.append("engine.memo-reset", entries=memo_entries)
+            self.journal.append(
+                "engine.close", stacks=stacks, releasedBytes=int(released)
+            )
+
+    def refresh_metrics(self):
+        """Pull-time gauge refresh (the Monarch pattern: per-node state
+        is read at scrape time, not streamed): HBM accounting the engine
+        already tracks internally plus the live compile-cache key count.
+        Called by the /metrics handler and by cache_snapshot()."""
+        with self._stacks_lock:
+            resident = self._resident_bytes
+            pending = self._pending_bytes()
+        REGISTRY.set_gauge(METRIC_ENGINE_RESIDENT_BYTES, resident)
+        REGISTRY.set_gauge(METRIC_ENGINE_EVICTED_BYTES, pending)
+        REGISTRY.set_gauge(METRIC_ENGINE_COMPILE_KEYS, _compile_cache_keys())
 
     def cache_snapshot(self) -> dict:
         """Cache/skip telemetry for /debug/vars: per-cache hit/miss
         tallies (the same counts the pilosa_engine_cache_* series
-        export), live cache sizes, and the sparsity counters."""
+        export), live cache sizes, the HBM accounting (gauges refreshed
+        as a side effect — /debug/vars and /metrics never disagree),
+        and the sparsity counters."""
+        self.refresh_metrics()
+        with self._stacks_lock:
+            resident = self._resident_bytes
+            pending = sum(n for _, n in self._pending_free)
         return {
             "caches": {
                 name: {"hits": hm[0], "misses": hm[1]}
                 for name, hm in self.cache_stats.items()
             },
-            "residentBytes": self._resident_bytes,
+            "residentBytes": resident,
+            "evictedLiveBytes": pending,
+            "evictions": int(self._evictions_counter.get()),
+            "stackRebuilds": self.stack_rebuilds,
+            "stackUpdates": self.stack_updates,
+            "compileCacheKeys": _compile_cache_keys(),
             "stacks": len(self._stacks),
             "masks": len(self._masks),
             "zeros": len(self._zeros),

@@ -22,6 +22,7 @@ from .core.holder import Holder
 from .core.translate import TranslateFile
 from .net import serve
 from .util import (
+    EventJournal,
     ExpvarStatsClient,
     NopLogger,
     NopStatsClient,
@@ -56,6 +57,10 @@ class Server:
         )
         self.cluster = None
         self.node_id = self._load_node_id()
+        # Per-node structured event journal (util/events.py): gossip,
+        # cluster, syncer, and engine all append to THIS node's ring —
+        # served at GET /debug/events and mirrored into the log.
+        self.journal = EventJournal(node=self.node_id, logger=self.logger)
         self.api: Optional[API] = None
         self._http = None
         self._http_thread = None
@@ -148,6 +153,10 @@ class Server:
             raise
 
     def _open_bound(self, host: str, port: int):
+        # The harness (and CLI flags) may override node_id after
+        # construction; re-stamp the journal's node label before any
+        # component starts appending.
+        self.journal.node = self.node_id
         # jax.distributed must come up before ANY device touch (holder
         # open may place fragments) — the analogue of setupNetworking
         # preceding holder.Open (server/server.go:302-331, server.go:334).
@@ -194,7 +203,11 @@ class Server:
             mesh_engine=mesh_engine,
             long_query_time=self.config.cluster_long_query_time,
             logger=self.logger,
+            journal=self.journal,
         )
+        # The readiness probe's gossip-convergence check reads the
+        # transport directly (None when gossip is not configured).
+        self.api.gossip = getattr(self, "gossip", None)
         if mesh_engine is not None and self.config.mesh_sequencer:
             mesh_engine.ticket = self._make_ticket_fn()
         self._http, self._http_thread = serve(
@@ -223,7 +236,9 @@ class Server:
             from .parallel import MeshEngine, make_mesh
 
             mesh = make_mesh(self.config.mesh_devices or None)
-            engine = MeshEngine(self.holder, mesh, logger=self.logger)
+            engine = MeshEngine(
+                self.holder, mesh, logger=self.logger, journal=self.journal
+            )
             if self.config.mesh_peers:
                 from concurrent.futures import ThreadPoolExecutor
 
@@ -341,6 +356,7 @@ class Server:
             path=self.data_dir,
             client_factory=self._make_client,
             logger=self.logger,
+            journal=self.journal,
         )
         if (
             not self.config.cluster_hosts
@@ -429,6 +445,7 @@ class Server:
             on_leave=on_leave,
             on_message=on_message,
             logger=self.logger,
+            journal=self.journal,
         ).start()
         cluster.gossip_send_async = self.gossip.send_async
         if self.config.gossip_seeds:
@@ -523,7 +540,9 @@ class Server:
         :430-483).  Callable after a late cluster attach (test harness)."""
         from .cluster.syncer import HolderSyncer
 
-        self.syncer = HolderSyncer(self.holder, self.cluster, self.logger)
+        self.syncer = HolderSyncer(
+            self.holder, self.cluster, self.logger, journal=self.journal
+        )
 
         def sync_and_clean():
             self.syncer.sync_holder()
@@ -581,10 +600,17 @@ class Server:
 
     def close(self):
         self._closing.set()
+        self.journal.append("server.shutdown", node=self.node_id)
         if getattr(self, "_membership_events", None) is not None:
             self._membership_events.put(None)
         if getattr(self, "gossip", None) is not None:
             self.gossip.close()
+        # Close ORDER is load-bearing for shutdown scrapes: the mesh
+        # engine closes only AFTER the HTTP socket stops accepting, and
+        # engine.close() itself flushes the resident-bytes gauges under
+        # its lock — so a /metrics scrape racing shutdown either reads
+        # pre-close truth or flushed zeros, never a stale value against
+        # a closed socket.
         if self._http is not None:
             if self._http_thread is not None:
                 # shutdown() waits on an event only serve_forever() sets
@@ -601,5 +627,17 @@ class Server:
                 self.api.mesh_engine.close()
             except Exception as e:  # noqa: BLE001 — teardown must not raise
                 self.logger.printf("mesh engine close failed: %s", e)
+            # The registry must render after engine teardown (a scrape
+            # that slipped in through the draining socket must not see a
+            # half-torn-down registry): render it once and fail LOUDLY
+            # in the log if it cannot.
+            try:
+                from .util.stats import REGISTRY
+
+                REGISTRY.prometheus_text()
+            except Exception as e:  # noqa: BLE001
+                self.logger.printf(
+                    "metrics registry unreadable after engine close: %s", e
+                )
         self.holder.close()
         self.translate_store.close()

@@ -1,4 +1,5 @@
-from . import stats, tracing
+from . import events, stats, tracing
+from .events import JOURNAL, Event, EventJournal
 from .logger import Logger, NopLogger, StandardLogger, VerboseLogger
 from .stats import (
     REGISTRY,
@@ -13,8 +14,11 @@ from .stats import (
 from .tracing import NopTracer, ProfilerTracer, Span, TraceContext, Tracer
 
 __all__ = [
+    "Event",
+    "EventJournal",
     "ExpvarStatsClient",
     "Histogram",
+    "JOURNAL",
     "Logger",
     "MetricsRegistry",
     "MultiStatsClient",
@@ -30,6 +34,7 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "VerboseLogger",
+    "events",
     "stats",
     "tracing",
 ]

@@ -300,6 +300,27 @@ METRIC_FRAGMENT_OP = "pilosa_fragment_op_seconds"
 METRIC_ENGINE_CACHE_HITS = "pilosa_engine_cache_hits_total"
 METRIC_ENGINE_CACHE_MISSES = "pilosa_engine_cache_misses_total"
 METRIC_DEVICE_BYTES_SKIPPED = "pilosa_device_bytes_skipped_total"
+# -- cluster & device observability (docs/observability.md) -----------------
+#   pilosa_engine_resident_bytes            gauge: HBM held by resident stacks
+#   pilosa_engine_evicted_bytes             gauge: evicted-but-still-live
+#                                           device buffers (weakref backlog)
+#   pilosa_engine_evictions_total           counter: stack evictions
+#   pilosa_engine_stack_rebuilds_total      counter: full stack (re)builds
+#   pilosa_engine_compile_total             counter: XLA backend compiles
+#   pilosa_engine_compile_seconds{phase=}   counter: cumulative trace/lower/
+#                                           compile seconds (recompile storms
+#                                           show as a slope)
+#   pilosa_engine_compile_cache_keys        gauge: distinct live compile keys
+#   pilosa_gossip_state_transitions_total{from,to}  gossip member flaps
+METRIC_ENGINE_RESIDENT_BYTES = "pilosa_engine_resident_bytes"
+METRIC_ENGINE_EVICTED_BYTES = "pilosa_engine_evicted_bytes"
+METRIC_ENGINE_EVICTIONS = "pilosa_engine_evictions_total"
+METRIC_ENGINE_REBUILDS = "pilosa_engine_stack_rebuilds_total"
+METRIC_ENGINE_COMPILE = "pilosa_engine_compile_total"
+METRIC_ENGINE_COMPILE_SECONDS = "pilosa_engine_compile_seconds"
+METRIC_ENGINE_COMPILE_KEYS = "pilosa_engine_compile_cache_keys"
+METRIC_GOSSIP_TRANSITIONS = "pilosa_gossip_state_transitions_total"
+COMPILE_PHASES = ("trace", "lower", "compile")
 
 PIPELINE_STAGES = ("queue_wait", "lower_dispatch", "device_readback", "decode")
 
@@ -333,7 +354,25 @@ REGISTRY.counter(
     METRIC_DEVICE_BYTES_SKIPPED,
     help="Device HBM bytes skipped by occupancy-guided sparse dispatches",
 )
-del _stage, _cache
+REGISTRY.set_gauge(METRIC_ENGINE_RESIDENT_BYTES, 0)
+REGISTRY.set_gauge(METRIC_ENGINE_EVICTED_BYTES, 0)
+REGISTRY.set_gauge(METRIC_ENGINE_COMPILE_KEYS, 0)
+REGISTRY.counter(
+    METRIC_ENGINE_EVICTIONS, help="Engine field-stack evictions"
+)
+REGISTRY.counter(
+    METRIC_ENGINE_REBUILDS, help="Engine full field-stack (re)builds"
+)
+REGISTRY.counter(
+    METRIC_ENGINE_COMPILE, help="XLA backend compiles observed in-process"
+)
+for _phase in COMPILE_PHASES:
+    REGISTRY.counter(
+        METRIC_ENGINE_COMPILE_SECONDS,
+        help="Cumulative JAX trace/lower/compile seconds",
+        phase=_phase,
+    )
+del _stage, _cache, _phase
 
 
 class StatsClient:

@@ -8,6 +8,9 @@ be a command's exit code, not a reviewer eyeballing two JSON blobs.
     python scripts/bench_guard.py BENCH_NEW.jsonl --baseline BENCH_OLD.jsonl
     python scripts/bench_guard.py BENCH_NEW.jsonl --tolerance 0.15 \
         --metric-tolerance http_count_qps=0.3 --require count_intersect_1B_cols_p50
+    curl -s localhost:10101/metrics > now.prom
+    python scripts/bench_guard.py now.prom --format prom --baseline old.prom \
+        --require pilosa_engine_compile_total
 
 Inputs accepted for both sides:
 - bench.py output: one JSON object per line, ``{"metric", "value",
@@ -15,7 +18,13 @@ Inputs accepted for both sides:
 - a bench-runner capture like BENCH_r05.json (the JSONL lives in its
   ``tail`` field);
 - a snapshot written by ``--write-baseline`` (``{"metrics": {...}}``) —
-  the shape BASELINE.json's ``published`` uses.
+  the shape BASELINE.json's ``published`` uses;
+- ``--format prom`` (or auto-sniffed): a scraped Prometheus ``/metrics``
+  exposition — counters/gauges become metrics keyed
+  ``name{labels}``, histogram ``_bucket`` series are skipped (their
+  ``_sum``/``_count`` pairs carry the comparable signal).  Prom samples
+  are dimensionless (direction unknown), so they diff informationally
+  and fail only via ``--require``.
 
 Direction is unit-aware: ``us``/``ms``/``s`` regress UP, ``qps``/
 ``GB/s`` regress DOWN.  Dimensionless telemetry (``queries/batch``,
@@ -51,9 +60,41 @@ def parse_jsonl(text: str) -> dict:
     return out
 
 
-def load_metrics(path: str) -> dict:
+def parse_prometheus(text: str) -> dict:
+    """{``name{labels}``: record} from a Prometheus text exposition.
+    Histogram ``_bucket`` series are skipped (hundreds of per-le lines
+    whose signal the ``_sum``/``_count`` pair already carries).  Prom
+    samples carry no unit, so records are dimensionless: the diff is
+    informational and only ``--require`` can fail the run."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_labels, sep, value = line.rpartition(" ")
+        if not sep:
+            continue
+        base = name_labels.split("{", 1)[0]
+        if base.endswith("_bucket"):
+            continue
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        out[name_labels] = {"metric": name_labels, "value": v, "unit": ""}
+    return out
+
+
+def _sniff_prom(text: str) -> bool:
+    head = text.lstrip()[:256]
+    return head.startswith("# HELP") or head.startswith("# TYPE")
+
+
+def load_metrics(path: str, fmt: str = "auto") -> dict:
     with open(path) as f:
         text = f.read()
+    if fmt == "prom" or (fmt == "auto" and _sniff_prom(text)):
+        return parse_prometheus(text)
     try:
         doc = json.loads(text)
     except json.JSONDecodeError:
@@ -136,6 +177,11 @@ def main(argv=None) -> int:
         "--write-baseline", metavar="PATH",
         help="also snapshot the new run's metrics to PATH",
     )
+    ap.add_argument(
+        "--format", choices=("auto", "jsonl", "prom"), default="auto",
+        help="input format for BOTH files: bench JSONL, a Prometheus "
+        "/metrics snapshot, or auto-sniffed per file (default)",
+    )
     ap.add_argument("--quiet", action="store_true", help="failures only")
     args = ap.parse_args(argv)
 
@@ -151,8 +197,8 @@ def main(argv=None) -> int:
                 f"--metric-tolerance expects NAME=FLOAT, got {spec!r}"
             )
 
-    current = load_metrics(args.current)
-    baseline = load_metrics(args.baseline)
+    current = load_metrics(args.current, args.format)
+    baseline = load_metrics(args.baseline, args.format)
     failures, notes, checked = check(
         current, baseline, args.tolerance, per_metric, tuple(args.require)
     )

@@ -55,6 +55,14 @@ required = [
     "pilosa_engine_cache_hits_total",
     "pilosa_engine_cache_misses_total",
     "pilosa_device_bytes_skipped_total",
+    # Cluster & device observability (docs/observability.md).
+    "pilosa_engine_resident_bytes",
+    "pilosa_engine_evicted_bytes",
+    "pilosa_engine_evictions_total",
+    "pilosa_engine_stack_rebuilds_total",
+    "pilosa_engine_compile_total",
+    "pilosa_engine_compile_seconds",
+    "pilosa_engine_compile_cache_keys",
 ]
 missing = [s for s in required if s not in text]
 assert not missing, f"/metrics is missing required series: {missing}"
@@ -110,6 +118,103 @@ while True:
     )
     time.sleep(0.05)
 
+# Health / readiness / federation smoke: liveness answers immediately,
+# readiness must turn true (bounded poll — a readyz that never flips is
+# a FAILURE, not a hang), and the federated /cluster/metrics must carry
+# the node label on its samples.
+health = json.loads(
+    urllib.request.urlopen(f"http://localhost:{port}/healthz", timeout=30).read()
+)
+assert health["status"] == "ok", health
+
+deadline = time.monotonic() + 30
+while True:
+    try:
+        rdy = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{port}/readyz", timeout=30
+            ).read()
+        )
+        if rdy.get("ready"):
+            break
+    except urllib.error.HTTPError as e:
+        rdy = json.loads(e.read())
+    assert time.monotonic() < deadline, (
+        f"readiness never turned true: {rdy.get('reasons')}"
+    )
+    time.sleep(0.2)
+
+node_id = api.node()["id"]
+fed = urllib.request.urlopen(
+    f"http://localhost:{port}/cluster/metrics", timeout=30
+).read().decode()
+assert f'node="{node_id}"' in fed, (
+    "federated output lacks the node label:\n" + "\n".join(fed.splitlines()[:8])
+)
+assert "pilosa_node_scrape_error" in fed, "federation lacks the scrape-error series"
+assert f'pilosa_node_scrape_error{{node="{node_id}"}} 0' in fed, (
+    "local node reported as scrape-degraded"
+)
+
+events = json.loads(
+    urllib.request.urlopen(
+        f"http://localhost:{port}/debug/events?limit=16", timeout=30
+    ).read()
+)
+assert "events" in events and "dropped" in events, events
+
+# Event-journal smoke: drive one event of each operator-facing family —
+# a gossip state transition, an anti-entropy pass, and an engine HBM
+# eviction — and assert each shows up at /debug/events.
+from pilosa_tpu.cluster import Cluster, Node
+from pilosa_tpu.cluster.gossip import ALIVE, SUSPECT, GossipNode
+from pilosa_tpu.cluster.syncer import HolderSyncer
+
+journal = api.journal
+
+gn = GossipNode("smoke-g", journal=journal)  # not started: no sockets race
+gn._apply_update({"id": "peer", "addr": ["127.0.0.1", 1], "state": ALIVE, "inc": 0})
+gn._mark("peer", SUSPECT)
+gn.close()
+
+cluster = Cluster(
+    node=Node("smoke-node", f"http://localhost:{port}"), journal=journal
+)
+cluster.holder = holder
+HolderSyncer(holder, cluster, journal=journal).sync_holder()
+
+g = idx.create_field("g")
+g.import_bulk([2, 2], [1, 5])
+eng.max_resident_bytes = 1  # force the next stack admission to evict
+req = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/query",
+    data=b"Count(Intersect(Row(g=2), Row(g=2)))", method="POST",
+)
+assert json.loads(urllib.request.urlopen(req, timeout=60).read())["results"][0] == 2
+
+def event_types(family):
+    doc = json.loads(urllib.request.urlopen(
+        f"http://localhost:{port}/debug/events?type={family}", timeout=30
+    ).read())
+    return [e["type"] for e in doc["events"]]
+
+deadline = time.monotonic() + 10
+while True:
+    missing = [
+        fam for fam, want in (
+            ("gossip", "gossip.transition"),
+            ("antientropy", "antientropy.end"),
+            ("engine", "engine.evict"),
+        )
+        if want not in event_types(fam)
+    ]
+    if not missing:
+        break
+    assert time.monotonic() < deadline, (
+        f"/debug/events is missing event families: {missing}"
+    )
+    time.sleep(0.1)
+
 srv.shutdown()
-print("observability smoke OK: /metrics + /debug/traces wired")
+print("observability smoke OK: /metrics + /debug/traces + health/readiness + federation wired")
 EOF

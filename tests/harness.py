@@ -70,6 +70,7 @@ def run_cluster(tmp_path, n: int, replica_n: int = 1, tls=None) -> ClusterHarnes
             path=srv.data_dir,
             client_factory=srv._make_client,
             logger=srv.logger,
+            journal=srv.journal,
         )
         cluster.nodes = sorted(
             [nodes[j] for j in range(n)], key=lambda nd: nd.id

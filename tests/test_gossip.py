@@ -1,11 +1,15 @@
 """SWIM gossip membership tests (gossip/gossip.go behavior: join
-propagation, failure detection, refutation)."""
+propagation, failure detection, refutation) plus the observability
+surface: state transitions journaled + counted, DEAD-member reap
+journaled."""
 
 import time
 
 import pytest
 
-from pilosa_tpu.cluster.gossip import ALIVE, DEAD, GossipNode
+from pilosa_tpu.cluster.gossip import ALIVE, DEAD, SUSPECT, GossipNode
+from pilosa_tpu.util.events import EventJournal
+from pilosa_tpu.util.stats import METRIC_GOSSIP_TRANSITIONS, REGISTRY
 
 
 def wait_until(fn, timeout=5.0):
@@ -113,6 +117,100 @@ def test_send_async_broadcast(nodes):
     # Exactly once despite retransmits.
     time.sleep(0.5)
     assert len(received[1]) == 1 and len(received[2]) == 1
+
+
+def test_mark_transitions_journal_and_counter(nodes):
+    """_mark no longer mutates member state silently: every transition
+    lands in the node's journal (with from/to/via) and advances the
+    pilosa_gossip_state_transitions_total{from,to} counter."""
+    j = EventJournal(node="g0")
+    g0, g1 = nodes(2)
+    g0.journal = j
+    c_suspect = REGISTRY.counter(
+        METRIC_GOSSIP_TRANSITIONS, **{"from": ALIVE, "to": SUSPECT}
+    )
+    c_dead = REGISTRY.counter(
+        METRIC_GOSSIP_TRANSITIONS, **{"from": SUSPECT, "to": DEAD}
+    )
+    before_suspect, before_dead = c_suspect.get(), c_dead.get()
+    g1.join(g0.addr)
+    assert wait_until(lambda: len(g0.alive_members()) == 2)
+    g1.close()  # hard kill: g0's probes fail -> SUSPECT -> DEAD
+    assert wait_until(
+        lambda: g0.members["g1"].state == DEAD, timeout=10
+    ), g0.members["g1"].state
+    transitions = [
+        (e.fields["from"], e.fields["to"])
+        for e in j.events(type="gossip.transition")
+        if e.fields.get("member") == "g1"
+    ]
+    assert (ALIVE, SUSPECT) in transitions, transitions
+    assert (SUSPECT, DEAD) in transitions, transitions
+    # Counter series advanced alongside the journal.
+    assert c_suspect.get() > before_suspect
+    assert c_dead.get() > before_dead
+    # Transition events carry the observing mechanism.
+    vias = {
+        e.fields["via"] for e in j.events(type="gossip.transition")
+        if e.fields.get("member") == "g1"
+    }
+    assert vias <= {"probe", "update"}, vias
+
+
+def test_suspect_dead_sequence_lands_in_both_survivors_journals(nodes):
+    """A member death is journaled on EVERY node that learns of it —
+    whether through its own failure detector (via=probe) or a peer's
+    piggybacked update (via=update) — so an operator can reconstruct
+    the flap from any surviving node's /debug/events."""
+    journals = {}
+    g = nodes(3)
+    for node in g:
+        journals[node.node_id] = node.journal = EventJournal(node=node.node_id)
+    g[1].join(g[0].addr)
+    g[2].join(g[0].addr)
+    assert wait_until(lambda: all(len(x.alive_members()) == 3 for x in g))
+    g[2].close()  # hard kill
+
+    def dead_on(node):
+        m = node.members.get("g2")
+        return m is not None and m.state == DEAD
+
+    assert wait_until(lambda: dead_on(g[0]) and dead_on(g[1]), timeout=15)
+
+    def death_journaled(journal):
+        return any(
+            e.fields.get("member") == "g2" and e.fields.get("to") == DEAD
+            for e in journal.events(type="gossip.transition")
+        )
+
+    assert wait_until(
+        lambda: death_journaled(journals["g0"])
+        and death_journaled(journals["g1"]),
+        timeout=10,
+    ), {
+        nid: [(e.type, e.fields) for e in j.events(type="gossip")]
+        for nid, j in journals.items()
+    }
+
+
+def test_dead_member_reap_is_journaled(nodes):
+    """The reap loop removes long-DEAD members from the table and
+    journals the removal (gossip.reap) instead of dropping it
+    unlogged."""
+    j = EventJournal(node="g0")
+    (g0,) = nodes(1, dead_reap_seconds=0.4)
+    g0.journal = j
+    g0._apply_update(
+        {"id": "ghost", "addr": ["127.0.0.1", 1], "state": ALIVE, "inc": 0}
+    )
+    g0._mark("ghost", SUSPECT)
+    g0._mark("ghost", DEAD)
+    assert "ghost" in g0.members
+    assert wait_until(lambda: "ghost" not in g0.members, timeout=10)
+    reaps = j.events(type="gossip.reap")
+    assert reaps and reaps[-1].fields["member"] == "ghost", [
+        (e.type, e.fields) for e in j.events()
+    ]
 
 
 def test_five_node_convergence_with_drops_and_large_state(nodes):
