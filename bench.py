@@ -1211,6 +1211,195 @@ def density_sweep():
     )
 
 
+# ---- ingest: sustained bulk-import throughput + freshness (--ingest-sweep)
+
+ING_BITS_PER_ROW = 16  # rows scale with batch size (n_bits/16 distinct
+#                        rows): the high-cardinality (term/tag store)
+#                        ingest shape, where per-row host overhead
+#                        dominates the pre-PR path
+ING_CHUNKS = 4  # sustained chunks per shape (fresh random bits each)
+ING_FRESH_REPS = 12
+
+
+def _ing_batch(rng, n_bits, n_rows):
+    """~n_bits unique storage positions spread over n_rows rows."""
+    rows = rng.integers(0, n_rows, int(n_bits * 1.1)).astype(np.uint64)
+    cols = rng.integers(0, 1 << 20, int(n_bits * 1.1)).astype(np.uint64)
+    return np.unique((rows << np.uint64(20)) | cols)[:n_bits]
+
+
+def _field_import_rowloop(field, row_ids, column_ids):
+    """The pre-PR field.import_bulk, byte-for-byte: one python loop
+    iteration per BIT to group by (view, shard), then the per-row
+    fragment walk (bulk_import_rowloop) — the bench's same-machine
+    baseline for the id-pairs ingest surface."""
+    from pilosa_tpu.core.view import VIEW_STANDARD
+
+    SW = 1 << 20
+    groups = {}
+    for r, c in zip(row_ids, column_ids):
+        rows, cols = groups.setdefault(VIEW_STANDARD, {}).setdefault(
+            c // SW, ([], [])
+        )
+        rows.append(r)
+        cols.append(c)
+    changed = 0
+    for view_name, shards in groups.items():
+        view = field.view_if_not_exists(view_name)
+        for shard, (rows, cols) in shards.items():
+            frag = view.fragment_if_not_exists(shard)
+            changed += frag.bulk_import_rowloop(rows, cols)
+    return changed
+
+
+def ingest_sweep():
+    """Sustained bulk-import throughput, new vectorized paths vs the
+    retained pre-PR per-row implementations on the SAME machine and
+    data (fragment.bulk_import_rowloop / import_roaring_rowloop), at
+    several batch sizes — plus the vectorized-decode micro, a pipelined
+    write->query freshness p50 through a live engine, and the ingest
+    sync worker's coalescing telemetry.  Headline JSONL metric:
+    ``ingest_mbits_s`` (1M-bit roaring batch, sustained); the
+    acceptance gate is its ratio over ``ingest_rowloop_mbits_s``."""
+    progress("importing jax (ingest sweep)")
+    import jax
+
+    from pilosa_tpu import pql
+    from pilosa_tpu.api import API, ImportRequest
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+    from pilosa_tpu.roaring import codec
+
+    rng = np.random.default_rng(13)
+
+    # ---- roaring fast path vs pre-PR per-row path (headline) -------------
+    for n_bits, label in ((1 << 16, "64k"), (1 << 18, "256k"), (1 << 20, "1m")):
+        fa = Fragment("ing", "f", "standard", 0)
+        fb = Fragment("ing", "f", "standard", 0)
+        tn = to = bits = 0
+        for _ in range(ING_CHUNKS):
+            vals = _ing_batch(rng, n_bits, n_bits // ING_BITS_PER_ROW)
+            data = codec.serialize(vals)
+            bits += vals.size
+            t0 = time.perf_counter()
+            ca = fa.import_roaring(data)
+            tn += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            cb = fb.import_roaring_rowloop(data)
+            to += time.perf_counter() - t0
+            assert ca == cb, (label, ca, cb)
+        assert fa.row_ids() == fb.row_ids()
+        for r in fa.row_ids()[::97]:
+            assert np.array_equal(fa.row_positions(r), fb.row_positions(r))
+        mb_new, mb_old = bits / tn / 1e6, bits / to / 1e6
+        emit_raw(
+            f"ingest_roaring_{label}_mbits_s", mb_new, "Mbits/s",
+            mb_new / mb_old,
+        )
+        progress(
+            f"roaring {label}: {mb_new:.1f} vs rowloop {mb_old:.2f} Mbits/s "
+            f"({mb_new / mb_old:.1f}x)"
+        )
+        if label == "1m":
+            emit_raw("ingest_mbits_s", mb_new, "Mbits/s", mb_new / mb_old)
+            emit_raw("ingest_rowloop_mbits_s", mb_old, "Mbits/s", 1.0)
+            emit_raw(
+                "ingest_speedup", mb_new / mb_old, "x", mb_new / mb_old
+            )
+
+    # ---- decode micro: vectorized container decode vs scalar oracle ------
+    vals = _ing_batch(rng, 1 << 20, (1 << 20) // ING_BITS_PER_ROW)
+    data = codec.serialize(vals)
+    t_np = min(
+        cpu_time(lambda: codec._deserialize_np(data), reps=1)
+        for _ in range(3)
+    )
+    t_py = cpu_time(lambda: codec._deserialize_py(data), reps=1)
+    emit_raw(
+        "ingest_decode_mbits_s", vals.size / t_np / 1e6, "Mbits/s",
+        t_py / t_np,
+    )
+    progress(f"decode: np {t_np * 1e3:.0f}ms vs py {t_py * 1e3:.0f}ms")
+
+    # ---- id-pairs surface: field.import_bulk (vectorized shard split +
+    # concurrent fragments) vs the pre-PR put()-loop + row walk ------------
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("ing")
+    N_SHARDS_ING = 8
+    fa, fb = idx.create_field("fa"), idx.create_field("fb")
+    tn = to = bits = 0
+    for _ in range(ING_CHUNKS):
+        rows = rng.integers(0, 2048, 1 << 20)
+        cols = rng.integers(0, N_SHARDS_ING << 20, 1 << 20)
+        rows_l, cols_l = rows.tolist(), cols.tolist()
+        bits += len(rows_l)
+        t0 = time.perf_counter()
+        ca = fa.import_bulk(rows_l, cols_l)
+        tn += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        cb = _field_import_rowloop(fb, rows_l, cols_l)
+        to += time.perf_counter() - t0
+        assert ca == cb
+    mb_new, mb_old = bits / tn / 1e6, bits / to / 1e6
+    emit_raw("ingest_bits_mbits_s", mb_new, "Mbits/s", mb_new / mb_old)
+    emit_raw("ingest_bits_rowloop_mbits_s", mb_old, "Mbits/s", 1.0)
+    progress(
+        f"id-pairs: {mb_new:.1f} vs rowloop {mb_old:.2f} Mbits/s "
+        f"({mb_new / mb_old:.1f}x)"
+    )
+
+    # ---- pipelined write -> query freshness through a live engine --------
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh)
+    api = API(holder=holder, mesh_engine=eng)
+    fq = idx.create_field("q")
+    FRESH_ROWS, FRESH_SHARDS = 64, 4
+    shards = list(range(FRESH_SHARDS))
+    # Seed every row up front so the resident stack's row table is
+    # stable and each write syncs as an incremental scatter.
+    seed_rows, seed_cols = [], []
+    for s in range(FRESH_SHARDS):
+        for r in range(FRESH_ROWS):
+            seed_rows.append(r)
+            seed_cols.append((s << 20) + r)
+    fq.import_bulk(seed_rows, seed_cols)
+    call = pql.parse("Intersect(Row(q=1), Row(q=2))").calls[0]
+    base = eng.count("ing", call, shards)  # warm: builds the stack
+    syncer = eng.ingest_syncer()
+    rebuilds0 = eng.stack_rebuilds
+    lat = []
+    nonce = iter(range(1, 1 << 30))
+    for i in range(ING_FRESH_REPS):
+        n = next(nonce)
+        wcols = [
+            (s << 20) + (7919 * n + 131 * s) % (1 << 20)
+            for s in range(FRESH_SHARDS)
+        ]
+        t0 = time.perf_counter()
+        api.import_bits(
+            ImportRequest(
+                "ing", "q",
+                row_ids=[1 + (n % 2)] * FRESH_SHARDS, column_ids=wcols,
+            )
+        )
+        got = eng.count("ing", call, shards)
+        lat.append(time.perf_counter() - t0)
+        assert got >= 0
+    syncer.flush()
+    assert eng.stack_rebuilds == rebuilds0, "ingest sync forced a rebuild"
+    fresh_p50 = statistics.median(lat)
+    emit_raw("ingest_freshness_p50_ms", fresh_p50 * 1e3, "ms", 1.0)
+    snap = syncer.snapshot()
+    emit_raw("ingest_sync_chunks", snap["chunks"], "chunks", 1.0)
+    emit_raw("ingest_sync_coalesced", snap["coalesced"], "chunks", 1.0)
+    progress(
+        f"freshness p50 {fresh_p50 * 1e3:.1f}ms; sync {snap['syncs']} passes "
+        f"over {snap['chunks']} chunks ({snap['coalesced']} coalesced)"
+    )
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -1230,6 +1419,14 @@ if __name__ == "__main__":
         "format — docs/sparsity.md)",
     )
     ap.add_argument(
+        "--ingest-sweep",
+        action="store_true",
+        help="run the ingest throughput sweep ONLY (sustained bulk-import "
+        "Mbits/s at several batch sizes vs the retained pre-PR per-row "
+        "path, vectorized-decode micro, write->query freshness p50; "
+        "headline JSONL metric ingest_mbits_s — docs/ingest.md)",
+    )
+    ap.add_argument(
         "--scrape",
         action="store_true",
         help="append the post-run /metrics device gauges (resident "
@@ -1238,7 +1435,9 @@ if __name__ == "__main__":
         "JSONL)",
     )
     args = ap.parse_args()
-    if args.density_sweep:
+    if args.ingest_sweep:
+        ingest_sweep()
+    elif args.density_sweep:
         density_sweep()
     else:
         main(depth_sweep=args.depth_sweep, scrape=args.scrape)

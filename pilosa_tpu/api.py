@@ -15,9 +15,19 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from . import __version__, pql
-from .util import tracing
-from .util.stats import METRIC_QUERY, REGISTRY
+from .util import fanout, tracing
+from .util.stats import (
+    INGEST_PATHS,
+    METRIC_INGEST_BATCHES,
+    METRIC_INGEST_BITS,
+    METRIC_INGEST_CHANGED,
+    METRIC_INGEST_SECONDS,
+    METRIC_QUERY,
+    REGISTRY,
+)
 from .core import timequantum
 from .core.field import FieldOptions
 from .core.fragment import SHARD_WIDTH
@@ -152,6 +162,17 @@ class API:
         self._h_query_pipelined = REGISTRY.histogram(
             METRIC_QUERY, path="pipelined"
         )
+        # Ingest surface handles (docs/ingest.md), resolved once: the
+        # import hot paths pay per-series locks only.
+        self._ingest_series = {
+            path: (
+                REGISTRY.counter(METRIC_INGEST_BATCHES, path=path),
+                REGISTRY.counter(METRIC_INGEST_BITS, path=path),
+                REGISTRY.histogram(METRIC_INGEST_SECONDS, path=path),
+            )
+            for path in INGEST_PATHS
+        }
+        self._ingest_changed = REGISTRY.counter(METRIC_INGEST_CHANGED)
         self.holder = holder if holder is not None else Holder()
         if not self.holder.opened:
             self.holder.open()
@@ -418,6 +439,27 @@ class API:
         if self.cluster is not None and self.cluster.state == "RESIZING":
             raise ApiError("cluster is resizing: writes are rejected")
 
+    def _ingest_done(self, path: str, index_name: str, bits: int, t0: float,
+                     changed: Optional[int] = None, remote: bool = False):
+        """Record one applied ingest batch (pilosa_ingest_* series) and
+        notify the engine's device-sync worker so resident stacks
+        scatter-update behind this write instead of on the next query's
+        critical path (docs/ingest.md).  ``remote`` replays (a
+        coordinator already counted the user-facing batch) skip the
+        series — otherwise a cluster import double-counts, once at the
+        coordinator and again at each forwarded owner — but still
+        notify the local sync worker."""
+        if not remote:
+            batches, bits_c, hist = self._ingest_series[path]
+            batches.inc()
+            bits_c.inc(bits)
+            hist.observe(time.monotonic() - t0)
+            if changed:
+                self._ingest_changed.inc(changed)
+        eng = self.mesh_engine
+        if eng is not None:
+            eng.ingest_syncer().notify(index_name)
+
     def import_bits(
         self, req: ImportRequest, remote: bool = False, clear: bool = False
     ):
@@ -459,32 +501,58 @@ class API:
                     "import with timestamps"
                 )
 
+        t0 = time.monotonic()
         if self.cluster is None or remote:
             self._import_local(idx, f, row_ids, col_ids, timestamps, clear)
+            self._ingest_done("bits", req.index, len(col_ids), t0,
+                              remote=remote)
             return
 
-        # Group by shard, forward to owners (api.go:835-860).
+        # Group by shard, forward to owners (api.go:835-860).  Locally
+        # owned groups merge into ONE local apply (field.import_bulk
+        # re-splits by shard and fans fragments out concurrently); the
+        # remote per-(shard, node) RPCs run through the bounded import
+        # fan-out instead of serially awaiting each round trip.
         groups: Dict[int, list] = {}
         for i, c in enumerate(col_ids):
             groups.setdefault(c // SHARD_WIDTH, []).append(i)
+        local_idxs: list = []
+        remote_jobs = []
         for shard, idxs in sorted(groups.items()):
             s_rows = [row_ids[i] for i in idxs]
             s_cols = [col_ids[i] for i in idxs]
             s_ts = [timestamps[i] for i in idxs] if timestamps else []
             for node in self.cluster.shard_nodes(req.index, shard):
                 if node.id == self.cluster.node.id:
-                    self._import_local(idx, f, s_rows, s_cols, s_ts, clear)
+                    local_idxs.extend(idxs)
                 else:
-                    self.cluster.client(node).import_bits(
-                        req.index,
-                        req.field,
-                        shard,
-                        s_rows,
-                        s_cols,
-                        timestamps=s_ts or None,
-                        remote=True,
-                        clear=clear,
+                    remote_jobs.append(
+                        lambda n=node, s=shard, r=s_rows, c=s_cols, t=s_ts: (
+                            self.cluster.client(n).import_bits(
+                                req.index,
+                                req.field,
+                                s,
+                                r,
+                                c,
+                                timestamps=t or None,
+                                remote=True,
+                                clear=clear,
+                            )
+                        )
                     )
+        if local_idxs:
+            remote_jobs.append(
+                lambda: self._import_local(
+                    idx,
+                    f,
+                    [row_ids[i] for i in local_idxs],
+                    [col_ids[i] for i in local_idxs],
+                    [timestamps[i] for i in local_idxs] if timestamps else [],
+                    clear,
+                )
+            )
+        fanout.run_fanout(remote_jobs)
+        self._ingest_done("bits", req.index, len(col_ids), t0)
 
     def _import_local(self, idx, f, row_ids, col_ids, timestamps, clear=False):
         ts = None
@@ -525,27 +593,45 @@ class API:
 
         def apply_local(cols, values):
             ef = idx.existence_field()
-            if not clear and ef is not None and cols:
+            if not clear and ef is not None and len(cols):
                 ef.import_bulk([0] * len(cols), cols)
             f.import_values(cols, values, clear=clear)
 
+        t0 = time.monotonic()
         if self.cluster is None or remote:
             apply_local(col_ids, req.values)
+            self._ingest_done("values", req.index, len(col_ids), t0,
+                              remote=remote)
             return
         groups: Dict[int, list] = {}
         for i, c in enumerate(col_ids):
             groups.setdefault(c // SHARD_WIDTH, []).append(i)
+        local_idxs: list = []
+        remote_jobs = []
         for shard, idxs in sorted(groups.items()):
             cols = [col_ids[i] for i in idxs]
             values = [req.values[i] for i in idxs]
             for node in self.cluster.shard_nodes(req.index, shard):
                 if node.id == self.cluster.node.id:
-                    apply_local(cols, values)
+                    local_idxs.extend(idxs)
                 else:
-                    self.cluster.client(node).import_values(
-                        req.index, req.field, shard, cols, values,
-                        remote=True, clear=clear,
+                    remote_jobs.append(
+                        lambda n=node, s=shard, c=cols, v=values: (
+                            self.cluster.client(n).import_values(
+                                req.index, req.field, s, c, v,
+                                remote=True, clear=clear,
+                            )
+                        )
                     )
+        if local_idxs:
+            remote_jobs.append(
+                lambda: apply_local(
+                    [col_ids[i] for i in local_idxs],
+                    [req.values[i] for i in local_idxs],
+                )
+            )
+        fanout.run_fanout(remote_jobs)
+        self._ingest_done("values", req.index, len(col_ids), t0)
 
     def import_roaring(
         self,
@@ -557,22 +643,28 @@ class API:
         clear: bool = False,
     ) -> int:
         """Union (or clear) a serialized roaring bitmap into a fragment —
-        the fast ingest path (api.go:290-349, ImportRoaringRequest.Clear)."""
+        the fast ingest path (api.go:290-349, ImportRoaringRequest.Clear).
+        The container payload is decoded ONCE (vectorized codec) and the
+        positions shared with both the fragment merge and the existence
+        field, where this previously paid two full decodes."""
         self._check_writable()
+        t0 = time.monotonic()
         idx = self.index(index_name)
         f = self.field(index_name, field_name)
         v = f.view_if_not_exists(view)
         frag = v.fragment_if_not_exists(shard)
-        n = frag.import_roaring(data, clear=clear)
-        ef = idx.existence_field()
-        if ef is not None and not clear:
-            from .roaring import codec
+        from .roaring import codec
 
-            positions = codec.deserialize(data).values
-            if positions.size:
-                base = shard * SHARD_WIDTH
-                cols = (positions % SHARD_WIDTH) + base
-                ef.import_bulk([0] * len(cols), cols.tolist())
+        positions = codec.deserialize(data).values
+        n = frag.import_roaring(data, clear=clear, values=positions)
+        ef = idx.existence_field()
+        if ef is not None and not clear and positions.size:
+            base = shard * SHARD_WIDTH
+            cols = (positions % SHARD_WIDTH).astype(np.int64) + base
+            ef.import_bulk(np.zeros(len(cols), dtype=np.int64), cols)
+        self._ingest_done(
+            "roaring", index_name, int(positions.size), t0, changed=n
+        )
         return n
 
     # -- export (api.go ExportCSV :416) ------------------------------------

@@ -14,6 +14,8 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Tuple
 
+import numpy as np
+
 THRESHOLD_FACTOR = 1.1
 
 DEFAULT_CACHE_SIZE = 50000
@@ -56,6 +58,23 @@ class RankCache:
         if n < self.threshold_value:
             return
         self.entries[row_id] = n
+
+    def bulk_update(self, row_ids, counts):
+        """Vectorized bulk_add: one C-speed dict.update for a whole
+        import batch (admission threshold applied as a numpy mask).
+        Caller invalidates once afterwards, same as bulk_add."""
+        if self.threshold_value > 0:
+            keep = np.asarray(counts) >= self.threshold_value
+            row_ids, counts = (
+                np.asarray(row_ids)[keep],
+                np.asarray(counts)[keep],
+            )
+        self.entries.update(
+            zip(
+                np.asarray(row_ids).tolist(),
+                np.asarray(counts).tolist(),
+            )
+        )
 
     def get(self, row_id: int) -> int:
         return self.entries.get(row_id, 0)
@@ -106,6 +125,10 @@ class LRUCache:
 
     bulk_add = add
 
+    def bulk_update(self, row_ids, counts):
+        for r, n in zip(row_ids.tolist(), counts.tolist()):
+            self.add(r, n)
+
     def get(self, row_id: int) -> int:
         n = self._od.get(row_id, 0)
         if row_id in self._od:
@@ -138,6 +161,9 @@ class NopCache:
         pass
 
     bulk_add = add
+
+    def bulk_update(self, row_ids, counts):
+        pass
 
     def get(self, row_id: int) -> int:
         return 0

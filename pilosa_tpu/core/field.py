@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..roaring import Bitmap
+from ..util import fanout
 from . import cache as cache_mod
 from . import timequantum
 from .fragment import SHARD_WIDTH, FALSE_ROW_ID, TRUE_ROW_ID  # noqa: F401
@@ -449,6 +450,13 @@ class Field:
                     f"field {self.name!r} has no time quantum: cannot "
                     "import with timestamps"
                 )
+        else:
+            # Hot path (no time fan-out): vectorized shard grouping —
+            # one stable argsort over the shard keys replaces the
+            # one-python-iteration-per-BIT put() loop, and the
+            # per-fragment applies run concurrently (util.fanout; each
+            # fragment has its own lock).
+            return self._import_bulk_fast(row_ids, column_ids, clear)
         groups: Dict[str, Dict[int, Tuple[list, list]]] = {}
 
         def put(view_name, shard, r, c):
@@ -475,21 +483,86 @@ class Field:
                 changed += frag.bulk_import(rows, cols, clear=clear)
         return changed
 
+    @staticmethod
+    def _shard_groups(view, cols: np.ndarray, *parallel: np.ndarray):
+        """Group column-parallel arrays by shard: yields
+        ``(fragment, cols_slice, *parallel_slices)`` per shard.  ONE
+        stable argsort over the shard keys (order within a shard is
+        preserved — last-write-wins paths depend on it); fragments are
+        created serially here because the view/fragment registries are
+        not concurrent-creation safe, then the caller fans the per-
+        fragment applies out."""
+        shards = cols // SHARD_WIDTH
+        uniq = np.unique(shards)
+        if uniq.size == 1:
+            frag = view.fragment_if_not_exists(int(uniq[0]))
+            return [(frag, cols) + parallel]
+        order = np.argsort(shards, kind="stable")
+        cols = cols[order]
+        parallel = tuple(a[order] for a in parallel)
+        starts = np.searchsorted(shards[order], uniq)
+        bounds = np.append(starts, cols.size)
+        out = []
+        for k, s in enumerate(uniq.tolist()):
+            frag = view.fragment_if_not_exists(int(s))
+            lo, hi = bounds[k], bounds[k + 1]
+            out.append(
+                (frag, cols[lo:hi]) + tuple(a[lo:hi] for a in parallel)
+            )
+        return out
+
+    def _import_bulk_fast(self, row_ids, column_ids, clear: bool) -> int:
+        rows = np.asarray(row_ids, dtype=np.int64)
+        cols = np.asarray(column_ids, dtype=np.int64)
+        if rows.size == 0:
+            return 0
+        view = self.view_if_not_exists(VIEW_STANDARD)
+        groups = self._shard_groups(view, cols, rows)
+        if len(groups) == 1:
+            frag, c, r = groups[0]
+            return frag.bulk_import(r, c, clear=clear)
+        return sum(
+            fanout.run_fanout(
+                [
+                    lambda f=frag, r=r, c=c: f.bulk_import(r, c, clear=clear)
+                    for frag, c, r in groups
+                ]
+            )
+        )
+
     def import_values(self, column_ids, values, clear: bool = False) -> None:
+        """Vectorized shard grouping + concurrent per-fragment applies,
+        same shape as import_bulk's fast path (range check first — a
+        late ValueError must not land after part of the batch applied)."""
         g = self.bsi_group(self.name)
         if g is None:
             raise ValueError(f"field {self.name} has no int range")
+        cols = np.asarray(column_ids, dtype=np.int64)
+        vals = np.asarray(values, dtype=np.int64)
+        if cols.size == 0:
+            return
+        bad = (vals < g.min) | (vals > g.max)
+        if bad.any():
+            raise ValueError(
+                f"value {int(vals[np.argmax(bad)])} out of range for "
+                f"field {self.name}"
+            )
+        vals = vals - g.min
         view = self.view_if_not_exists(view_bsi_name(self.name))
-        by_shard: Dict[int, Tuple[list, list]] = {}
-        for c, v in zip(column_ids, values):
-            if v < g.min or v > g.max:
-                raise ValueError(f"value {v} out of range for field {self.name}")
-            cols, vals = by_shard.setdefault(c // SHARD_WIDTH, ([], []))
-            cols.append(c)
-            vals.append(v - g.min)
-        for shard, (cols, vals) in by_shard.items():
-            frag = view.fragment_if_not_exists(shard)
-            frag.import_values(cols, vals, g.bit_depth(), clear=clear)
+        depth = g.bit_depth()
+        groups = self._shard_groups(view, cols, vals)
+        if len(groups) == 1:
+            frag, c, v = groups[0]
+            frag.import_values(c, v, depth, clear=clear)
+            return
+        fanout.run_fanout(
+            [
+                lambda f=frag, c=c, v=v: f.import_values(
+                    c, v, depth, clear=clear
+                )
+                for frag, c, v in groups
+            ]
+        )
 
     def __repr__(self) -> str:
         return f"Field({self.index}/{self.name}, type={self.options.type})"

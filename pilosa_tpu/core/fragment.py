@@ -97,6 +97,16 @@ class _WriteSeq:
 WRITE_SEQ = _WriteSeq()
 
 
+def _sorted_unique_u64(values: np.ndarray) -> np.ndarray:
+    """uint64 view of ``values``, sorted-unique.  The common producer
+    (the roaring codec) already emits sorted-unique vectors, so this is
+    an O(n) verification there and a single np.unique sort otherwise."""
+    v = np.asarray(values, dtype=np.uint64)
+    if v.size > 1 and not np.all(v[1:] > v[:-1]):
+        v = np.unique(v)
+    return v
+
+
 def _locked(fn):
     """Run under the fragment mutex (fragment.go:88 RWMutex discipline)."""
     import functools
@@ -171,14 +181,21 @@ class Fragment:
         # syncs reaching back past it must rebuild.
         self._mutlog: Dict[int, int] = {}
         self._mut_floor = 0
-        # Word-level dirty tracking: {row: {device_word_index: version}}
+        # Word-level dirty tracking: {row: [(version, int32 word idxs)]}
         # lets the engine sync a point write by shipping the CHANGED
         # 4-byte words instead of the whole 128 KiB row — the
         # host->device transfer is the dominant cost of incremental sync
-        # through a slow transport.  ``_word_floor[row]`` marks the last
+        # through a slow transport.  Chunks (version-stamped word
+        # arrays) replace the old per-word dict: a bulk batch logs ONE
+        # append per dirty row instead of one dict store per word (the
+        # dict bookkeeping dominated the old ingest path).  Chunks
+        # compact (unique-merge to the newest version — safe: a too-new
+        # version only reships idempotent words) when entries exceed
+        # WORD_LOG_MAX, and flip to whole-row dirty when the distinct
+        # words still exceed it.  ``_word_floor[row]`` marks the last
         # whole-row-dirty version (dense load, clear_row, log overflow):
         # syncs reaching back past it take the full row.
-        self._word_log: Dict[int, Dict[int, int]] = {}
+        self._word_log: Dict[int, List[tuple]] = {}
         self._word_floor: Dict[int, int] = {}
 
         # Lazily-built mutex occupancy vector: column -> owning row (-1 none).
@@ -224,12 +241,14 @@ class Fragment:
         yield from self._group_by_pairs(row_ids, in_row)
 
     def _load_positions(self, positions: np.ndarray):
-        """Storage positions (row*ShardWidth + in-shard col) -> rows."""
+        """Storage positions (row*ShardWidth + in-shard col) -> rows,
+        through the same multi-row merge as the bulk-import path."""
         if positions.size == 0:
             return
-        for r, pos in self._group_by_row(positions):
-            n = self._store.union(r, pos)
-            self.cache.bulk_add(r, n)
+        rows, bounds, pos = self._split_packed(_sorted_unique_u64(positions))
+        new_counts, _, _ = self._store.bulk_merge(rows, bounds, pos)
+        for i in range(len(rows)):
+            self.cache.bulk_add(int(rows[i]), int(new_counts[i]))
         self.cache.invalidate()
         self._mutex_owners = None
         self._version += 1
@@ -345,21 +364,80 @@ class Fragment:
         self._mutlog[row_id] = self._version
         v = self._version
         if cols is None:
-            self._word_floor[row_id] = v
-            self._word_log.pop(row_id, None)
+            self._word_row_dirty(row_id, v)
         else:
-            wlog = self._word_log.setdefault(row_id, {})
             if isinstance(cols, (int, np.integer)):
-                wlog[int(cols) >> 5] = v
+                words = np.asarray([int(cols) >> 5], dtype=np.int32)
             else:
-                for w in np.unique(
+                words = np.unique(
                     np.asarray(cols, dtype=np.int64) >> 5
-                ).tolist():
-                    wlog[w] = v
-            if len(wlog) > self.WORD_LOG_MAX:
-                self._word_floor[row_id] = v
-                self._word_log.pop(row_id, None)
+                ).astype(np.int32)
+            self._word_log_append(row_id, v, words)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        WRITE_SEQ.v += 1
+        if self._on_touch is not None:
+            self._on_touch()
+
+    def _word_row_dirty(self, row_id: int, v: int):
+        self._word_floor[row_id] = v
+        self._word_log.pop(row_id, None)
+
+    # Word-log chunks per row before a compaction pass (bounds both the
+    # entry count and how many batch parent arrays a row's views pin).
+    WORD_LOG_CHUNKS = 8
+
+    def _word_log_append(self, row_id: int, v: int, words: np.ndarray):
+        """Log a row's dirty device words as ONE (version, array) chunk;
+        every WORD_LOG_CHUNKS appends the chunks compact (unique-merge,
+        stamped at the newest version — over-stamping only reships
+        idempotent words), and the row flips to whole-row dirty once its
+        distinct dirty words exceed WORD_LOG_MAX anyway."""
+        if words.size > self.WORD_LOG_MAX:
+            self._word_row_dirty(row_id, v)
+            return
+        chunks = self._word_log.setdefault(row_id, [])
+        chunks.append((v, words))
+        if len(chunks) >= self.WORD_LOG_CHUNKS:
+            merged = np.unique(np.concatenate([w for _, w in chunks]))
+            if merged.size > self.WORD_LOG_MAX:
+                self._word_row_dirty(row_id, v)
+                return
+            self._word_log[row_id] = [(v, merged.astype(np.int32))]
+
+    def _touch_rows(self, rows, words, wbounds):
+        """Bulk ``_touch``: ONE version bump covers every row of a batch
+        (sync_snapshot only needs ordering, not per-row versions), the
+        row log updates through one C-speed ``dict.update``, and each
+        row's dirty device words land as ONE word-log chunk —
+        ``words[wbounds[i]:wbounds[i+1]]`` (sorted unique int32,
+        precomputed from the batch's packed keys in one pass)."""
+        self._version += 1
+        v = self._version
+        row_list = rows.tolist()
+        self._mutlog.update(dict.fromkeys(row_list, v))
+        word_log = self._word_log
+        wb = wbounds.tolist() if isinstance(wbounds, np.ndarray) else wbounds
+        max_words = self.WORD_LOG_MAX
+        max_chunks = self.WORD_LOG_CHUNKS
+        for i, r in enumerate(row_list):
+            w = words[wb[i] : wb[i + 1]]
+            if w.size > max_words:
+                self._word_row_dirty(r, v)
+                continue
+            chunks = word_log.get(r)
+            if chunks is None:
+                word_log[r] = [(v, w)]
+                continue
+            chunks.append((v, w))
+            if len(chunks) >= max_chunks:
+                merged = np.unique(np.concatenate([x for _, x in chunks]))
+                if merged.size > max_words:
+                    self._word_row_dirty(r, v)
+                else:
+                    word_log[r] = [(v, merged.astype(np.int32))]
+        checksums = self._checksums
+        for blk in np.unique(rows // HASH_BLOCK_SIZE).tolist():
+            checksums.pop(blk, None)
         WRITE_SEQ.v += 1
         if self._on_touch is not None:
             self._on_touch()
@@ -395,13 +473,21 @@ class Fragment:
                     continue
                 occ = self._store.occupancy64(r)
                 wlog = self._word_log.get(r)
-                if version < self._word_floor.get(r, 0) or wlog is None:
+                if version < self._word_floor.get(r, 0) or not wlog:
                     out[r] = ("row", self.row_words(r), occ)
                     continue
-                widxs = np.asarray(
-                    sorted(w for w, wv in wlog.items() if wv > version),
-                    dtype=np.int32,
-                )
+                fresh = [w for wv, w in wlog if wv > version]
+                if not fresh:
+                    # The row version advanced but no word chunk did:
+                    # only a whole-row touch can do that, and the floor
+                    # check above would have caught it — defensive.
+                    out[r] = ("row", self.row_words(r), occ)
+                    continue
+                widxs = (
+                    np.unique(np.concatenate(fresh))
+                    if len(fresh) > 1
+                    else fresh[0]
+                ).astype(np.int32)
                 words = self.row_words(r)
                 out[r] = ("words", widxs, words[widxs], occ)
             return self._version, out
@@ -564,31 +650,132 @@ class Fragment:
         return value, True
 
     @_locked
+    @_timed("set_value")
     def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
-        """Write a BSI value + not-null bit (fragment.go:634-689)."""
+        """Write a BSI value + not-null bit (fragment.go:634-689) as one
+        multi-plane pass: a single touch/version bump and op-log append
+        per CHANGED plane, instead of bit_depth+1 full single-bit write
+        paths each paying their own touch, word-log, and histogram."""
         self._check_open()
-        changed = False
-        for i in range(bit_depth):
-            if (value >> i) & 1:
-                changed |= self._set_bit(i, column_id)
-            else:
-                changed |= self._clear_bit(i, column_id)
-        changed |= self._set_bit(bit_depth, column_id)
-        return changed
+        return self._write_value(column_id, bit_depth, value, clear=False)
 
     @_locked
+    @_timed("clear_value")
     def clear_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        """Clear a BSI value: every value plane is CLEARED along with
+        the not-null bit — the reference's semantics (fragment.go
+        clearValue :700 calls setValueBase with value=0).  ``value`` is
+        accepted for signature compatibility but ignored; this
+        previously re-WROTE the value's planes like set_value, leaving
+        the cleared column's bit pattern resident in the plane rows."""
         self._check_open()
-        changed = False
-        for i in range(bit_depth):
-            if (value >> i) & 1:
-                changed |= self._set_bit(i, column_id)
+        return self._write_value(column_id, bit_depth, 0, clear=True)
+
+    def _write_value(
+        self, column_id: int, bit_depth: int, value: int, clear: bool
+    ) -> bool:
+        """Masked multi-plane write under one lock hold: per-plane
+        single-bit store ops (cheap), but op-log/owner bookkeeping only
+        for planes that actually changed, then ONE bulk touch."""
+        self.pos(0, column_id)  # bounds check once, not per plane
+        in_row = column_id % SHARD_WIDTH
+        store = self._store
+        owners = self._mutex_owners
+        changed_rows: List[int] = []
+        for i in range(bit_depth + 1):
+            if i == bit_depth:
+                setting = not clear
             else:
-                changed |= self._clear_bit(i, column_id)
-        changed |= self._clear_bit(bit_depth, column_id)
-        return changed
+                setting = bool((value >> i) & 1)
+            if setting:
+                if not store.set(i, in_row):
+                    continue
+                if owners is not None:
+                    owners[in_row] = i
+                self._append_op(codec.OP_TYPE_ADD, i * SHARD_WIDTH + in_row)
+            else:
+                if not store.clear(i, in_row):
+                    continue
+                if owners is not None and owners[in_row] == i:
+                    owners[in_row] = -1
+                self._append_op(codec.OP_TYPE_REMOVE, i * SHARD_WIDTH + in_row)
+            changed_rows.append(i)
+        if not changed_rows:
+            return False
+        rows = np.asarray(changed_rows, dtype=np.int64)
+        self._touch_rows(
+            rows,
+            np.full(len(changed_rows), in_row >> 5, dtype=np.int32),
+            np.arange(len(changed_rows) + 1, dtype=np.int64),
+        )
+        for r in changed_rows:
+            self.cache.add(r, store.count(r))
+        return True
 
     # -- bulk import -------------------------------------------------------
+
+    @staticmethod
+    def _split_packed(packed: np.ndarray):
+        """Sorted unique packed ``row << SHARD_WIDTH_EXP | pos`` keys ->
+        ``(rows int64[R], bounds int64[R+1], positions uint32[N])`` where
+        row ``rows[i]`` owns ``positions[bounds[i]:bounds[i+1]]`` —
+        the one materialization every bulk path shares.  Accepts int64
+        or uint64 keys (python-int shifts keep the dtype)."""
+        row_keys = (packed >> ops.SHARD_WIDTH_EXP).astype(np.int64)
+        starts = np.flatnonzero(np.r_[True, row_keys[1:] != row_keys[:-1]])
+        rows = row_keys[starts]
+        bounds = np.append(starts, packed.size)
+        positions = (packed & (SHARD_WIDTH - 1)).astype(np.uint32)
+        return rows, bounds, positions
+
+    def _apply_packed(self, packed: np.ndarray, clear: bool) -> int:
+        """Apply sorted unique packed (row, pos) keys as ONE multi-row
+        RowStore.bulk_merge + ONE bulk touch; caches update from the
+        merge's own count vector and ``changed`` comes from its popcount
+        delta (no per-row before/after count() walk).  The dirty device
+        words per row come out of the same sorted keys (``packed >> 5``)
+        in one vectorized pass.  Returns bits changed.  Caller
+        invalidates the rank cache and snapshots."""
+        rows, bounds, positions = self._split_packed(packed)
+        new_counts, changed, touched = self._store.bulk_merge(
+            rows, bounds, positions, clear=clear, packed=packed
+        )
+        if self._mutex_owners is not None:
+            # Keep the lazily-built occupancy vector honest, like
+            # _set_bit/_clear_bit: a stale owner entry would make a
+            # later mutex re-set of the same (row, col) a silent no-op.
+            idx = positions.astype(np.int64)
+            rep = np.repeat(rows, np.diff(bounds))
+            if clear:
+                mine = self._mutex_owners[idx] == rep
+                self._mutex_owners[idx[mine]] = -1
+            else:
+                self._mutex_owners[idx] = rep
+        # Device-word keys (row << 15 | pos >> 5), already sorted: dedup
+        # and split per row without touching python per position.
+        wk = packed >> 5
+        uw = wk[np.r_[True, wk[1:] != wk[:-1]]]
+        words = (uw & (bitops.WORDS - 1)).astype(np.int32)
+        wrows = uw >> 15
+        wbounds = np.append(
+            np.flatnonzero(np.r_[True, wrows[1:] != wrows[:-1]]), uw.size
+        )
+        if not touched.all():
+            keep = np.flatnonzero(touched)
+            rows, new_counts = rows[keep], new_counts[keep]
+            wsizes = np.diff(wbounds)[keep]
+            words = (
+                np.concatenate(
+                    [words[wbounds[i] : wbounds[i + 1]] for i in keep]
+                )
+                if keep.size
+                else words[:0]
+            )
+            wbounds = np.append(0, np.cumsum(wsizes))
+        if rows.size:
+            self._touch_rows(rows, words, wbounds)
+            self.cache.bulk_update(rows, new_counts)
+        return int(changed.sum())
 
     @_locked
     @_timed("bulk_import")
@@ -599,18 +786,82 @@ class Fragment:
         clear: bool = False,
     ) -> int:
         """Set (or with ``clear`` remove, api.go ImportOptions.Clear
-        :764) many bits at once, updating caches once per row and taking
-        a single snapshot — bypassing the op-log (fragment.go:1445-1533).
-        Mutex fragments go through a vectorized clear-previous-owner pass
+        :764) many bits at once: ONE sort over packed (row, col) keys,
+        ONE multi-row store merge, ONE touch/cache pass, ONE snapshot —
+        bypassing the op-log (fragment.go:1445-1533).  Mutex fragments
+        go through a vectorized clear-previous-owner pass
         (bulkImportMutex :1538) driven by the occupancy vector; a CLEAR
-        import bypasses it (fragment.go:1451 `!options.Clear`)."""
+        import bypasses it (fragment.go:1451 `!options.Clear`).  The
+        pre-vectorization per-row walk survives as
+        ``bulk_import_rowloop`` (differential oracle + bench baseline)."""
+        self._check_open()
+        row_ids = np.asarray(row_ids, dtype=np.int64)
+        column_ids = np.asarray(column_ids, dtype=np.int64)
+        if row_ids.size == 0:
+            return 0
+        if self.mutex and not clear:
+            changed = self._bulk_import_mutex(row_ids, column_ids)
+            self.snapshot()
+            return changed
+        packed = np.unique(
+            (row_ids << np.int64(ops.SHARD_WIDTH_EXP))
+            | (column_ids % SHARD_WIDTH)
+        )
+        changed = self._apply_packed(packed, clear)
+        self.cache.invalidate()
+        self.snapshot()
+        return changed
+
+    def _bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray) -> int:
+        """Vectorized mutex bulk path: last write per column wins; previous
+        owners are looked up in the occupancy vector, cleared in one
+        multi-row difference, and the fresh assignments land in one
+        multi-row union (fragment.go bulkImportMutex :1538-1607)."""
+        in_row = (column_ids % SHARD_WIDTH).astype(np.int64)
+        cols, rws = self._last_write_wins(in_row, row_ids)
+
+        own = self._owners()
+        prev = own[cols]
+        changed = 0
+        exp = np.uint64(ops.SHARD_WIDTH_EXP)
+
+        stale = (prev >= 0) & (prev != rws)
+        if stale.any():
+            packed = np.sort(
+                (prev[stale].astype(np.uint64) << exp)
+                | cols[stale].astype(np.uint64)
+            )
+            self._apply_packed(packed, clear=True)
+        fresh = prev != rws
+        if fresh.any():
+            packed = np.sort(
+                (rws[fresh].astype(np.uint64) << exp)
+                | cols[fresh].astype(np.uint64)
+            )
+            changed = self._apply_packed(packed, clear=False)
+        own[cols] = rws
+        self.cache.invalidate()
+        return changed
+
+    @_locked
+    def bulk_import_rowloop(
+        self,
+        row_ids: Iterable[int],
+        column_ids: Iterable[int],
+        clear: bool = False,
+    ) -> int:
+        """The pre-vectorization per-row import walk, byte-for-byte:
+        RowStore.union/difference once per row with per-row touch and
+        count bookkeeping.  Kept as the differential oracle for the
+        ingest tests and the same-machine baseline for
+        ``bench.py --ingest-sweep`` — NOT a serving path."""
         self._check_open()
         row_ids = np.asarray(list(row_ids), dtype=np.int64)
         column_ids = np.asarray(list(column_ids), dtype=np.int64)
         if row_ids.size == 0:
             return 0
         if self.mutex and not clear:
-            changed = self._bulk_import_mutex(row_ids, column_ids)
+            changed = self._bulk_import_mutex_rowloop(row_ids, column_ids)
             self.snapshot()
             return changed
         changed = 0
@@ -625,9 +876,6 @@ class Fragment:
             )
             changed += abs(after - before)
             if clear and self._mutex_owners is not None:
-                # Keep the lazily-built occupancy vector honest, like
-                # _clear_bit: a stale owner entry would make a later
-                # mutex re-set of the same (row, col) a silent no-op.
                 idx = pos.astype(np.int64)
                 mine = self._mutex_owners[idx] == r
                 self._mutex_owners[idx[mine]] = -1
@@ -637,10 +885,11 @@ class Fragment:
         self.snapshot()
         return changed
 
-    def _bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray) -> int:
-        """Vectorized mutex bulk path: last write per column wins; previous
-        owners are looked up in the occupancy vector and cleared per-row
-        (fragment.go bulkImportMutex :1538-1607)."""
+    def _bulk_import_mutex_rowloop(
+        self, row_ids: np.ndarray, column_ids: np.ndarray
+    ) -> int:
+        """Pre-vectorization mutex bulk walk (oracle twin of
+        _bulk_import_mutex)."""
         in_row = (column_ids % SHARD_WIDTH).astype(np.int64)
         cols, rws = self._last_write_wins(in_row, row_ids)
 
@@ -686,6 +935,7 @@ class Fragment:
         return (cols[keep],) + tuple(a[keep] for a in parallel)
 
     @_locked
+    @_timed("import_values")
     def import_values(
         self,
         column_ids: Iterable[int],
@@ -693,40 +943,52 @@ class Fragment:
         bit_depth: int,
         clear: bool = False,
     ):
-        """Bulk BSI write, vectorized by bit plane: each plane gets one
-        union of its set columns and one difference of its clear columns,
-        instead of bit_depth+1 op-logged writes per value
-        (fragment.go importValue :1609-1657).  One snapshot at the end.
-        With ``clear`` the not-null plane is REMOVED for the given
+        """Bulk BSI write as TWO multi-row merges: every plane's set
+        positions pack into one sorted union and every plane's clear
+        positions into one sorted difference (plus the not-null plane on
+        the matching side), instead of two store calls + a touch per
+        plane (fragment.go importValue :1609-1657).  One snapshot at the
+        end.  With ``clear`` the not-null plane is REMOVED for the given
         columns (fragment.go importSetValue :669 clear branch) — the
         value planes are still written per the given bits, matching the
         reference exactly."""
         self._check_open()
-        cols = np.asarray(list(column_ids), dtype=np.int64)
-        vals = np.asarray(list(values), dtype=np.int64)
+        cols = np.asarray(column_ids, dtype=np.int64)
+        vals = np.asarray(values, dtype=np.int64)
         if cols.size == 0:
             return
         in_row, vals = self._last_write_wins(cols % SHARD_WIDTH, vals)
         order = np.argsort(in_row)
         in_row, vals = in_row[order], vals[order]
-        pos32 = in_row.astype(np.uint32)
+        pos_u64 = in_row.astype(np.uint64)
+        exp = np.uint64(ops.SHARD_WIDTH_EXP)
 
+        set_chunks, clr_chunks = [], []
         for i in range(bit_depth):
             bit_set = ((vals >> i) & 1).astype(bool)
-            set_pos, clr_pos = pos32[bit_set], pos32[~bit_set]
-            if set_pos.size:
-                self._store.union(i, set_pos)
-            if clr_pos.size:
-                self._store.difference(i, clr_pos)
-            self._touch(i, pos32)
-            self.cache.bulk_add(i, self._store.count(i))
-        n = (
-            self._store.difference(bit_depth, pos32)
-            if clear
-            else self._store.union(bit_depth, pos32)
+            key = np.uint64(i) << exp
+            set_chunks.append(key | pos_u64[bit_set])
+            clr_chunks.append(key | pos_u64[~bit_set])
+        not_null = (np.uint64(bit_depth) << exp) | pos_u64
+        (clr_chunks if clear else set_chunks).append(not_null)
+        # Plane-major concatenation of already-sorted position runs:
+        # each chunk is sorted and plane keys ascend, so the packed
+        # vectors arrive sorted-unique without a second sort pass.
+        # (bit_depth 0 — a min==max BSI group — leaves one side empty.)
+        clr_packed = (
+            np.concatenate(clr_chunks)
+            if clr_chunks
+            else np.empty(0, dtype=np.uint64)
         )
-        self._touch(bit_depth, pos32)
-        self.cache.bulk_add(bit_depth, n)
+        set_packed = (
+            np.concatenate(set_chunks)
+            if set_chunks
+            else np.empty(0, dtype=np.uint64)
+        )
+        if clr_packed.size:
+            self._apply_packed(clr_packed, clear=True)
+        if set_packed.size:
+            self._apply_packed(set_packed, clear=False)
         self.cache.invalidate()
         self.snapshot()
 
@@ -745,41 +1007,75 @@ class Fragment:
 
     @_locked
     @_timed("import_roaring")
-    def import_roaring(self, data: bytes, clear: bool = False) -> int:
+    def import_roaring(
+        self, data: bytes, clear: bool = False, values: Optional[np.ndarray] = None
+    ) -> int:
         """Union (or with ``clear``, subtract) a serialized roaring bitmap
         straight into storage — the fast ingest path
-        (fragment.go importRoaring :1659; ImportRoaringRequest.Clear)."""
+        (fragment.go importRoaring :1659; ImportRoaringRequest.Clear).
+        ``values``: pre-decoded storage positions (the API decodes once
+        and shares them here instead of paying a second container
+        decode).  The codec's sorted-unique positions ARE the packed
+        (row, pos) keys — row*ShardWidth + col is row << 20 | col — so
+        the decode output feeds the multi-row merge with no re-sort;
+        ``changed`` comes from the merge's popcount delta instead of two
+        full-store count sweeps."""
         self._check_open()
-        dec = codec.deserialize(data)
-        before = sum(self._store.counts.values())
+        if values is None:
+            values = codec.deserialize(data).values
+        positions = _sorted_unique_u64(values)
+        if positions.size == 0:
+            self.snapshot()
+            return 0
         if clear:
-            self._difference_positions(dec.values)
+            changed = self._difference_positions(positions)
         else:
-            self._union_positions(dec.values)
+            changed = self._union_positions(positions)
+        self.snapshot()
+        return changed
+
+    @_locked
+    def import_roaring_rowloop(self, data: bytes, clear: bool = False) -> int:
+        """The pre-vectorization roaring ingest, byte-for-byte: scalar
+        container decode (codec._deserialize_py), per-row store walk,
+        and full-store count sweeps for ``changed``.  Kept as the
+        differential oracle for the ingest tests and the same-machine
+        baseline for ``bench.py --ingest-sweep`` — NOT a serving path."""
+        self._check_open()
+        dec = codec._deserialize_py(data)
+        before = sum(self._store.counts.values())
+        positions = dec.values
+        if positions.size:
+            if clear:
+                for r, pos in self._group_by_row(positions):
+                    if r not in self._store:
+                        continue
+                    n = self._store.difference(r, pos)
+                    self._touch(r, pos)
+                    self.cache.bulk_add(r, n)
+            else:
+                for r, pos in self._group_by_row(positions):
+                    n = self._store.union(r, pos)
+                    self._touch(r, pos)
+                    self.cache.bulk_add(r, n)
+            self._mutex_owners = None
+            self.cache.invalidate()
         self.snapshot()
         return abs(sum(self._store.counts.values()) - before)
 
-    def _difference_positions(self, positions: np.ndarray):
+    def _difference_positions(self, positions: np.ndarray) -> int:
         if positions.size == 0:
-            return
-        for r, pos in self._group_by_row(positions):
-            if r not in self._store:
-                continue
-            n = self._store.difference(r, pos)
-            self._touch(r, pos)
-            self.cache.bulk_add(r, n)
-        self._mutex_owners = None
+            return 0
+        changed = self._apply_packed(_sorted_unique_u64(positions), clear=True)
         self.cache.invalidate()
+        return changed
 
-    def _union_positions(self, positions: np.ndarray):
+    def _union_positions(self, positions: np.ndarray) -> int:
         if positions.size == 0:
-            return
-        for r, pos in self._group_by_row(positions):
-            n = self._store.union(r, pos)
-            self._touch(r, pos)
-            self.cache.bulk_add(r, n)
-        self._mutex_owners = None
+            return 0
+        changed = self._apply_packed(_sorted_unique_u64(positions), clear=False)
         self.cache.invalidate()
+        return changed
 
     @_locked
     def clear_row(self, row_id: int) -> bool:

@@ -710,6 +710,13 @@ class Handler:
         # series.
         if eng is not None and hasattr(eng, "cache_snapshot"):
             out["engineCaches"] = eng.cache_snapshot()
+        # Ingest pipeline telemetry (docs/ingest.md): the device-sync
+        # worker's coalescing stats, surfaced top-level so operators
+        # watching a bulk load don't have to dig through engineCaches.
+        if eng is not None and hasattr(eng, "_ingest_syncer"):
+            syncer = eng._ingest_syncer
+            if syncer is not None:
+                out["ingestSync"] = syncer.snapshot()
         # The histogram registry's JSON view: same data /metrics serves,
         # merged here so one curl shows counters + stages + quantiles.
         out["metrics"] = REGISTRY.snapshot()

@@ -29,6 +29,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -60,6 +61,9 @@ from ..util.stats import (
     METRIC_ENGINE_EVICTIONS,
     METRIC_ENGINE_REBUILDS,
     METRIC_ENGINE_RESIDENT_BYTES,
+    METRIC_INGEST_SYNC_CHUNKS,
+    METRIC_INGEST_SYNC_COALESCED,
+    METRIC_INGEST_SYNC_DISPATCHES,
     REGISTRY,
 )
 from . import kernels
@@ -435,6 +439,117 @@ def _scatter_words_donated(mesh, *args):
     return _scatter_jits(mesh)["words_donated"](mesh, *args)
 
 
+class IngestSyncer:
+    """Stage-decoupled ingest device-sync worker (docs/ingest.md).
+
+    Import paths mutate host truth in the caller's thread, then
+    ``notify()`` this worker, which scatter-syncs the touched index's
+    RESIDENT field stacks on its own thread — so the host decode/pack
+    of ingest chunk N+1 overlaps the device scatter of chunk N (the
+    batcher's stage-decoupled worker pattern, docs/pipeline.md), and
+    chunks landing while a sync pass is in flight coalesce: one
+    ``sync_snapshot`` drain — occupancy bitmaps riding the same
+    fragment lock as the words, exactly as the query-path sync — and
+    one scatter chain carry every dirty row of every coalesced chunk.
+
+    Purely a freshness/latency optimization: queries that arrive before
+    the worker still sync on demand through ``field_stack``, so
+    correctness never depends on this thread's progress.  ``flush()``
+    exists for freshness measurements and deterministic tests."""
+
+    def __init__(self, engine: "MeshEngine"):
+        self._engine = engine
+        self._cv = threading.Condition()
+        self._pending: set = set()
+        self._busy = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self.chunks = 0
+        self.coalesced = 0
+        self.syncs = 0
+        self.stacks_synced = 0
+        self._c_chunks = REGISTRY.counter(METRIC_INGEST_SYNC_CHUNKS)
+        self._c_coalesced = REGISTRY.counter(METRIC_INGEST_SYNC_COALESCED)
+        self._c_syncs = REGISTRY.counter(METRIC_INGEST_SYNC_DISPATCHES)
+
+    def notify(self, index: str):
+        """Mark an index's resident stacks stale; wakes (or lazily
+        starts) the sync worker.  Never blocks on device work."""
+        with self._cv:
+            if self._closed:
+                return
+            self.chunks += 1
+            self._c_chunks.inc()
+            if index in self._pending:
+                # This chunk rides a sync pass that has not started yet
+                # — the coalescing win the counter's help text claims.
+                self.coalesced += 1
+                self._c_coalesced.inc()
+            self._pending.add(index)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="ingest-sync", daemon=True
+                )
+                self._thread.start()
+            self._cv.notify()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._pending:
+                    return
+                drain = list(self._pending)
+                self._pending.clear()
+                self._busy = True
+            try:
+                for index in drain:
+                    try:
+                        self.stacks_synced += self._engine.warm_sync(index)
+                    except Exception as e:  # noqa: BLE001
+                        # A failed warm sync must not kill the worker —
+                        # the query path still syncs on demand.
+                        self._engine._log(f"ingest warm-sync {index}: {e}")
+                self.syncs += 1
+                self._c_syncs.inc()
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until every pending notify has synced; False on
+        timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending or self._busy:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+            return True
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "chunks": self.chunks,
+                "coalesced": self.coalesced,
+                "syncs": self.syncs,
+                "stacksSynced": self.stacks_synced,
+                "pending": len(self._pending),
+                "busy": self._busy,
+            }
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+
+
 class _NotSparse(Exception):
     """Internal: a lowered tree has no occupancy-guided form."""
 
@@ -521,6 +636,9 @@ class MeshEngine:
         # Lazy cross-request Count micro-batcher (parallel/batcher.py).
         self._batcher = None
         self._batcher_lock = threading.Lock()
+        # Lazy ingest device-sync worker (IngestSyncer): the API's
+        # import paths notify it after each applied chunk.
+        self._ingest_syncer = None
         # Count/Sum/Min/Max/fused-TopN/TopN-scorer/GroupBy all replay on
         # peers; without a configured broadcast on a multi-process mesh
         # every fused path falls back to the per-shard host path instead
@@ -806,6 +924,33 @@ class MeshEngine:
         self._stacks[key] = stack
         self._resident_bytes += mat.nbytes
         return stack
+
+    def ingest_syncer(self) -> IngestSyncer:
+        """The lazy ingest device-sync worker (docs/ingest.md)."""
+        if self._ingest_syncer is None:
+            with self._batcher_lock:
+                if self._ingest_syncer is None:
+                    self._ingest_syncer = IngestSyncer(self)
+        return self._ingest_syncer
+
+    def warm_sync(self, index: str) -> int:
+        """Scatter-sync every RESIDENT stack of ``index`` to current
+        host truth — the device half of the ingest pipeline.  Only
+        already-resident stacks sync: warming never admits a stack a
+        query hasn't asked for, so a bulk load of a never-queried field
+        cannot evict the serving set.  Returns stacks visited."""
+        with self._dispatch_lock, self._stacks_lock:
+            keys = [k for k in self._stacks if k[0] == index]
+        n = 0
+        canonical = self.canonical_shards(index)
+        for key in keys:
+            with self._dispatch_lock, self._stacks_lock:
+                if key in self._stacks:
+                    self._field_stack_locked(
+                        key, key[0], key[1], key[2], canonical
+                    )
+                    n += 1
+        return n
 
     # Rows per scatter dispatch (operand = rows x 128 KiB of host->device
     # transfer per chunk); deltas of any size chain chunks — the first
@@ -2421,6 +2566,13 @@ class MeshEngine:
         keep every buffer reachable.  Wired from server.close().
         Idempotent; a closed engine can still serve (caches simply
         rebuild) but deployments shouldn't."""
+        syncer = self._ingest_syncer
+        if syncer is not None:
+            try:
+                syncer.close()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+            self._ingest_syncer = None
         batcher = self._batcher
         if batcher is not None:
             try:
@@ -2508,6 +2660,11 @@ class MeshEngine:
             "sparseDispatches": self.sparse_dispatches,
             "deviceBytesSkipped": self.device_bytes_skipped,
             "batchCseDeduped": self.batch_cse_deduped,
+            "ingestSync": (
+                None
+                if self._ingest_syncer is None
+                else self._ingest_syncer.snapshot()
+            ),
             "closed": self._closed,
         }
 

@@ -322,6 +322,25 @@ METRIC_ENGINE_COMPILE_KEYS = "pilosa_engine_compile_cache_keys"
 METRIC_GOSSIP_TRANSITIONS = "pilosa_gossip_state_transitions_total"
 COMPILE_PHASES = ("trace", "lower", "compile")
 
+# -- ingest surface (docs/ingest.md) ----------------------------------------
+#   pilosa_ingest_batches_total{path=}      bulk-import batches accepted
+#   pilosa_ingest_bits_total{path=}         bits/values submitted to them
+#   pilosa_ingest_changed_total             bits the imports actually flipped
+#   pilosa_ingest_seconds{path=}            per-batch apply latency histogram
+#   pilosa_ingest_sync_chunks_total         ingest chunks notified to the
+#                                           device-sync worker
+#   pilosa_ingest_sync_coalesced_total      notifies absorbed into an
+#                                           already-pending sync (overlap win)
+#   pilosa_ingest_sync_dispatches_total     warm-sync passes the worker ran
+METRIC_INGEST_BATCHES = "pilosa_ingest_batches_total"
+METRIC_INGEST_BITS = "pilosa_ingest_bits_total"
+METRIC_INGEST_CHANGED = "pilosa_ingest_changed_total"
+METRIC_INGEST_SECONDS = "pilosa_ingest_seconds"
+METRIC_INGEST_SYNC_CHUNKS = "pilosa_ingest_sync_chunks_total"
+METRIC_INGEST_SYNC_COALESCED = "pilosa_ingest_sync_coalesced_total"
+METRIC_INGEST_SYNC_DISPATCHES = "pilosa_ingest_sync_dispatches_total"
+INGEST_PATHS = ("bits", "values", "roaring")
+
 PIPELINE_STAGES = ("queue_wait", "lower_dispatch", "device_readback", "decode")
 
 # Engine cache names labelling the hit/miss counter series (engine.py
@@ -372,7 +391,34 @@ for _phase in COMPILE_PHASES:
         help="Cumulative JAX trace/lower/compile seconds",
         phase=_phase,
     )
-del _stage, _cache, _phase
+for _path in INGEST_PATHS:
+    REGISTRY.counter(
+        METRIC_INGEST_BATCHES, help="Bulk-import batches accepted", path=_path
+    )
+    REGISTRY.counter(
+        METRIC_INGEST_BITS, help="Bits submitted to bulk imports", path=_path
+    )
+    REGISTRY.histogram(
+        METRIC_INGEST_SECONDS,
+        help="Bulk-import batch apply latency (seconds)",
+        path=_path,
+    )
+REGISTRY.counter(
+    METRIC_INGEST_CHANGED, help="Bits bulk imports actually changed"
+)
+REGISTRY.counter(
+    METRIC_INGEST_SYNC_CHUNKS,
+    help="Ingest chunks notified to the device-sync worker",
+)
+REGISTRY.counter(
+    METRIC_INGEST_SYNC_COALESCED,
+    help="Ingest sync notifies coalesced into a pending pass",
+)
+REGISTRY.counter(
+    METRIC_INGEST_SYNC_DISPATCHES,
+    help="Warm-sync passes the ingest sync worker ran",
+)
+del _stage, _cache, _phase, _path
 
 
 class StatsClient:

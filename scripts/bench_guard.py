@@ -27,11 +27,14 @@ Inputs accepted for both sides:
   and fail only via ``--require``.
 
 Direction is unit-aware: ``us``/``ms``/``s`` regress UP, ``qps``/
-``GB/s`` regress DOWN.  Dimensionless telemetry (``queries/batch``,
-``batches``) is reported but never fails the run.  Metrics present in
-only one file are reported as added/missing; ``--require`` names
-metrics whose ABSENCE from the new run is itself a failure (a deleted
-headline metric must not pass silently).
+``GB/s``/``Mbits/s`` regress DOWN.  Dimensionless telemetry
+(``queries/batch``, ``batches``) is reported but never fails the run.
+Metrics present in only one file are reported as added/missing;
+``--require`` names metrics whose ABSENCE from the new run is itself a
+failure (a deleted headline metric must not pass silently).  The
+headline metrics in ``AUTO_REQUIRE`` — the north-star latency and the
+ingest ``ingest_mbits_s`` throughput — are required automatically
+whenever the baseline records them.
 """
 
 from __future__ import annotations
@@ -41,7 +44,13 @@ import json
 import sys
 
 LOWER_BETTER = {"us", "ms", "s", "seconds"}
-HIGHER_BETTER = {"qps", "GB/s", "gbs"}
+HIGHER_BETTER = {"qps", "GB/s", "gbs", "Mbits/s"}
+
+# Headline metrics auto-required whenever the BASELINE carries them: a
+# later PR that silently drops the ingest or north-star line from the
+# bench must fail the guard, not pass by omission (equivalent to always
+# passing ``--require ingest_mbits_s`` once a baseline records it).
+AUTO_REQUIRE = ("count_intersect_1B_cols_p50", "ingest_mbits_s")
 
 
 def parse_jsonl(text: str) -> dict:
@@ -121,6 +130,9 @@ def check(current: dict, baseline: dict, tolerance: float,
           per_metric: dict, require=()) -> tuple:
     """(failures, notes, checked): tolerance violations, informational
     lines, and how many metrics were actually compared."""
+    require = tuple(require) + tuple(
+        n for n in AUTO_REQUIRE if n in baseline and n not in require
+    )
     failures, notes, checked = [], [], 0
     for name in sorted(baseline):
         base = baseline[name]

@@ -99,6 +99,50 @@ assert count_intersect()["results"][0] == 3
 assert memo_hits() > h0, "repeated Count did not hit the result memo"
 assert eng.fused_dispatches == disp0, "memo hit still dispatched the device"
 
+# Ingest smoke: import-roaring -> query -> /metrics round trip — a
+# serialized roaring batch lands through the HTTP fast path, the fresh
+# bits are immediately queryable, and the pilosa_ingest_* series moved
+# (docs/ingest.md).
+import numpy as _np
+
+from pilosa_tpu.roaring import codec as _codec
+
+_vals = _np.asarray(
+    [(3 << 20) | 1, (3 << 20) | 2, (3 << 20) | 70000], dtype=_np.uint64
+)
+_r = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/field/f/import-roaring/0",
+    data=_codec.serialize(_vals), method="POST",
+)
+_doc = json.loads(urllib.request.urlopen(_r, timeout=60).read())
+assert _doc["changed"] == 3, _doc
+_r = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/query",
+    data=b"Count(Row(f=3))", method="POST",
+)
+assert json.loads(urllib.request.urlopen(_r, timeout=60).read())["results"][0] == 3
+
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+ingest_required = [
+    "pilosa_ingest_batches_total",
+    "pilosa_ingest_bits_total",
+    "pilosa_ingest_changed_total",
+    "pilosa_ingest_seconds_bucket",
+    "pilosa_ingest_sync_chunks_total",
+    "pilosa_ingest_sync_coalesced_total",
+    "pilosa_ingest_sync_dispatches_total",
+]
+missing = [s for s in ingest_required if s not in text]
+assert not missing, f"/metrics is missing ingest series: {missing}"
+for line in text.splitlines():
+    if line.startswith("pilosa_ingest_batches_total") and 'path="roaring"' in line:
+        assert float(line.rsplit(" ", 1)[1]) >= 1, line
+        break
+else:
+    raise AssertionError("no pilosa_ingest_batches_total{path=roaring} sample")
+
 # The root span registers from a completion callback moments after the
 # response is written; poll briefly instead of racing it.
 import time
