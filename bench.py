@@ -353,7 +353,7 @@ def report_scrape(port):
         emit_raw(name, v, "bytes" if "bytes" in name else "", 1.0)
 
 
-def main(depth_sweep=False, scrape=False):
+def main(depth_sweep=False, conn_sweep=False, scrape=False):
     progress("importing jax")
     import jax
     import jax.numpy as jnp
@@ -807,7 +807,18 @@ def main(depth_sweep=False, scrape=False):
     from pilosa_tpu.net.server import serve
 
     api = API(holder=holder, mesh_engine=eng)
-    httpd, _ = serve(api, "localhost", 0)
+    # The bench measures serving CAPACITY, so admission must sit above
+    # the offered load: the conn-sweep's open-loop senders pipeline up
+    # to 64 conns x 64 in-flight (the server's per-connection pending
+    # cap) = 4096 concurrent requests from ONE tenant, which the
+    # production default (1024) would correctly shed with 429s — and a
+    # shed reply would crash the 200-only sweep readers.
+    from pilosa_tpu.net.admission import AdmissionController
+
+    httpd, _ = serve(
+        api, "localhost", 0,
+        admission=AdmissionController(max_inflight=1 << 17),
+    )
     port = httpd.server_address[1]
     c2_texts = [
         f"Count(Xor(Difference(Union(Row(f={100 + 4 * k}), Row(f={101 + 4 * k})), "
@@ -985,6 +996,107 @@ print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
             )
         eng._batcher.stop()
         eng._batcher = None  # back to the default-depth lazy batcher
+
+    # ---- optional connection-count sweep (--conn-sweep) ------------------
+    # Open-loop senders: each connection PIPELINES its requests (a writer
+    # thread streams them without waiting for responses; a reader drains
+    # them), so offered load is set by the connection count — not gated
+    # on the previous response like the closed-loop headline run.  One
+    # line per level: http_count_qps_c{N}, plus the batcher's occupancy
+    # delta at that level — the cross-connection coalescing curve
+    # (docs/serving.md; the event-loop server feeds every connection
+    # into ONE accumulate stage, so occupancy should RISE with N).
+    OPEN_LOOP_SRC = r"""
+import json, socket, sys, threading, time
+port, n_conns, per_conn = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))
+texts = json.loads(sys.stdin.read())
+
+def build(body):
+    b = body.encode()
+    return (b"POST /index/b10m/query HTTP/1.1\r\nHost: l\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(b)).encode() + b"\r\n\r\n" + b)
+
+reqs = [build(t) for t in texts]
+done = []
+lock = threading.Lock()
+
+def conn_worker(cid):
+    s = socket.create_connection(("localhost", port), timeout=300)
+    f = s.makefile("rb")
+    def writer():
+        for j in range(per_conn):
+            s.sendall(reqs[(cid * per_conn + j) % len(reqs)])
+    w = threading.Thread(target=writer)
+    w.start()
+    n = 0
+    try:
+        for j in range(per_conn):
+            line = f.readline()
+            assert line.startswith(b"HTTP/1.1 200"), line
+            clen = 0
+            while True:
+                h = f.readline()
+                if h in (b"\r\n", b""):
+                    break
+                if h.lower().startswith(b"content-length:"):
+                    clen = int(h.split(b":")[1])
+            f.read(clen)
+            n += 1
+    finally:
+        w.join()
+        s.close()
+        with lock:
+            done.append(n)
+
+threads = [threading.Thread(target=conn_worker, args=(c,))
+           for c in range(n_conns)]
+t0 = time.perf_counter()
+for t in threads: t.start()
+for t in threads: t.join()
+print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
+"""
+
+    def run_open_loop(texts, n_conns, per_conn):
+        import os as os_mod
+        import tempfile
+
+        script = tempfile.NamedTemporaryFile("w", suffix=".py", delete=False)
+        script.write(OPEN_LOOP_SRC)
+        script.close()
+        try:
+            p = subprocess.Popen(
+                [sys_mod.executable, script.name, str(port), str(n_conns),
+                 str(per_conn)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            )
+            out, _ = p.communicate(json.dumps(texts).encode(), timeout=600)
+        finally:
+            os_mod.unlink(script.name)
+        doc = json.loads(out)
+        return doc["n"] / doc["seconds"], doc["n"]
+
+    if conn_sweep:
+        texts = [t.decode() for t in c2_texts]
+        TOTAL = 2048  # per level; sized so one level runs in seconds
+        for n_conns in (1, 4, 16, 64):
+            b = eng._batcher
+            b0, q0 = (b.batches, b.batched_queries) if b else (0, 0)
+            c_qps, c_total = run_open_loop(
+                texts, n_conns, max(32, TOTAL // n_conns)
+            )
+            emit_raw(f"http_count_qps_c{n_conns}", c_qps, "qps",
+                     c_qps * c_c2)
+            b = eng._batcher
+            if b is not None and b.batches > b0:
+                occ = (b.batched_queries - q0) / (b.batches - b0)
+            else:
+                occ = 0.0
+            progress(
+                f"conn sweep c{n_conns}: {c_qps:.1f} qps over {c_total}, "
+                f"occupancy {occ:.2f}"
+            )
     if scrape:
         report_scrape(port)
     httpd.shutdown()
@@ -1427,6 +1539,14 @@ if __name__ == "__main__":
         "headline JSONL metric ingest_mbits_s — docs/ingest.md)",
     )
     ap.add_argument(
+        "--conn-sweep",
+        action="store_true",
+        help="also sweep client connection counts (1/4/16/64, open-loop "
+        "pipelined senders) and emit http_count_qps_c{N} lines plus the "
+        "batcher's per-level occupancy — the cross-connection coalescing "
+        "curve (docs/serving.md)",
+    )
+    ap.add_argument(
         "--scrape",
         action="store_true",
         help="append the post-run /metrics device gauges (resident "
@@ -1440,4 +1560,8 @@ if __name__ == "__main__":
     elif args.density_sweep:
         density_sweep()
     else:
-        main(depth_sweep=args.depth_sweep, scrape=args.scrape)
+        main(
+            depth_sweep=args.depth_sweep,
+            conn_sweep=args.conn_sweep,
+            scrape=args.scrape,
+        )

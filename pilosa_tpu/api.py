@@ -146,6 +146,11 @@ class API:
         # check; set by the server after _setup_gossip (None when no
         # gossip is configured).
         self.gossip = None
+        # Admission controller handle (net/admission.py), wired by
+        # net.serve() on the event-loop backend: lets API-level surfaces
+        # (debug snapshots, operator tooling) read shed state without a
+        # reference to the HTTP server object.
+        self.admission = None
         # Tracing is always-on at the serving tier: the default is a
         # real span tracer (cheap — a few object allocations per query)
         # so /debug/traces works out of the box; pass a NopTracer to

@@ -79,6 +79,27 @@ class Config:
         self.tls_skip_verify = False
         # HTTP handler options (server/config.go:54-58): CORS origins.
         self.handler_allowed_origins: List[str] = []
+        # Serving backend (docs/serving.md): "async" = event-loop
+        # reactor (net/aserver.py), "threaded" = stdlib oracle.
+        self.server_backend = "async"
+        # SO_REUSEPORT acceptor/reactor workers (scale-out knob; 1 is
+        # right for a single-core host).
+        self.server_reactors = 1
+        # Elastic blocking-route worker ceiling + bounded submit queue.
+        self.server_workers = 256
+        self.server_queue_depth = 1024
+        # Admission control: global in-flight bound, the load fraction
+        # where per-tenant weighted fairness arms, the tenant weight map
+        # ("gold=4,free=1"; unlisted tenants weigh 1).
+        self.server_max_inflight = 1024
+        self.server_fair_start = 0.5
+        self.server_tenant_weights = ""
+        # Parse-stage bounds: oversized bodies are rejected before
+        # buffering; a partial request older than read-timeout is a
+        # slow-loris and its connection is dropped.
+        self.server_max_body_bytes = 256 * 1024 * 1024
+        self.server_read_timeout = 120.0
+        self.server_idle_timeout = 120.0
         # mesh (TPU-native: devices for the shard mesh; 0 = all)
         self.mesh_devices = 0
         # multi-host JAX runtime (jax.distributed): coordinator address
@@ -170,6 +191,29 @@ class Config:
         self.handler_allowed_origins = h.get(
             "allowed-origins", self.handler_allowed_origins
         )
+        srv = doc.get("server", {})
+        self.server_backend = srv.get("backend", self.server_backend)
+        self.server_reactors = int(srv.get("reactors", self.server_reactors))
+        self.server_workers = int(srv.get("workers", self.server_workers))
+        self.server_queue_depth = int(
+            srv.get("queue-depth", self.server_queue_depth)
+        )
+        self.server_max_inflight = int(
+            srv.get("max-inflight", self.server_max_inflight)
+        )
+        self.server_fair_start = float(
+            srv.get("fair-start", self.server_fair_start)
+        )
+        self.server_tenant_weights = srv.get(
+            "tenant-weights", self.server_tenant_weights
+        )
+        self.server_max_body_bytes = int(
+            srv.get("max-body-bytes", self.server_max_body_bytes)
+        )
+        if "read-timeout" in srv:
+            self.server_read_timeout = _parse_duration(srv["read-timeout"])
+        if "idle-timeout" in srv:
+            self.server_idle_timeout = _parse_duration(srv["idle-timeout"])
         mesh = doc.get("mesh", {})
         self.mesh_devices = mesh.get("devices", self.mesh_devices)
         self.jax_coordinator = mesh.get("jax-coordinator", self.jax_coordinator)
@@ -219,6 +263,16 @@ class Config:
             ("tls_key", "TLS_KEY", str),
             ("tls_skip_verify", "TLS_SKIP_VERIFY", bool),
             ("handler_allowed_origins", "HANDLER_ALLOWED_ORIGINS", list),
+            ("server_backend", "SERVER_BACKEND", str),
+            ("server_reactors", "SERVER_REACTORS", int),
+            ("server_workers", "SERVER_WORKERS", int),
+            ("server_queue_depth", "SUBMIT_QUEUE", int),
+            ("server_max_inflight", "MAX_INFLIGHT", int),
+            ("server_fair_start", "FAIR_START", float),
+            ("server_tenant_weights", "TENANT_WEIGHTS", str),
+            ("server_max_body_bytes", "MAX_BODY_BYTES", int),
+            ("server_read_timeout", "READ_TIMEOUT", _parse_duration),
+            ("server_idle_timeout", "IDLE_TIMEOUT", _parse_duration),
             ("mesh_devices", "MESH_DEVICES", int),
             ("jax_coordinator", "JAX_COORDINATOR", str),
             ("jax_num_processes", "JAX_NUM_PROCESSES", int),
@@ -276,6 +330,18 @@ skip-verify = {str(self.tls_skip_verify).lower()}
 
 [handler]
 allowed-origins = [{", ".join(f'"{o}"' for o in self.handler_allowed_origins)}]
+
+[server]
+backend = "{self.server_backend}"
+reactors = {self.server_reactors}
+workers = {self.server_workers}
+queue-depth = {self.server_queue_depth}
+max-inflight = {self.server_max_inflight}
+fair-start = {self.server_fair_start}
+tenant-weights = "{self.server_tenant_weights}"
+max-body-bytes = {self.server_max_body_bytes}
+read-timeout = "{int(self.server_read_timeout)}s"
+idle-timeout = "{int(self.server_idle_timeout)}s"
 
 [translation]
 primary-url = "{self.translation_primary_url}"

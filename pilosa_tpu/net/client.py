@@ -3,15 +3,24 @@
 Mirror of the reference's InternalClient (http/client.go:69-1007 and the
 root-pkg interface client.go:32-60): query forwarding, imports, schema
 ensure, fragment block sync, whole-shard retrieval, cluster messages, and
-translate-log streaming — stdlib urllib only.
+translate-log streaming — stdlib ``http.client`` with POOLED KEEP-ALIVE
+connections.
+
+Pooling rationale (docs/serving.md): cluster-internal traffic — remote
+shard fan-out, /cluster/metrics federation, translate-log replication,
+resize shard copies — used to pay a fresh TCP (and on https a fresh TLS
+handshake) per call via ``urllib.urlopen``.  Every hop now reuses an
+idle persistent connection from a small per-client pool, exactly what
+the reference gets for free from Go's http.Transport.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
+import threading
 from typing import List, Optional
-from urllib.error import HTTPError, URLError
-from urllib.request import Request, urlopen
 
 from ..util import tracing
 
@@ -30,6 +39,11 @@ class ClientError(Exception):
 
 
 class InternalClient:
+    # Idle persistent connections retained per client.  Concurrent
+    # callers beyond this still work (a fresh connection is dialed when
+    # the pool is empty); only the RETAINED idle set is bounded.
+    POOL_SIZE = 8
+
     def __init__(
         self, uri: str, timeout: float = 30.0, tls_skip_verify: bool = False
     ):
@@ -39,14 +53,65 @@ class InternalClient:
         :31-32, http/client.go GetHTTPClient)."""
         self.uri = uri.rstrip("/")
         self.timeout = timeout
+        self._https = self.uri.startswith("https://")
+        # urlsplit, not string surgery: IPv6 literals ("http://[::1]:10101")
+        # and path-prefixed gateways ("http://gw:8080/pilosa") must keep
+        # working exactly as they did through urllib.
+        from urllib.parse import urlsplit
+
+        u = urlsplit(self.uri)
+        self._host = u.hostname or "localhost"
+        self._port = u.port or (443 if self._https else 80)
+        self._base_path = u.path.rstrip("/")
         self._ssl_ctx = None
-        if self.uri.startswith("https://") and tls_skip_verify:
+        if self._https:
             import ssl
 
-            ctx = ssl.create_default_context()
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
+            if tls_skip_verify:
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            else:
+                ctx = ssl.create_default_context()
             self._ssl_ctx = ctx
+        self._pool: List[http.client.HTTPConnection] = []
+        self._pool_lock = threading.Lock()
+
+    # -- connection pool ---------------------------------------------------
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._https:
+            return http.client.HTTPSConnection(
+                self._host, self._port, timeout=self.timeout,
+                context=self._ssl_ctx,
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout
+        )
+
+    def _acquire(self):
+        """(conn, reused): an idle pooled connection when one exists,
+        else a fresh dial.  ``reused`` drives the one-shot retry — a
+        kept-alive socket the server closed between requests is an
+        expected race, not an error."""
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop(), True
+        return self._connect(), False
+
+    def _release(self, conn: http.client.HTTPConnection):
+        with self._pool_lock:
+            if len(self._pool) < self.POOL_SIZE:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def close(self):
+        """Drop all idle pooled connections (tests/teardown hygiene)."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for c in pool:
+            c.close()
 
     # -- low level ---------------------------------------------------------
 
@@ -64,28 +129,53 @@ class InternalClient:
         # the wire half of the explicit capture/attach protocol in
         # util.tracing.
         tracing.inject_headers(headers)
-        req = Request(
-            self.uri + path,
-            data=body,
-            method=method,
-            headers=headers,
-        )
-        try:
-            with urlopen(
-                req, timeout=self.timeout, context=self._ssl_ctx
-            ) as resp:
+        for attempt in (0, 1):
+            conn, reused = self._acquire()
+            try:
+                conn.request(
+                    method, self._base_path + path, body=body, headers=headers
+                )
+                resp = conn.getresponse()
                 data = resp.read()
-        except HTTPError as e:
-            detail = e.read().decode(errors="replace")
-            raise ClientError(
-                f"{method} {path}: {e.code}: {detail}", code=e.code,
-                body=detail,
-            ) from e
-        except URLError as e:
-            raise ClientError(f"{method} {path}: {e.reason}") from e
-        if raw:
-            return data
-        return json.loads(data) if data else {}
+                status = resp.status
+                keep = not resp.will_close
+            except (
+                http.client.HTTPException, socket.error, OSError,
+            ) as e:
+                conn.close()
+                # Retry ONCE, but only on the stale-keep-alive
+                # signatures — the server closed the idle socket under
+                # us BEFORE producing any response bytes (send on a
+                # dead socket, or an empty status line).  A timeout or
+                # a failure mid-response may mean the request was
+                # already processed: resending a non-idempotent POST
+                # there would double-apply it, so those surface
+                # immediately.
+                stale = isinstance(
+                    e,
+                    (
+                        http.client.RemoteDisconnected,
+                        http.client.BadStatusLine,
+                        BrokenPipeError,
+                        ConnectionResetError,
+                    ),
+                ) and not isinstance(e, socket.timeout)
+                if reused and attempt == 0 and stale:
+                    continue
+                raise ClientError(f"{method} {path}: {e}") from e
+            if keep:
+                self._release(conn)
+            else:
+                conn.close()
+            if status >= 400:
+                detail = data.decode(errors="replace")
+                raise ClientError(
+                    f"{method} {path}: {status}: {detail}", code=status,
+                    body=detail,
+                )
+            if raw:
+                return data
+            return json.loads(data) if data else {}
 
     def _get(self, path: str, raw: bool = False):
         return self._do("GET", path, raw=raw)

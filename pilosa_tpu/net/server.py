@@ -10,6 +10,7 @@ examples use).
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -23,9 +24,21 @@ from ..executor.executor import Error as ExecError, FieldNotFoundError, IndexNot
 from ..executor.translate import TranslateError
 from ..pql import ParseError
 from ..util.stats import REGISTRY
-from .wire import response_to_json
+from .wire import count_response_bytes, response_to_json
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Serving backend selection (docs/serving.md): "async" is the event-loop
+# reactor (net/aserver.py); "threaded" is the stdlib thread-per-connection
+# server kept as the differential oracle.  Config [server] backend /
+# PILOSA_TPU_SERVER_BACKEND override.
+DEFAULT_BACKEND = "async"
+
+
+def _resolve_backend(backend: Optional[str]) -> str:
+    if backend:
+        return backend
+    return os.environ.get("PILOSA_TPU_SERVER_BACKEND", DEFAULT_BACKEND)
 
 # Process start reference for /healthz uptime.
 _START_MONOTONIC = time.monotonic()
@@ -154,6 +167,11 @@ class Handler:
         self.api = api
         self.logger = logger
         self.allowed_origins = list(allowed_origins or [])
+        # Wired by serve() on the async backend: the admission
+        # controller (shed accounting for /debug/vars) and the server
+        # instance (connection gauges refreshed at scrape time).
+        self.admission = None
+        self.server = None
         self.routes: List[Route] = []
         r = self._route
         # Public routes (http/handler.go:237-259).
@@ -402,10 +420,12 @@ class Handler:
         self.api.delete_field(index, field)
         return {}
 
-    def _post_query(self, q, b, *, index, **kw):
-        # The reference reads the body as raw PQL unless it's protobuf
-        # (http/handler.go handlePostQuery); accept JSON {"query": ...}
-        # as well as a bare PQL string.
+    def _query_request(self, index, q, b, headers) -> QueryRequest:
+        """Decode one POST /index/{i}/query body into a QueryRequest —
+        shared by the threaded route handler and the reactor's inline
+        fast path.  The reference reads the body as raw PQL unless it's
+        protobuf (http/handler.go handlePostQuery); accept JSON
+        {"query": ...} as well as a bare PQL string."""
         try:
             doc = json.loads(b) if b else {}
         except json.JSONDecodeError:
@@ -413,7 +433,7 @@ class Handler:
         if isinstance(doc, str):  # JSON-quoted PQL body
             doc = {"query": doc}
         shards = doc.get("shards") or _parse_shards(q)
-        req = QueryRequest(
+        return QueryRequest(
             index,
             doc.get("query", ""),
             shards=shards,
@@ -426,29 +446,70 @@ class Handler:
             # Join the caller's trace when the request carries one
             # (X-Trace-Id from a coordinator's shard fan-out, or an
             # external client propagating its own trace).
-            trace_context=self.api.tracer.extract_headers(
-                kw.get("_headers", {})
-            ),
+            trace_context=self.api.tracer.extract_headers(headers or {}),
         )
+
+    def _defer_query(self, req: QueryRequest):
+        """Submit ``req`` into the batch pipeline; DeferredResponse when
+        it pipelined, None when the caller must run the sync path."""
         fut = self.api.query_async(req)
-        if fut is not None:
-            # Pipelined: the response resolves from the batch pipeline's
-            # completion callback; this handler thread goes back to
-            # reading requests instead of parking on the readback.
-            d = DeferredResponse()
+        if fut is None:
+            return None
+        # Pipelined: the response resolves from the batch pipeline's
+        # completion callback; the calling thread (handler thread or
+        # reactor) goes back to reading requests instead of parking on
+        # the readback.
+        d = DeferredResponse()
 
-            def _done(f):
-                try:
-                    out = response_to_json(f.result(0))
-                    span = getattr(f, "trace_span", None)
-                    if span is not None:
-                        out["traceID"] = span.trace_id
-                    d.resolve(200, "application/json", json.dumps(out).encode())
-                except Exception as e:  # noqa: BLE001
-                    status, payload = error_response(e)
-                    d.resolve(status, "application/json", payload)
+        def _done(f):
+            try:
+                resp = f.result(0)
+                span = getattr(f, "trace_span", None)
+                trace_id = span.trace_id if span is not None else None
+                payload = count_response_bytes(resp, trace_id)
+                if payload is None:
+                    out = response_to_json(resp)
+                    if trace_id is not None:
+                        out["traceID"] = trace_id
+                    payload = json.dumps(out).encode()
+                d.resolve(200, "application/json", payload)
+            except Exception as e:  # noqa: BLE001
+                status, payload = error_response(e)
+                d.resolve(status, "application/json", payload)
 
-            fut.add_done_callback(_done)
+        fut.add_done_callback(_done)
+        return d
+
+    # The reactor's inline route: only deferred queries may run on the
+    # event loop (everything else can block).
+    _QUERY_PATH_RE = re.compile(r"^/index/([^/]+)/query$")
+
+    def handle_async(self, method, path, query, body, headers):
+        """Non-blocking dispatch attempt for the event-loop server
+        (net/aserver.py): decode the query and feed it into the batch
+        pipeline's accumulate stage ON THE REACTOR THREAD, so concurrent
+        arrivals from every live connection coalesce into the same
+        fused batches.  Returns a DeferredResponse / response triple, or
+        None when the request needs the blocking worker pool (non-query
+        routes, protobuf negotiation, sync-fallback queries)."""
+        if method != "POST":
+            return None
+        m = self._QUERY_PATH_RE.match(path)
+        if m is None:
+            return None
+        from . import proto
+
+        if proto.CONTENT_TYPE in headers.get(
+            "Content-Type", ""
+        ) or proto.CONTENT_TYPE in headers.get("Accept", ""):
+            return None
+        req = self._query_request(m.group(1), query, body, headers)
+        return self._defer_query(req)
+
+    def _post_query(self, q, b, *, index, **kw):
+        req = self._query_request(index, q, b, kw.get("_headers", {}))
+        d = self._defer_query(req)
+        if d is not None:
             return d
         resp = self.api.query(req)
         out = response_to_json(resp)
@@ -547,6 +608,13 @@ class Handler:
         # backlog, distinct compile keys) refresh at scrape time.
         if eng is not None and hasattr(eng, "refresh_metrics"):
             eng.refresh_metrics()
+        # Serving-tier gauges (live connections, admission in-flight /
+        # active tenants) refresh at scrape time too: the admit path
+        # keeps plain ints, the scrape stamps them into the registry.
+        if self.server is not None and hasattr(self.server, "refresh_gauges"):
+            self.server.refresh_gauges()
+        elif self.admission is not None:
+            self.admission.refresh_gauges()
         return REGISTRY.prometheus_text()
 
     def _metrics(self, q, b, **kw):
@@ -717,6 +785,12 @@ class Handler:
             syncer = eng._ingest_syncer
             if syncer is not None:
                 out["ingestSync"] = syncer.snapshot()
+        # Serving-tier state (docs/serving.md): backend, live
+        # connections, admission in-flight and per-tenant occupancy.
+        if self.server is not None and hasattr(self.server, "snapshot"):
+            out["server"] = self.server.snapshot()
+        elif self.admission is not None:
+            out["server"] = {"admission": self.admission.snapshot()}
         # The histogram registry's JSON view: same data /metrics serves,
         # merged here so one curl shows counters + stages + quantiles.
         out["metrics"] = REGISTRY.snapshot()
@@ -1179,14 +1253,29 @@ def make_server_ssl_context(certfile: str, keyfile: str):
 
 
 def bind_http(
-    host: str = "localhost", port: int = 10101, ssl_context=None
-) -> ThreadingHTTPServer:
+    host: str = "localhost",
+    port: int = 10101,
+    ssl_context=None,
+    backend: Optional[str] = None,
+    **server_opts,
+):
     """Bind the listening socket WITHOUT serving yet: callers that must
     advertise an ephemeral port (server.py Open order: cluster/gossip
     capture the URI before the API exists) learn the real port from
     ``.server_address`` first, then pass the instance to serve().
     ``ssl_context`` serves HTTPS (reference: scheme https when
-    TLS.CertificatePath is set, server/server.go:204-214)."""
+    TLS.CertificatePath is set, server/server.go:204-214).
+
+    ``backend`` picks the serving engine: "async" (default; the
+    net/aserver.py event-loop reactor — docs/serving.md) or "threaded"
+    (the stdlib thread-per-connection oracle).  ``server_opts`` are
+    passed through to AsyncHTTPServer (reactors=, admission=, ...)."""
+    if _resolve_backend(backend) != "threaded":
+        from .aserver import AsyncHTTPServer
+
+        return AsyncHTTPServer(
+            host, port, ssl_context=ssl_context, **server_opts
+        )
     cls = type("_BoundHandler", (_HTTPRequestHandler,), {"handler": None})
     # Serving tier: bursts of concurrent clients (the micro-batcher's
     # whole point) must not get connection-reset by the stdlib default
@@ -1228,20 +1317,41 @@ def serve(
     api: API,
     host: str = "localhost",
     port: int = 10101,
-    srv: Optional[ThreadingHTTPServer] = None,
+    srv=None,
     ssl_context=None,
     allowed_origins=None,
-) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    backend: Optional[str] = None,
+    admission=None,
+    **server_opts,
+) -> Tuple[object, threading.Thread]:
     """Start the HTTP server on a background thread; returns (server,
     thread).  port=0 binds an ephemeral port (test harness pattern,
     test/pilosa.go:38-103).  ``srv`` continues a socket pre-bound with
     bind_http().  ``ssl_context`` serves HTTPS; ``allowed_origins``
-    enables CORS."""
+    enables CORS.  ``backend``/``admission``/``server_opts`` configure
+    the event-loop server (docs/serving.md); the threaded backend
+    ignores them."""
     if srv is None:
-        srv = bind_http(host, port, ssl_context=ssl_context)
-    srv.RequestHandlerClass.handler = Handler(
-        api, allowed_origins=allowed_origins
-    )
+        srv = bind_http(
+            host, port, ssl_context=ssl_context, backend=backend,
+            **server_opts,
+        )
+    handler = Handler(api, allowed_origins=allowed_origins)
+    from .aserver import AsyncHTTPServer
+
+    if isinstance(srv, AsyncHTTPServer):
+        if admission is None and srv.admission is None:
+            from .admission import AdmissionController
+
+            admission = AdmissionController()
+        if admission is not None:
+            srv.admission = admission
+        handler.admission = srv.admission
+        handler.server = srv
+        # api.admission lets the API layer (readiness snapshots, debug
+        # surfaces) see shed state without reaching into the server.
+        api.admission = srv.admission
+    srv.RequestHandlerClass.handler = handler
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
     return srv, thread

@@ -148,9 +148,12 @@ class CountBatcher:
     # costs latency only when the system is already saturated.
     # The window breaks EARLY when arrivals go quiet (depth stable
     # across one poll), so a lone straggler pays ~one poll, not the
-    # whole window.
-    ACCUM_WINDOW = 0.15
-    ACCUM_POLL = 0.005
+    # whole window.  Env-tunable (PILOSA_BATCH_WINDOW / PILOSA_BATCH_POLL,
+    # seconds): the event-loop server feeds the queue from EVERY live
+    # connection (docs/serving.md), and the right window tracks the
+    # deployment's readback RTT, not a constant.
+    ACCUM_WINDOW = float(os.environ.get("PILOSA_BATCH_WINDOW", 0.15))
+    ACCUM_POLL = float(os.environ.get("PILOSA_BATCH_POLL", 0.005))
 
     # Fused batches allowed in flight at once (the pipeline depth): the
     # dispatch worker blocks on the (depth+1)'th batch, so the queue
@@ -180,6 +183,13 @@ class CountBatcher:
         # futures awaiting readback.
         self._dispatch_q: "queue_mod.Queue" = queue_mod.Queue()
         self._collect_q: "queue_mod.Queue" = queue_mod.Queue()
+        # Batches dispatched but not yet collected (heuristic read by
+        # the drain loop's accumulate decision).  Writes are
+        # read-modify-write from the dispatch thread AND every collect
+        # worker, so they take ``_lock``; a lost update would leave the
+        # counter skewed forever.  Reads stay lock-free (stale by at
+        # most one transition — fine for a heuristic).
+        self._live = 0
         # Telemetry the QPS bench and tests assert on.
         self.batches = 0
         self.batched_queries = 0
@@ -307,12 +317,18 @@ class CountBatcher:
                         return
                     self._cond.wait(timeout=60.0)
                 depth0 = len(self._queue)
-            # A lone queued query outside the hot window (an idle
-            # deferred submit) dispatches immediately: the accumulation
-            # window exists to fuse CONCURRENT arrivals, and a lone
-            # caller paying a poll sleep would tax idle latency for
-            # nothing.
-            if depth0 > 1 or (
+            # A lone queued query in an IDLE pipe (no batch in flight,
+            # outside the hot window) dispatches immediately: the
+            # accumulation window exists to fuse CONCURRENT arrivals,
+            # and a lone caller paying a poll sleep would tax idle
+            # latency for nothing.  But when a batch is already in
+            # flight (``_live``), waiting costs this query nothing — it
+            # could not dispatch ahead of the in-flight batch's slot
+            # anyway — and the window lets its peers pile in.  Without
+            # this, sustained load that happened to arrive one-at-a-time
+            # between drain wakeups would never bootstrap the first
+            # fused batch (the hot window only opens AFTER one).
+            if depth0 > 1 or self._live > 0 or (
                 time.monotonic() - self._last_fused < self.HOT_WINDOW
             ):
                 deadline = time.monotonic() + self.ACCUM_WINDOW
@@ -349,6 +365,8 @@ class CountBatcher:
             # pipe — the backpressure that lets the accumulate stage
             # self-tune batch size under overload.
             self._inflight.acquire()
+            with self._lock:
+                self._live += 1
             self.pipeline.add_delta("inflight", 1)
             if not retried:
                 now = time.monotonic()
@@ -380,6 +398,8 @@ class CountBatcher:
             except BaseException as batch_err:  # noqa: BLE001 — the loop
                 # must survive anything; a dead dispatch worker wedges
                 # every later submit at WAIT_TIMEOUT.
+                with self._lock:
+                    self._live -= 1
                 self.pipeline.add_delta("inflight", -1)
                 self._inflight.release()
                 self._handle_batch_failure(index, items, retried, batch_err)
@@ -390,6 +410,11 @@ class CountBatcher:
             self.pipeline.incr("batched_queries", len(items))
             self.pipeline.gauge_max("max_batch_occupancy", len(items))
             if len(items) >= 2:
+                # Cross-request coalescing evidence (bench --conn-sweep
+                # reads these): how many batches actually fused, and how
+                # many answers rode them.
+                self.pipeline.incr("fused_batches")
+                self.pipeline.incr("fused_queries", len(items))
                 self._last_fused = time.monotonic()
             self._collect_q.put((dev, items, time.monotonic()))
 
@@ -473,6 +498,8 @@ class CountBatcher:
                 for it in items:
                     it.error = e
             finally:
+                with self._lock:
+                    self._live -= 1
                 self.pipeline.add_delta("inflight", -1)
                 self._inflight.release()
                 for it in items:

@@ -140,6 +140,7 @@ class Server:
         self._http = bind_http(
             host if host not in ("", "0.0.0.0") else "0.0.0.0", port,
             ssl_context=ssl_ctx,
+            **self._server_opts(),
         )
         port = self._http.server_address[1]
         try:
@@ -214,12 +215,44 @@ class Server:
             self.api,
             srv=self._http,
             allowed_origins=self.config.handler_allowed_origins,
+            admission=self._make_admission(),
         )
         self.logger.printf(
             "pilosa-tpu listening on %s:%d (node %s)", host, port, self.node_id
         )
         self._start_monitors()
         return self
+
+    def _server_opts(self) -> dict:
+        """Serving-tier knobs for bind_http (docs/serving.md): backend
+        selection plus the event-loop server's reactor/pool/parse
+        bounds.  The threaded backend consumes only ``backend``."""
+        cfg = self.config
+        opts = {"backend": cfg.server_backend}
+        if cfg.server_backend != "threaded":
+            opts.update(
+                reactors=cfg.server_reactors,
+                pool_workers=cfg.server_workers,
+                queue_depth=cfg.server_queue_depth,
+                max_body_bytes=cfg.server_max_body_bytes,
+                read_timeout=cfg.server_read_timeout,
+                idle_timeout=cfg.server_idle_timeout,
+            )
+        return opts
+
+    def _make_admission(self):
+        """Admission controller for the event-loop backend; None keeps
+        the threaded oracle admission-free (its thread-per-connection
+        model is the differential baseline)."""
+        if self.config.server_backend == "threaded":
+            return None
+        from .net.admission import AdmissionController, _parse_weights
+
+        return AdmissionController(
+            max_inflight=self.config.server_max_inflight,
+            fair_start=self.config.server_fair_start,
+            weights=_parse_weights(self.config.server_tenant_weights),
+        )
 
     def _make_mesh_engine(self):
         """Fused device query path over the local mesh (parallel package);

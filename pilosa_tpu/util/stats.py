@@ -343,6 +343,27 @@ INGEST_PATHS = ("bits", "values", "roaring")
 
 PIPELINE_STAGES = ("queue_wait", "lower_dispatch", "device_readback", "decode")
 
+# -- serving tier (docs/serving.md) -----------------------------------------
+#   pilosa_admission_inflight               gauge: requests admitted, not done
+#   pilosa_admission_active_tenants         gauge: tenants with in-flight work
+#   pilosa_admission_admitted_total         counter: requests admitted
+#   pilosa_admission_shed_total{reason=}    counter: fast-rejected requests
+#                                           (overload|tenant_fair|queue_full)
+#   pilosa_server_connections               gauge: live HTTP connections
+#   pilosa_server_connections_total         counter: connections accepted
+#   pilosa_server_requests_total{path=}     counter: requests by dispatch path
+#                                           (inline = reactor fast path,
+#                                           pool = blocking worker, shed)
+METRIC_ADMISSION_INFLIGHT = "pilosa_admission_inflight"
+METRIC_ADMISSION_TENANTS = "pilosa_admission_active_tenants"
+METRIC_ADMISSION_ADMITTED = "pilosa_admission_admitted_total"
+METRIC_ADMISSION_SHED = "pilosa_admission_shed_total"
+METRIC_SERVER_CONNECTIONS = "pilosa_server_connections"
+METRIC_SERVER_CONNECTIONS_TOTAL = "pilosa_server_connections_total"
+METRIC_SERVER_REQUESTS = "pilosa_server_requests_total"
+SHED_REASONS = ("overload", "tenant_fair", "queue_full")
+SERVER_REQUEST_PATHS = ("inline", "pool", "shed")
+
 # Engine cache names labelling the hit/miss counter series (engine.py
 # resolves one handle pair per name at construction).
 ENGINE_CACHES = (
@@ -418,7 +439,28 @@ REGISTRY.counter(
     METRIC_INGEST_SYNC_DISPATCHES,
     help="Warm-sync passes the ingest sync worker ran",
 )
-del _stage, _cache, _phase, _path
+REGISTRY.set_gauge(METRIC_ADMISSION_INFLIGHT, 0)
+REGISTRY.set_gauge(METRIC_ADMISSION_TENANTS, 0)
+REGISTRY.set_gauge(METRIC_SERVER_CONNECTIONS, 0)
+REGISTRY.counter(
+    METRIC_ADMISSION_ADMITTED, help="Requests admitted to the engine"
+)
+for _reason in SHED_REASONS:
+    REGISTRY.counter(
+        METRIC_ADMISSION_SHED,
+        help="Requests shed before engine work",
+        reason=_reason,
+    )
+REGISTRY.counter(
+    METRIC_SERVER_CONNECTIONS_TOTAL, help="HTTP connections accepted"
+)
+for _p in SERVER_REQUEST_PATHS:
+    REGISTRY.counter(
+        METRIC_SERVER_REQUESTS,
+        help="HTTP requests by dispatch path",
+        path=_p,
+    )
+del _stage, _cache, _phase, _path, _reason, _p
 
 
 class StatsClient:

@@ -47,10 +47,18 @@ LOWER_BETTER = {"us", "ms", "s", "seconds"}
 HIGHER_BETTER = {"qps", "GB/s", "gbs", "Mbits/s"}
 
 # Headline metrics auto-required whenever the BASELINE carries them: a
-# later PR that silently drops the ingest or north-star line from the
-# bench must fail the guard, not pass by omission (equivalent to always
-# passing ``--require ingest_mbits_s`` once a baseline records it).
-AUTO_REQUIRE = ("count_intersect_1B_cols_p50", "ingest_mbits_s")
+# later PR that silently drops the ingest, serving-QPS, or north-star
+# line from the bench must fail the guard, not pass by omission
+# (equivalent to always passing ``--require ingest_mbits_s`` once a
+# baseline records it).  ``http_count_qps``/``http_mixed_qps`` are the
+# multi-connection serving headlines (docs/serving.md; bench.py
+# --conn-sweep emits the per-connection-count curve around them).
+AUTO_REQUIRE = (
+    "count_intersect_1B_cols_p50",
+    "ingest_mbits_s",
+    "http_count_qps",
+    "http_mixed_qps",
+)
 
 
 def parse_jsonl(text: str) -> dict:

@@ -9,7 +9,7 @@
 #   sh scripts/smoke.sh tests/     # full non-slow suite, same flags
 set -e
 cd "$(dirname "$0")/.."
-TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_observability.py}"
+TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_asyncserver.py tests/test_observability.py}"
 env JAX_PLATFORMS=cpu python -m pytest $TARGETS -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
@@ -23,6 +23,7 @@ import urllib.request
 from pilosa_tpu.api import API
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.net import serve
+from pilosa_tpu.net.admission import AdmissionController
 from pilosa_tpu.parallel import MeshEngine, make_mesh
 
 holder = Holder()
@@ -32,7 +33,13 @@ f = idx.create_field("f")
 f.import_bulk([1, 1, 1], [0, 5, 9])
 eng = MeshEngine(holder, make_mesh(1))
 api = API(holder=holder, mesh_engine=eng)
-srv, _ = serve(api, port=0)
+# The event-loop backend (the default) with an admission controller
+# small enough for the shed drill below to be deterministic.
+srv, _ = serve(
+    api, port=0,
+    admission=AdmissionController(max_inflight=32, fair_start=0.25),
+)
+assert type(srv).__name__ == "AsyncHTTPServer", type(srv)
 port = srv.server_address[1]
 
 req = urllib.request.Request(
@@ -259,6 +266,93 @@ while True:
     )
     time.sleep(0.1)
 
+# Serving-tier smoke (docs/serving.md): drive CONCURRENT queries through
+# the event-loop server, then assert the admission/connection series are
+# live and a weighted-fair shed answers 429 before any engine work.
+import threading
+import urllib.error
+
+results, errors = [], []
+
+def _client():
+    try:
+        for _ in range(4):
+            r = urllib.request.Request(
+                f"http://localhost:{port}/index/smoke/query",
+                data=b"Count(Row(f=1))", method="POST",
+            )
+            results.append(
+                json.loads(urllib.request.urlopen(r, timeout=60).read())["results"][0]
+            )
+    except Exception as e:  # noqa: BLE001
+        errors.append(e)
+
+threads = [threading.Thread(target=_client) for _ in range(6)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(60)
+assert not errors, errors
+assert results and set(results) == {3}, results[:8]
+
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+serving_required = [
+    "pilosa_admission_inflight",
+    "pilosa_admission_active_tenants",
+    "pilosa_admission_admitted_total",
+    "pilosa_admission_shed_total",
+    "pilosa_server_connections",
+    "pilosa_server_connections_total",
+    "pilosa_server_requests_total",
+]
+missing = [s for s in serving_required if s not in text]
+assert not missing, f"/metrics is missing serving series: {missing}"
+for line in text.splitlines():
+    if line.startswith("pilosa_admission_admitted_total"):
+        assert float(line.rsplit(" ", 1)[1]) >= 24, line
+        break
+else:
+    raise AssertionError("no pilosa_admission_admitted_total sample")
+# The scrape's own live connection makes the gauge >= 1 at refresh time.
+for line in text.splitlines():
+    if line.startswith("pilosa_server_connections ") or \
+        line.startswith("pilosa_server_connections{"):
+        assert float(line.rsplit(" ", 1)[1]) >= 1, line
+        break
+else:
+    raise AssertionError("no pilosa_server_connections sample")
+
+# Shed drill: saturate one tenant's weighted-fair share directly on the
+# controller, then a real HTTP request from that tenant must answer 429
+# (tenant_fair) WITHOUT touching the engine.
+adm = api.admission
+for _ in range(32):
+    assert adm.admit("hog") is None
+disp_before = eng.fused_dispatches
+r = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/query",
+    data=b"Count(Row(f=1))", method="POST",
+    headers={"X-Pilosa-Tenant": "hog"},
+)
+try:
+    urllib.request.urlopen(r, timeout=30)
+    raise AssertionError("hog request was not shed")
+except urllib.error.HTTPError as e:
+    assert e.code == 429, e.code
+    doc = json.loads(e.read())
+    assert doc.get("shed") == "tenant_fair", doc
+assert eng.fused_dispatches == disp_before, "shed request reached the engine"
+for _ in range(32):
+    adm.release("hog")
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+assert 'pilosa_admission_shed_total{reason="tenant_fair"} 1' in text, (
+    "shed counter did not record the 429"
+)
+
 srv.shutdown()
-print("observability smoke OK: /metrics + /debug/traces + health/readiness + federation wired")
+print("observability smoke OK: /metrics + /debug/traces + health/readiness + federation + admission wired")
 EOF

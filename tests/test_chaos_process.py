@@ -248,6 +248,9 @@ def test_sigstop_collective_peer_degrades_to_host_path(tmp_path):
     executor answers from the host per-shard path — the query completes
     correctly in bounded time instead of hanging in a collective no
     peer will join."""
+    from capabilities import require_multiprocess_collectives
+
+    require_multiprocess_collectives()
     script = tmp_path / "collective_server.py"
     script.write_text(COLLECTIVE_SERVER)
     coordinator = f"127.0.0.1:{_free_port()}"

@@ -12,12 +12,17 @@ from pilosa_tpu.ops import SHARD_WIDTH
 from pilosa_tpu.roaring import Bitmap
 
 
-@pytest.fixture
-def server():
+@pytest.fixture(params=["async", "threaded"])
+def server(request):
+    """Every route test runs against BOTH serving backends: the
+    event-loop reactor (net/aserver.py, the default) and the threaded
+    oracle it must stay byte-compatible with (docs/serving.md)."""
     api = API()
-    srv, thread = serve(api, port=0)
+    srv, thread = serve(api, port=0, backend=request.param)
     uri = f"http://localhost:{srv.server_address[1]}"
-    yield api, InternalClient(uri)
+    client = InternalClient(uri)
+    yield api, client
+    client.close()
     srv.shutdown()
 
 

@@ -139,6 +139,9 @@ def _env():
 
 
 def test_two_process_fused_count(tmp_path):
+    from capabilities import require_multiprocess_collectives
+
+    require_multiprocess_collectives()
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -196,6 +199,9 @@ def _wait_ready(procs, deadline_s=90):
 
 
 def test_two_server_collective_count_http(tmp_path):
+    from capabilities import require_multiprocess_collectives
+
+    require_multiprocess_collectives()
     script = tmp_path / "server_worker.py"
     script.write_text(SERVER_WORKER)
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -263,6 +269,9 @@ def test_two_server_symmetric_initiation(tmp_path):
     correct."""
     import threading
 
+    from capabilities import require_multiprocess_collectives
+
+    require_multiprocess_collectives()
     script = tmp_path / "server_worker.py"
     script.write_text(SERVER_WORKER)
     coordinator = f"127.0.0.1:{_free_port()}"
