@@ -1512,6 +1512,214 @@ def ingest_sweep():
     )
 
 
+def force_cpu_host_devices(n):
+    """Pin the CPU platform with ``n`` virtual host devices.  Must run
+    BEFORE jax initializes a backend (the __main__ pre-import window);
+    a mismatched ambient ``xla_force_host_platform_device_count`` is
+    REPLACED — a leftover 4-device flag must not silently turn an
+    8-device bench into a 4-device one that still emits the 8-device
+    headline.  Shared by bench --multichip and
+    __graft_entry__.dryrun_multichip (tests/conftest.py keeps its own
+    suite-wide copy)."""
+    import os
+    import re
+
+    opt = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        os.environ["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", opt, flags
+        )
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized: the env change is a no-op
+
+
+# ---- multi-chip shard execution over ICI (--multichip) -------------------
+
+MC_ROWS = 8  # rows 10..17 -> four disjoint intersect pairs per index
+MC_CPU_BASE_SHARDS = 64  # CPU-baseline sample cap (scaled to full S)
+
+
+def multichip_bench(n_devices=None, shards_per_device=None):
+    """Weak-scaling bench of the one-mesh-one-cluster data plane
+    (docs/mesh.md): per device-count d in {1, 2, 4, ..., N} build a
+    d-device shard mesh whose dataset SCALES with the mesh
+    (``shards_per_device`` shards each), and time the fused
+    Count(Intersect) dispatch whose psum over SHARD_AXIS is the whole
+    per-query shard reduce — no HTTP fan-out, no per-shard host loop.
+
+    Emits (JSONL, same stream format as the main bench):
+      mesh_devices / mesh_shards_per_device       mesh shape
+      mesh_psum_us                                the reduce-only cost: a
+                                                  shard_map psum across the
+                                                  full N-device mesh
+      count_intersect_p50_d{d}                    the 1->N scaling curve
+      mesh_weak_scaling_eff                       t_1/t_N (1.0 = perfect:
+                                                  N devices serve N x the
+                                                  shards at flat latency)
+      count_intersect_8B_cols_p50                 THE MULTICHIP HEADLINE:
+                                                  the N-device point; the
+                                                  record carries the true
+                                                  ``cols`` and is flagged
+                                                  ``scaled`` when below the
+                                                  8-device x 960-shard
+                                                  (~8.05B-col) full shape
+
+    On TPU silicon (bench.py --multichip --multichip-platform native)
+    the full shape is 960 shards/device — 8 devices is ~8.05B columns.
+    On this CPU container the lane runs on forced host devices with a
+    reduced shards_per_device so the MULTICHIP_r*.json trajectory still
+    records a real measured headline every round."""
+    import jax
+
+    from pilosa_tpu import pql
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.ops import bitops
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+    from pilosa_tpu.parallel.mesh import SHARD_AXIS, pad_shards, put_global
+
+    avail = len(jax.devices())
+    n = n_devices or avail
+    if n > avail:
+        progress(f"requested {n} devices, only {avail}: trimming")
+        n = avail
+    on_tpu = jax.default_backend() == "tpu"
+    spd = shards_per_device or (960 if on_tpu else 24)
+    full_shape = on_tpu and n >= 8 and spd >= 960
+    progress(
+        f"multichip: {n} devices ({jax.default_backend()}), "
+        f"{spd} shards/device"
+    )
+
+    # One index per device count so each mesh's canonical shard axis is
+    # exactly its own d*spd shards (weak scaling: per-device load flat).
+    curve = []
+    d = 1
+    while d < n:
+        curve.append(d)
+        d *= 2
+    curve.append(n)
+
+    rng = np.random.default_rng(9)
+    holder = Holder()
+    holder.open()
+    host_rows = {}  # CPU-baseline sample: row -> list of word arrays
+    for d in curve:
+        idx = holder.create_index(f"mc_d{d}")
+        f = idx.create_field("f")
+        view = f.view_if_not_exists("standard")
+        for s in range(d * spd):
+            for r in range(10, 10 + MC_ROWS):
+                words = __rand(rng, bitops.WORDS64)
+                view.fragment_if_not_exists(s).load_row_words(r, words)
+                if d == n and r in (10, 11) and s < MC_CPU_BASE_SHARDS:
+                    host_rows.setdefault(r, []).append(words)
+        for frag in view.fragments.values():
+            frag.cache.invalidate()
+    progress("multichip build done")
+
+    # CPU baseline: numpy AND+popcount over a sampled shard prefix,
+    # scaled to the full shard count (the conservative denominator of
+    # the main bench, sampled so the CPU lane stays fast).
+    n_shards_full = n * spd
+    a = np.concatenate(host_rows[10])
+    b = np.concatenate(host_rows[11])
+    sample = min(n_shards_full, MC_CPU_BASE_SHARDS)
+
+    def cpu_ns():
+        return int(np.sum(np.bitwise_count(a & b)))
+
+    cpu_s = cpu_time(cpu_ns) * (n_shards_full / sample)
+
+    results = {}
+    for d in curve:
+        mesh = make_mesh(d)
+        eng = MeshEngine(holder, mesh, max_resident_bytes=12 << 30)
+        # The versioned result memo would serve repeated pairs with zero
+        # device work and turn the 'p50' into memo-lookup time; this
+        # lane measures the DISPATCH, so the memo is disabled (the main
+        # bench's 'every rep a different pair' discipline, with the
+        # pair pool recycled across reps).
+        eng.result_memo.maxsize = 0
+        index = f"mc_d{d}"
+        shards = list(range(d * spd))
+        calls = [
+            pql.parse(f"Intersect(Row(f={10 + 2 * k}), Row(f={11 + 2 * k}))")
+            .calls[0]
+            for k in range(MC_ROWS // 2)
+        ]
+        jax.device_get(eng.count_async(index, calls[0], shards))
+        t_d, _ = device_p50(
+            lambda i: eng.count_async(index, calls[i % len(calls)], shards),
+            reps=12,
+        )
+        results[d] = t_d
+        # The CPU denominator covers the FULL n-device dataset; a
+        # d-device point covers d/n of it, so scale the baseline to the
+        # same shard count or the curve would claim n/d-inflated ratios.
+        cpu_d = cpu_s * (d / n)
+        emit_raw(f"count_intersect_p50_d{d}", t_d * 1e6, "us", cpu_d / t_d)
+        progress(f"  d={d}: {t_d * 1e6:.1f} us over {len(shards)} shards")
+        if d == n:
+            # The reduce alone: a shard_map psum across the full mesh —
+            # the ICI hop that replaced the reference's HTTP broadcast.
+            try:
+                from jax.experimental.shard_map import shard_map
+            except ImportError:  # newer jax
+                from jax.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            padded = pad_shards(len(shards), mesh)
+            part = put_global(
+                mesh, np.ones((padded, 1), np.int32), P(SHARD_AXIS)
+            )
+            psum_fn = jax.jit(
+                shard_map(
+                    lambda x: jax.lax.psum(x.sum(), SHARD_AXIS),
+                    mesh=mesh,
+                    in_specs=P(SHARD_AXIS),
+                    out_specs=P(),
+                )
+            )
+            jax.device_get(psum_fn(part))
+            t_psum, _ = device_p50(lambda i: psum_fn(part), reps=12)
+            emit_raw("mesh_psum_us", t_psum * 1e6, "us", 1.0)
+            emit_raw("mesh_devices", d, "devices", 1.0)
+            emit_raw(
+                "mesh_shards_per_device", padded // d, "shards", 1.0
+            )
+        eng.close()
+
+    t1, tn = results[curve[0]], results[n]
+    # Weak scaling: N devices hold N x the data; perfect ICI scaling
+    # keeps latency flat, so efficiency is t_1/t_N.
+    emit_raw("mesh_weak_scaling_eff", min(t1 / tn, 1.0), "ratio", 1.0)
+    cols = n_shards_full << 20
+    rec = {
+        "metric": "count_intersect_8B_cols_p50",
+        "value": round(results[n] * 1e6, 1),
+        "unit": "us",
+        "vs_baseline": round(cpu_s / results[n], 2),
+        "cols": cols,
+        "n_devices": n,
+    }
+    if not full_shape:
+        rec["scaled"] = True  # below the 8-dev x 960-shard full shape
+    print(json.dumps(rec), flush=True)
+    progress(
+        f"headline: {results[n] * 1e6:.1f} us over {cols / 1e9:.2f}B cols "
+        f"on {n} devices (weak-scaling eff {min(t1 / tn, 1.0):.2f})"
+    )
+    holder.close()
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -1547,6 +1755,35 @@ if __name__ == "__main__":
         "curve (docs/serving.md)",
     )
     ap.add_argument(
+        "--multichip",
+        nargs="?",
+        const=8,
+        default=None,
+        type=int,
+        metavar="N",
+        help="run the multi-chip shard-execution bench ONLY: an N-device "
+        "(default 8) shard mesh with the dataset scaled per device, "
+        "emitting the count_intersect_8B_cols_p50 headline, mesh_psum_us, "
+        "shards-per-device occupancy, and the 1->N weak-scaling curve "
+        "(docs/mesh.md; MULTICHIP_r*.json trajectory)",
+    )
+    ap.add_argument(
+        "--multichip-platform",
+        choices=("cpu", "native"),
+        default="cpu",
+        help="'cpu' (default) forces N virtual host devices via XLA_FLAGS "
+        "before jax loads — the reproducible CI lane; 'native' uses the "
+        "runtime's real devices (a TPU pod slice)",
+    )
+    ap.add_argument(
+        "--multichip-shards-per-device",
+        type=int,
+        default=None,
+        metavar="S",
+        help="shards owned per device (default: 960 on TPU — 8 devices "
+        "is ~8.05B columns — else 24 for the CPU lane)",
+    )
+    ap.add_argument(
         "--scrape",
         action="store_true",
         help="append the post-run /metrics device gauges (resident "
@@ -1555,7 +1792,14 @@ if __name__ == "__main__":
         "JSONL)",
     )
     args = ap.parse_args()
-    if args.ingest_sweep:
+    if args.multichip is not None and args.multichip_platform == "cpu":
+        force_cpu_host_devices(args.multichip)
+    if args.multichip is not None:
+        multichip_bench(
+            args.multichip,
+            shards_per_device=args.multichip_shards_per_device,
+        )
+    elif args.ingest_sweep:
         ingest_sweep()
     elif args.density_sweep:
         density_sweep()

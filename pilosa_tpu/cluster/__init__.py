@@ -7,6 +7,7 @@ from .cluster import (
     Node,
     fnv1a64,
     jump_hash,
+    place_partition,
 )
 
 __all__ = [
@@ -18,4 +19,5 @@ __all__ = [
     "STATE_STARTING",
     "fnv1a64",
     "jump_hash",
+    "place_partition",
 ]

@@ -30,7 +30,7 @@ import os
 import random
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..util import events as events_mod
 
@@ -62,13 +62,24 @@ def jump_hash(key: int, n: int) -> int:
 
 
 class Node:
-    __slots__ = ("id", "uri", "is_coordinator", "state")
+    __slots__ = ("id", "uri", "is_coordinator", "state", "devices")
 
-    def __init__(self, id: str, uri: str, is_coordinator: bool = False):
+    def __init__(
+        self,
+        id: str,
+        uri: str,
+        is_coordinator: bool = False,
+        devices: int = 1,
+    ):
         self.id = id
         self.uri = uri
         self.is_coordinator = is_coordinator
         self.state = "READY"
+        # Placement weight = the node's accelerator count (node = mesh):
+        # an 8-chip host owns 8x the partition slots of a 1-chip host, so
+        # its in-mesh psum reduce covers 8x the shards with zero network
+        # hops (docs/mesh.md).  Advertised via gossip node metadata.
+        self.devices = max(1, int(devices))
 
     def to_dict(self) -> dict:
         return {
@@ -76,16 +87,77 @@ class Node:
             "uri": self.uri,
             "isCoordinator": self.is_coordinator,
             "state": self.state,
+            "devices": self.devices,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "Node":
-        n = cls(d["id"], d["uri"], d.get("isCoordinator", False))
+        n = cls(
+            d["id"], d["uri"], d.get("isCoordinator", False),
+            devices=d.get("devices", 1),
+        )
         n.state = d.get("state", "READY")
         return n
 
+    def clone(self) -> "Node":
+        """Value copy for placement diffs: frag_sources must compute the
+        OLD placement from pre-change weights even after the live Node
+        object is updated in place."""
+        n = Node(self.id, self.uri, self.is_coordinator, self.devices)
+        n.state = self.state
+        return n
+
     def __repr__(self):
-        return f"Node({self.id}@{self.uri})"
+        return f"Node({self.id}@{self.uri}x{self.devices})"
+
+
+def place_partition(
+    nodes: List["Node"], replica_n: int, partition_id: int
+) -> List["Node"]:
+    """Capacity-weighted partition placement: the single source of
+    placement truth, used by live routing (partition_nodes) AND resize
+    diffing (frag_sources) so the two can never diverge.
+
+    Each node contributes ``devices`` slots to a ring ordered by node id;
+    the primary is ``jump_hash(partition, total_slots)`` and replicas are
+    the next DISTINCT nodes around the ring.  With every weight at 1 this
+    degrades exactly to the reference's scheme (jump_hash over the sorted
+    node list, replicas adjacent — cluster.go jmphasher :905,
+    partitionNodes :857), so homogeneous clusters keep byte-identical
+    placement across the upgrade."""
+    slots, n_nodes = build_slot_ring(nodes)
+    return place_on_ring(slots, n_nodes, replica_n, partition_id)
+
+
+def build_slot_ring(nodes: List["Node"]) -> Tuple[List["Node"], int]:
+    """(slot ring, distinct node count): each node repeated ``devices``
+    times in id order.  O(total devices) — hot callers (per-shard
+    routing) cache the ring per membership/weight epoch
+    (Cluster._placement_ring) instead of rebuilding it per shard."""
+    ordered = sorted(nodes, key=lambda n: n.id)
+    slots: List[Node] = []
+    for n in ordered:
+        slots.extend([n] * max(1, getattr(n, "devices", 1)))
+    return slots, len(ordered)
+
+
+def place_on_ring(
+    slots: List["Node"], n_nodes: int, replica_n: int, partition_id: int
+) -> List["Node"]:
+    if not slots:
+        return []
+    start = jump_hash(partition_id, len(slots))
+    out: List[Node] = []
+    seen = set()
+    for i in range(len(slots)):
+        n = slots[(start + i) % len(slots)]
+        if n.id in seen:
+            continue
+        out.append(n)
+        seen.add(n.id)
+        if len(out) >= min(replica_n, n_nodes):
+            break
+    return out
 
 
 RESIZE_JOB_RUNNING = "RUNNING"
@@ -209,6 +281,8 @@ class Cluster:
             client_factory = InternalClient
         self._client_factory = client_factory
         self._clients: Dict[str, object] = {}
+        # (membership key, slot ring, node count): see _placement_ring.
+        self._ring_cache: Optional[tuple] = None
         self.hosts = hosts or []
         self.event_listeners: List[Callable] = []
         # Resize-job bookkeeping (cluster.go jobs/currentJob :188-190).
@@ -234,14 +308,25 @@ class Cluster:
         data = index.encode() + shard.to_bytes(8, "big")
         return fnv1a64(data) % self.partition_n
 
+    def _placement_ring(self) -> Tuple[List[Node], int]:
+        """Cached weighted slot ring (caller holds self._lock).  Keyed
+        on the (id, devices) multiset so direct test mutations of
+        ``nodes``/``devices`` invalidate it too — per-shard routing
+        calls this once per shard per query, and rebuilding the ring
+        (sort + total-devices slot list) there measurably taxed
+        1000-shard fan-outs."""
+        key = tuple(sorted((n.id, n.devices) for n in self.nodes))
+        cached = self._ring_cache
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        slots, n_nodes = build_slot_ring(self.nodes)
+        self._ring_cache = (key, slots, n_nodes)
+        return slots, n_nodes
+
     def partition_nodes(self, partition_id: int) -> List[Node]:
         with self._lock:
-            n = len(self.nodes)
-            if n == 0:
-                return []
-            replica_n = min(self.replica_n, n)
-            start = jump_hash(partition_id, n)
-            return [self.nodes[(start + i) % n] for i in range(replica_n)]
+            slots, n_nodes = self._placement_ring()
+            return place_on_ring(slots, n_nodes, self.replica_n, partition_id)
 
     def shard_nodes(self, index: str, shard: int) -> List[Node]:
         return self.partition_nodes(self.partition(index, shard))
@@ -299,16 +384,32 @@ class Cluster:
                 # a recovery signal: refresh its state and re-run the
                 # state machine, or a restarted coordinator would report
                 # STARTING forever while every peer is healthy.
+                reweigh = existing.devices != node.devices
                 changed = (
                     existing.state != node.state or existing.uri != node.uri
                 )
                 existing.state = node.state
                 existing.uri = node.uri
                 if changed:
-                    self.save_topology()  # a rejoin may carry a NEW address
-                self._determine_state()
-                return
+                    # A rejoin may carry a NEW address: persist it NOW,
+                    # unconditionally — a device-count change rides a
+                    # resize job below and only lands on success, but a
+                    # URI must survive a coordinator restart even if
+                    # that job aborts (or the address routes fragments
+                    # to a dead socket after recovery).
+                    self.save_topology()
+                if not reweigh:
+                    self._determine_state()
+                    return
+                # A device-count change (host re-provisioned with a
+                # different chip count) moves partition slots, so the
+                # placement diff must be walked like a membership
+                # change — below, outside the lock.
             old_nodes = list(self.nodes)
+
+        if existing is not None:
+            self._reweigh_node(existing, node.devices, resize)
+            return
 
         def apply_membership():
             with self._lock:
@@ -392,6 +493,42 @@ class Cluster:
         apply_membership()
         self._determine_state()
         return node
+
+    def _reweigh_node(self, existing: Node, devices: int, resize: bool = True):
+        """A known node re-announced itself with a DIFFERENT device count
+        (host re-provisioned from 1 chip to 8, or vice versa).  Placement
+        weight changes move partition slots exactly like membership
+        changes do, so with data on a coordinator the weight lands only
+        after a resize job has moved the affected fragments — queries
+        keep routing on the old weights while data is in flight."""
+        with self._lock:
+            old_nodes = [n.clone() for n in self.nodes]
+            new_nodes = [n.clone() for n in self.nodes]
+            for n in new_nodes:
+                if n.id == existing.id:
+                    n.devices = max(1, int(devices))
+
+        def apply_membership():
+            with self._lock:
+                existing.devices = max(1, int(devices))
+                self.save_topology()
+            if self.is_coordinator() and self.holder is not None:
+                self.send_sync(self.node_status())
+
+        if (
+            resize
+            and self.is_coordinator()
+            and self.holder is not None
+            and self.holder.has_data()
+        ):
+            self._run_resize(
+                old_nodes, new_nodes, apply_membership,
+                action=("reweigh", (existing.id, devices)),
+            )
+            self._determine_state()
+            return
+        apply_membership()
+        self._determine_state()
 
     def node_failed(self, node_id: str):
         """Failure detector verdict (gossip NotifyLeave): mark and degrade;
@@ -524,12 +661,13 @@ class Cluster:
             return {}
 
         def placement(nodes: List[Node], index: str, shard: int) -> List[Node]:
-            n = len(nodes)
-            if n == 0:
-                return []
-            replica_n = min(self.replica_n, n)
-            start = jump_hash(self.partition(index, shard), n)
-            return [nodes[(start + i) % n] for i in range(replica_n)]
+            # Same capacity-weighted math as live routing (place_partition
+            # is the single source of placement truth) — a resize diff
+            # computed with different math would strand or double-copy
+            # fragments.
+            return place_partition(
+                nodes, self.replica_n, self.partition(index, shard)
+            )
 
         out: Dict[str, List[ResizeSource]] = {n.id: [] for n in new_nodes}
         for index_name, idx in self.holder.indexes.items():
@@ -698,6 +836,10 @@ class Cluster:
                 try:
                     if kind == "join":
                         self.add_node(arg)
+                    elif kind == "reweigh":
+                        node = self.node_by_id(arg[0])
+                        if node is not None:
+                            self._reweigh_node(node, arg[1])
                     else:
                         self.remove_node(arg)
                 except Exception as e:  # noqa: BLE001

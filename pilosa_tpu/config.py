@@ -216,11 +216,18 @@ class Config:
             self.server_idle_timeout = _parse_duration(srv["idle-timeout"])
         mesh = doc.get("mesh", {})
         self.mesh_devices = mesh.get("devices", self.mesh_devices)
-        self.jax_coordinator = mesh.get("jax-coordinator", self.jax_coordinator)
-        self.jax_num_processes = mesh.get(
-            "jax-num-processes", self.jax_num_processes
+        # ``coordinator`` / ``processes`` / ``process-id`` are the
+        # documented [mesh] keys (docs/mesh.md); the jax-* spellings are
+        # kept as accepted aliases for configs written before PR 7.
+        self.jax_coordinator = mesh.get(
+            "coordinator", mesh.get("jax-coordinator", self.jax_coordinator)
         )
-        self.jax_process_id = mesh.get("jax-process-id", self.jax_process_id)
+        self.jax_num_processes = mesh.get(
+            "processes", mesh.get("jax-num-processes", self.jax_num_processes)
+        )
+        self.jax_process_id = mesh.get(
+            "process-id", mesh.get("jax-process-id", self.jax_process_id)
+        )
         self.mesh_peers = mesh.get("peers", self.mesh_peers)
         self.mesh_sequencer = mesh.get("sequencer", self.mesh_sequencer)
         if "dispatch-timeout" in mesh:
@@ -348,9 +355,9 @@ primary-url = "{self.translation_primary_url}"
 
 [mesh]
 devices = {self.mesh_devices}
-jax-coordinator = "{self.jax_coordinator}"
-jax-num-processes = {self.jax_num_processes}
-jax-process-id = {self.jax_process_id}
+coordinator = "{self.jax_coordinator}"
+processes = {self.jax_num_processes}
+process-id = {self.jax_process_id}
 peers = [{", ".join(f'"{u}"' for u in self.mesh_peers)}]
 sequencer = "{self.mesh_sequencer}"
 """

@@ -485,6 +485,13 @@ class Executor:
         from ..parallel.singleflight import SingleFlight
 
         self._sflight = SingleFlight()
+        # Remote fan-out tally: one per peer RPC issued by the mapper.
+        # With capacity-weighted ownership (cluster.place_partition) a
+        # query whose shards are all locally owned must leave this at 0
+        # — the fused mesh dispatch's psum IS the reduce (docs/mesh.md);
+        # tests assert on it alongside the client-level
+        # pilosa_cluster_remote_calls_total counter.
+        self.remote_fanouts = 0
 
     _PARSE_CACHE_MAX = 512
 
@@ -870,6 +877,7 @@ class Executor:
                     result = reduce_fn(result, map_fn(shard))
                 continue
             try:
+                self.remote_fanouts += 1
                 with self.tracer.start_span(
                     "executor.RemoteQuery", node=node_id, shards=len(node_shards)
                 ):

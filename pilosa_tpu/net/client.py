@@ -76,6 +76,16 @@ class InternalClient:
             self._ssl_ctx = ctx
         self._pool: List[http.client.HTTPConnection] = []
         self._pool_lock = threading.Lock()
+        # Per-instance request tally + the process-wide
+        # pilosa_cluster_remote_calls_total counter.  EVERY internal
+        # request counts (query fan-out and control plane alike): on a
+        # single node the counter staying at 0 proves a local query
+        # dialed nothing; in a live cluster the per-query fan-out
+        # signal is executor.remote_fanouts, not this series.
+        self.requests = 0
+        from ..util.stats import METRIC_CLUSTER_REMOTE_CALLS, REGISTRY
+
+        self._requests_counter = REGISTRY.counter(METRIC_CLUSTER_REMOTE_CALLS)
 
     # -- connection pool ---------------------------------------------------
 
@@ -123,6 +133,8 @@ class InternalClient:
         content_type: str = "application/json",
         raw: bool = False,
     ):
+        self.requests += 1
+        self._requests_counter.inc()
         headers = {"Content-Type": content_type} if body is not None else {}
         # Propagate the ambient trace context (trace id + this hop's
         # span id) so a remote shard fan-out joins the caller's trace —

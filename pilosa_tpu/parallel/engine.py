@@ -62,6 +62,10 @@ from ..util.stats import (
     METRIC_ENGINE_REBUILDS,
     METRIC_ENGINE_RESIDENT_BYTES,
     METRIC_INGEST_SYNC_CHUNKS,
+    METRIC_MESH_DEVICES,
+    METRIC_MESH_LOCAL_DEVICES,
+    METRIC_MESH_PSUM_DISPATCHES,
+    METRIC_MESH_SHARDS_PER_DEVICE,
     METRIC_INGEST_SYNC_COALESCED,
     METRIC_INGEST_SYNC_DISPATCHES,
     REGISTRY,
@@ -647,7 +651,21 @@ class MeshEngine:
         self.multiproc = jax.process_count() > 1
         # Count of fused device dispatches (one per kernel invocation;
         # cluster tests assert it advances when the fused path runs).
+        # Exported as pilosa_mesh_psum_dispatches_total: each fused
+        # dispatch's psum over SHARD_AXIS IS the per-query shard reduce
+        # (the ICI replacement for HTTP fan-out — docs/mesh.md).
         self.fused_dispatches = 0
+        self._psum_dispatch_counter = REGISTRY.counter(
+            METRIC_MESH_PSUM_DISPATCHES
+        )
+        # Static mesh shape gauges: total mesh devices and the subset
+        # addressable from THIS process (the node's placement weight).
+        REGISTRY.set_gauge(METRIC_MESH_DEVICES, int(mesh.devices.size))
+        REGISTRY.set_gauge(
+            METRIC_MESH_LOCAL_DEVICES,
+            sum(1 for d in mesh.devices.flat
+                if d.process_index == jax.process_index()),
+        )
         # Residency telemetry: full stack (re)builds vs incremental
         # scatter syncs (tests assert writes do NOT force rebuilds).
         self.stack_rebuilds = 0
@@ -705,6 +723,12 @@ class MeshEngine:
         # True only inside close(): the teardown evict-everything loop
         # must not flood the journal with one event per stack.
         self._closing_down = False
+
+    def _note_fused_dispatch(self):
+        """One fused collective dispatch: the in-mesh psum reduce ran
+        instead of a per-shard host loop / HTTP fan-out."""
+        self.fused_dispatches += 1
+        self._psum_dispatch_counter.inc()
 
     def _cache_hit(self, name: str):
         self.cache_stats[name][0] += 1
@@ -1577,7 +1601,7 @@ class MeshEngine:
         prog = self._lower(index, c, lw)
         mask = self._mask_words(shards, canonical)
         plan = self._sparse_plan(prog, lw, shards, canonical)
-        self.fused_dispatches += 1
+        self._note_fused_dispatch()
         if plan is not None:
             return self._dispatch_sparse(plan, mask)
         return kernels.count_tree(
@@ -1843,7 +1867,7 @@ class MeshEngine:
             prog1 = self._lower(index, u_calls[0], lw1)
             mask1 = self._mask_words(u_shards[0], canonical)
             plan = self._sparse_plan(prog1, lw1, u_shards[0], canonical)
-            self.fused_dispatches += 1
+            self._note_fused_dispatch()
             if plan is not None:
                 dev = self._dispatch_sparse(plan, mask1)
             else:
@@ -1874,7 +1898,7 @@ class MeshEngine:
             i_mask = lw.add_mask(self._mask_words(u_shards[0], canonical))
             progs.append((prog, i_mask))
         lw.finish()
-        self.fused_dispatches += 1
+        self._note_fused_dispatch()
         dev = kernels.count_batch_tree(
             self.mesh, tuple(progs), tuple(lw.specs), *lw.operands
         )
@@ -1916,7 +1940,7 @@ class MeshEngine:
                 lw = _Lowering(self, canonical)
                 prog = self._lower(index, c, lw)
                 mask = self._mask_words(shards, canonical)
-                self.fused_dispatches += 1
+                self._note_fused_dispatch()
                 return kernels.eval_tree_replicated(
                     self.mesh, prog, tuple(lw.specs), mask, *lw.operands
                 )
@@ -1939,7 +1963,7 @@ class MeshEngine:
             lw = _Lowering(self, canonical)
             prog = self._lower(index, c, lw)
             mask = self._mask_words(shards, canonical)
-            self.fused_dispatches += 1
+            self._note_fused_dispatch()
             return kernels.eval_tree(
                 self.mesh, prog, tuple(lw.specs), mask, *lw.operands
             )
@@ -1998,7 +2022,7 @@ class MeshEngine:
         def dispatch():
             lw = _Lowering(self, canonical)
             prog = self._lower_filter(index, filter_call, lw)
-            self.fused_dispatches += 1
+            self._note_fused_dispatch()
             return kernels.sum_tree(
                 self.mesh,
                 prog,
@@ -2066,7 +2090,7 @@ class MeshEngine:
         def dispatch():
             lw = _Lowering(self, canonical)
             prog = self._lower_filter(index, filter_call, lw)
-            self.fused_dispatches += 1
+            self._note_fused_dispatch()
             return kernels.minmax_tree(
                 self.mesh,
                 prog,
@@ -2161,7 +2185,7 @@ class MeshEngine:
         def dispatch():
             lw = _Lowering(self, stack.shards)
             prog = self._lower(index, src_call, lw)
-            self.fused_dispatches += 1
+            self._note_fused_dispatch()
             return kernels.topn_tree(
                 self.mesh,
                 prog,
@@ -2330,7 +2354,7 @@ class MeshEngine:
         def dispatch():
             lw = _Lowering(self, stack.shards)
             prog = self._lower(index, src_call, lw)
-            self.fused_dispatches += 1
+            self._note_fused_dispatch()
             return kernels.topn_full_tree(
                 self.mesh,
                 prog,
@@ -2509,7 +2533,7 @@ class MeshEngine:
         def dispatch():
             lw = _Lowering(self, canonical)
             prog = self._lower_filter(index, filter_call, lw)
-            self.fused_dispatches += 1
+            self._note_fused_dispatch()
             return kernels.groupn_tree(
                 self.mesh,
                 prog,
@@ -2630,6 +2654,17 @@ class MeshEngine:
         REGISTRY.set_gauge(METRIC_ENGINE_RESIDENT_BYTES, resident)
         REGISTRY.set_gauge(METRIC_ENGINE_EVICTED_BYTES, pending)
         REGISTRY.set_gauge(METRIC_ENGINE_COMPILE_KEYS, _compile_cache_keys())
+        n_dev = int(self.mesh.devices.size)
+        REGISTRY.set_gauge(METRIC_MESH_DEVICES, n_dev)
+        with self._stacks_lock:
+            widest = max(
+                (len(shards) for _, shards in self._canonical.values()),
+                default=0,
+            )
+        REGISTRY.set_gauge(
+            METRIC_MESH_SHARDS_PER_DEVICE,
+            pad_shards(widest, self.mesh) // n_dev if widest else 0,
+        )
 
     def cache_snapshot(self) -> dict:
         """Cache/skip telemetry for /debug/vars: per-cache hit/miss

@@ -96,17 +96,30 @@ def pad_shards(n_shards: int, mesh: Mesh) -> int:
 
 
 def shard_owner(shard_index: int, n_shards_padded: int, mesh: Mesh) -> int:
-    """Mesh position owning a (packed) shard index."""
-    per_dev = n_shards_padded // mesh.devices.size
+    """Mesh position owning a (packed) shard index.  ``n_shards_padded``
+    must be a positive multiple of the mesh size (what ``pad_shards``
+    returns) — anything else is a caller bug surfaced loudly, not a
+    ZeroDivisionError deep in a dispatch."""
+    n_dev = int(mesh.devices.size)
+    if n_shards_padded < n_dev or n_shards_padded % n_dev:
+        raise ValueError(
+            f"n_shards_padded={n_shards_padded} is not a positive "
+            f"multiple of the mesh size {n_dev} (use pad_shards)"
+        )
+    per_dev = n_shards_padded // n_dev
     return shard_index // per_dev
 
 
 def stack_sharded(arrays: Sequence[np.ndarray], mesh: Mesh, pad_to: Optional[int] = None):
     """Stack per-shard host arrays into a device array sharded over the
-    mesh axis, zero-padding to the mesh multiple."""
+    mesh axis, zero-padding to the mesh multiple.  An empty shard list
+    has no element shape/dtype to build from and is rejected explicitly
+    (callers short-circuit empty queries before placement)."""
     import jax.numpy as jnp
 
     n = len(arrays)
+    if n == 0:
+        raise ValueError("stack_sharded: empty shard list")
     padded = pad_to if pad_to is not None else pad_shards(n, mesh)
     base = np.asarray(arrays[0])
     out = np.zeros((padded,) + base.shape, dtype=base.dtype)

@@ -254,6 +254,30 @@ class Server:
             weights=_parse_weights(self.config.server_tenant_weights),
         )
 
+    def _node_devices(self) -> int:
+        """This node's placement weight: the device count of the LOCAL
+        (addressable) slice of the shard mesh.  Advertised via gossip
+        node metadata so capacity-weighted shard ownership
+        (cluster.place_partition) gives an 8-chip host 8x the shards of
+        a 1-chip host — its in-mesh psum then covers them with zero
+        extra network hops (docs/mesh.md).  1 when the mesh is disabled
+        or devices are unreachable (the per-shard host path still
+        works, so the node still takes a single-device share)."""
+        if self.config.mesh_devices < 0:
+            return 1
+        try:
+            import jax
+
+            n = jax.local_device_count()
+            if self.config.mesh_devices and jax.process_count() == 1:
+                # A single-process mesh trimmed by [mesh] devices owns
+                # only the trimmed slice.
+                n = min(n, self.config.mesh_devices)
+            return max(1, int(n))
+        except Exception as e:  # noqa: BLE001 — no devices is a 1-weight
+            self.logger.printf("device probe failed (weight=1): %s", e)
+            return 1
+
     def _make_mesh_engine(self):
         """Fused device query path over the local mesh (parallel package);
         None when no usable devices (the per-shard path still works).
@@ -268,7 +292,15 @@ class Server:
         try:
             from .parallel import MeshEngine, make_mesh
 
-            mesh = make_mesh(self.config.mesh_devices or None)
+            if self.config.jax_coordinator:
+                # jax.distributed is up (see _open_bound): the mesh spans
+                # every host's devices; collectives ride ICI/DCN while
+                # the cluster control plane stays per-host HTTP/gossip.
+                from .parallel import multihost
+
+                mesh = multihost.global_mesh(self.config.mesh_devices or None)
+            else:
+                mesh = make_mesh(self.config.mesh_devices or None)
             engine = MeshEngine(
                 self.holder, mesh, logger=self.logger, journal=self.journal
             )
@@ -283,6 +315,16 @@ class Server:
             return engine
         except Exception as e:
             self.logger.printf("mesh engine unavailable: %s", e)
+            # The gossip weight advertised in _setup_cluster assumed a
+            # live mesh; without one this node serves via the per-shard
+            # host loop and must take a single-device share — an 8x
+            # weight on the slowest member would skew the whole cluster
+            # onto it.  Peers that saw the optimistic weight reweigh via
+            # the gossip meta update (push-pull carries it).
+            if self.cluster is not None and self.cluster.node.devices != 1:
+                self.cluster.node.devices = 1
+                if getattr(self, "gossip", None) is not None:
+                    self.gossip.meta["devices"] = 1
             return None
 
     def _make_ticket_fn(self):
@@ -383,7 +425,10 @@ class Server:
 
         uri = _advertise_uri(host, port, self.scheme)
         self.cluster = Cluster(
-            node=Node(self.node_id, uri, self.config.cluster_coordinator),
+            node=Node(
+                self.node_id, uri, self.config.cluster_coordinator,
+                devices=self._node_devices(),
+            ),
             replica_n=self.config.cluster_replicas,
             hosts=self.config.cluster_hosts,
             path=self.data_dir,
@@ -435,6 +480,7 @@ class Server:
                                 member.id,
                                 member.meta.get("uri"),
                                 member.meta.get("coordinator", False),
+                                devices=member.meta.get("devices", 1),
                             )
                         )
                     else:
@@ -469,7 +515,13 @@ class Server:
 
         self.gossip = GossipNode(
             self.node_id,
-            meta={"uri": uri, "coordinator": self.config.cluster_coordinator},
+            meta={
+                "uri": uri,
+                "coordinator": self.config.cluster_coordinator,
+                # Placement weight: capacity-weighted shard ownership
+                # reads this from every member's metadata.
+                "devices": self.cluster.node.devices,
+            },
             port=self.config.gossip_port,
             probe_interval=self.config.gossip_probe_interval,
             probe_timeout=self.config.gossip_probe_timeout,

@@ -354,6 +354,30 @@ PIPELINE_STAGES = ("queue_wait", "lower_dispatch", "device_readback", "decode")
 #   pilosa_server_requests_total{path=}     counter: requests by dispatch path
 #                                           (inline = reactor fast path,
 #                                           pool = blocking worker, shed)
+# -- mesh data plane (docs/mesh.md) -----------------------------------------
+#   pilosa_mesh_devices                     gauge: devices in the shard mesh
+#   pilosa_mesh_local_devices               gauge: devices addressable from
+#                                           THIS process (the node's
+#                                           placement weight)
+#   pilosa_mesh_shards_per_device           gauge: padded shard-axis
+#                                           occupancy per device (max over
+#                                           resident indexes)
+#   pilosa_mesh_psum_dispatches_total       counter: fused collective
+#                                           dispatches (the psum-IS-the-
+#                                           reduce path)
+#   pilosa_cluster_remote_calls_total       counter: internal-client HTTP
+#                                           requests (query fan-out AND
+#                                           cluster control plane: schema/
+#                                           status/federation/resize).  On
+#                                           a single node it stays 0; the
+#                                           per-query fan-out signal is
+#                                           executor.remote_fanouts
+METRIC_MESH_DEVICES = "pilosa_mesh_devices"
+METRIC_MESH_LOCAL_DEVICES = "pilosa_mesh_local_devices"
+METRIC_MESH_SHARDS_PER_DEVICE = "pilosa_mesh_shards_per_device"
+METRIC_MESH_PSUM_DISPATCHES = "pilosa_mesh_psum_dispatches_total"
+METRIC_CLUSTER_REMOTE_CALLS = "pilosa_cluster_remote_calls_total"
+
 METRIC_ADMISSION_INFLIGHT = "pilosa_admission_inflight"
 METRIC_ADMISSION_TENANTS = "pilosa_admission_active_tenants"
 METRIC_ADMISSION_ADMITTED = "pilosa_admission_admitted_total"
@@ -438,6 +462,17 @@ REGISTRY.counter(
 REGISTRY.counter(
     METRIC_INGEST_SYNC_DISPATCHES,
     help="Warm-sync passes the ingest sync worker ran",
+)
+REGISTRY.set_gauge(METRIC_MESH_DEVICES, 0)
+REGISTRY.set_gauge(METRIC_MESH_LOCAL_DEVICES, 0)
+REGISTRY.set_gauge(METRIC_MESH_SHARDS_PER_DEVICE, 0)
+REGISTRY.counter(
+    METRIC_MESH_PSUM_DISPATCHES,
+    help="Fused mesh collective dispatches (psum over the shard axis)",
+)
+REGISTRY.counter(
+    METRIC_CLUSTER_REMOTE_CALLS,
+    help="Internal-client HTTP requests (query fan-out + control plane)",
 )
 REGISTRY.set_gauge(METRIC_ADMISSION_INFLIGHT, 0)
 REGISTRY.set_gauge(METRIC_ADMISSION_TENANTS, 0)

@@ -58,6 +58,10 @@ AUTO_REQUIRE = (
     "ingest_mbits_s",
     "http_count_qps",
     "http_mixed_qps",
+    # The multichip headline (bench.py --multichip; MULTICHIP_r*.json):
+    # required as soon as a baseline records it, so a later round cannot
+    # silently drop the multi-device lane.
+    "count_intersect_8B_cols_p50",
 )
 
 
@@ -170,6 +174,19 @@ def check(current: dict, baseline: dict, tolerance: float,
     for name in require:
         if name not in current:
             failures.append(f"{name}: required metric missing from the new run")
+    # The multichip headline carries its shape (cols, n_devices): a
+    # round that shrinks either would read as a spurious speedup under
+    # the latency-only diff, so a shrink is itself a regression.
+    head = "count_intersect_8B_cols_p50"
+    base_h, cur_h = baseline.get(head), current.get(head)
+    if base_h and cur_h:
+        for fld in ("cols", "n_devices"):
+            bv, cv = base_h.get(fld), cur_h.get(fld)
+            if bv and cv and cv < bv:
+                failures.append(
+                    f"{head}: {fld} shrank to {cv} (baseline {bv}) — a "
+                    "smaller shape must not pass as a latency win"
+                )
     return failures, notes, checked
 
 

@@ -70,10 +70,43 @@ required = [
     "pilosa_engine_compile_total",
     "pilosa_engine_compile_seconds",
     "pilosa_engine_compile_cache_keys",
+    # One mesh, one cluster (docs/mesh.md): mesh shape + the psum
+    # dispatch counter (each fused dispatch's psum IS the shard reduce).
+    "pilosa_mesh_devices",
+    "pilosa_mesh_local_devices",
+    "pilosa_mesh_shards_per_device",
+    "pilosa_mesh_psum_dispatches_total",
+    "pilosa_cluster_remote_calls_total",
 ]
 missing = [s for s in required if s not in text]
 assert not missing, f"/metrics is missing required series: {missing}"
 assert 'le="+Inf"' in text, "histogram export lacks the +Inf bucket"
+
+# Mesh smoke: an Intersect tree cannot take the O(1) cardinality lane,
+# so it must run as a fused mesh dispatch — the psum counter moves and
+# the device/occupancy gauges carry the mesh shape; a single-node query
+# must never have dialed the internal client.
+req = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/query",
+    data=b"Count(Intersect(Row(f=1), Row(f=1)))",
+    method="POST",
+)
+doc = json.loads(urllib.request.urlopen(req, timeout=60).read())
+assert doc["results"][0] == 3, doc
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+mesh_samples = {}
+for line in text.splitlines():
+    if line.startswith("pilosa_mesh_") or line.startswith("pilosa_cluster_"):
+        name, _, value = line.rpartition(" ")
+        mesh_samples[name] = float(value)
+assert mesh_samples.get("pilosa_mesh_devices", 0) >= 1, mesh_samples
+assert mesh_samples.get("pilosa_mesh_local_devices", 0) >= 1, mesh_samples
+assert mesh_samples.get("pilosa_mesh_shards_per_device", 0) >= 1, mesh_samples
+assert mesh_samples.get("pilosa_mesh_psum_dispatches_total", 0) > 0, mesh_samples
+assert mesh_samples.get("pilosa_cluster_remote_calls_total", -1) == 0, (
+    "single-node query fanned out over HTTP", mesh_samples)
 
 # Result-memo smoke: a REPEATED fused Count must be served from the
 # versioned result memo — the hit counter increments and the engine

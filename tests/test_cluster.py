@@ -619,3 +619,259 @@ def test_remove_node_aborted_job_raises(tmp_path, monkeypatch):
         assert h[0].cluster.node_by_id("node1") is not None
     finally:
         h.close()
+
+
+# -- capacity-weighted placement (node = mesh, docs/mesh.md) ----------------
+
+
+def test_weighted_placement_shares():
+    """An 8-device host owns ~8x the partitions of 1-device hosts, every
+    partition keeps exactly replica_n DISTINCT owners, and equal weights
+    degrade to the legacy jump-hash scheme byte-for-byte."""
+    from pilosa_tpu.cluster import place_partition
+
+    nodes = [Node(f"n{i}", f"http://h{i}") for i in range(4)]
+    c = Cluster(node=nodes[0], replica_n=2)
+    c.nodes = sorted(nodes, key=lambda n: n.id)
+
+    # Equal weights: byte-identical to the legacy scheme.
+    for pid in range(256):
+        start = jump_hash(pid, 4)
+        legacy = [c.nodes[(start + i) % 4].id for i in range(2)]
+        assert [n.id for n in c.partition_nodes(pid)] == legacy
+
+    # n0 re-provisioned with 8 chips: ~8/11 of primaries, all sets valid.
+    nodes[0].devices = 8
+    primaries = {}
+    for pid in range(256):
+        owners = c.partition_nodes(pid)
+        assert len(owners) == 2
+        assert len({n.id for n in owners}) == 2
+        primaries[owners[0].id] = primaries.get(owners[0].id, 0) + 1
+    share = primaries["n0"] / 256
+    assert 0.55 < share < 0.9, primaries  # expected ~8/11 = 0.727
+    for nid in ("n1", "n2", "n3"):
+        assert primaries.get(nid, 0) > 0  # small nodes still own some
+
+
+def test_weighted_no_orphan_no_double_own_across_resize():
+    """Join/leave of nodes with heterogeneous device counts: at every
+    membership step each shard has exactly min(replica_n, n) distinct
+    owners (nothing orphaned, nothing double-assigned), and the
+    frag_sources diff targets exactly the owners that GAINED a shard."""
+    from pilosa_tpu.cluster import place_partition
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    n_shards = 48
+    for s in range(n_shards):
+        f.view_if_not_exists("standard").fragment_if_not_exists(s).set_bit(
+            1, s * SHARD_WIDTH + 3
+        )
+
+    a = Node("a", "http://a", devices=1)
+    b = Node("b", "http://b", devices=8)
+    cnew = Node("c", "http://c", devices=4)
+    c = Cluster(node=a, replica_n=2)
+    c.holder = h
+
+    def check_assignment(nodes):
+        owned = {}
+        for s in range(n_shards):
+            owners = place_partition(nodes, c.replica_n, c.partition("i", s))
+            ids = [n.id for n in owners]
+            assert len(ids) == min(2, len(nodes)), (s, ids)
+            assert len(set(ids)) == len(ids), (s, ids)  # no double-own
+            for nid in ids:
+                owned.setdefault(nid, set()).add(s)
+        covered = set()
+        for shard_set in owned.values():
+            covered |= shard_set
+        assert covered == set(range(n_shards))  # no orphan
+        return owned
+
+    steps = [
+        [a, b],          # heterogeneous pair
+        [a, b, cnew],    # 4-device joiner
+        [b, cnew],       # 1-device node leaves
+        [b],             # down to the big node alone
+    ]
+    prev = None
+    for nodes in steps:
+        c.nodes = sorted([n.clone() for n in nodes], key=lambda n: n.id)
+        owned = check_assignment(c.nodes)
+        if prev is not None:
+            old_nodes, new_nodes = prev, c.nodes
+            sources = c.frag_sources(old_nodes, new_nodes)
+            new_ids = {n.id for n in new_nodes}
+            for nid, srcs in sources.items():
+                assert nid in new_ids
+                for src in srcs:
+                    # The target actually owns the shard under the NEW
+                    # placement and didn't under the OLD one.
+                    new_owner_ids = {
+                        n.id
+                        for n in place_partition(
+                            new_nodes, c.replica_n, c.partition("i", src.shard)
+                        )
+                    }
+                    old_owner_ids = {
+                        n.id
+                        for n in place_partition(
+                            old_nodes, c.replica_n, c.partition("i", src.shard)
+                        )
+                    }
+                    assert nid in new_owner_ids
+                    assert nid not in old_owner_ids
+                    assert src.node.id in old_owner_ids  # real source
+        prev = c.nodes
+
+    # The 8-device node ends up with the full set when alone; in the
+    # heterogeneous pair it owns the supermajority of primaries.
+    c.nodes = sorted([a.clone(), b.clone()], key=lambda n: n.id)
+    prim = {"a": 0, "b": 0}
+    for s in range(n_shards):
+        prim[
+            place_partition(c.nodes, 1, c.partition("i", s))[0].id
+        ] += 1
+    assert prim["b"] > prim["a"] * 3, prim  # ~8x in expectation
+
+
+def test_node_devices_persist_in_topology(tmp_path):
+    """Weights survive .topology round-trips and Node dict round-trips."""
+    n = Node("n0", "http://h0", devices=8)
+    assert Node.from_dict(n.to_dict()).devices == 8
+    c = Cluster(node=n, path=str(tmp_path))
+    c.nodes = [n, Node("n1", "http://h1", devices=4)]
+    c.save_topology()
+    c2 = Cluster(node=Node("n0", "http://h0", devices=8), path=str(tmp_path))
+    assert {m.id: m.devices for m in c2.nodes} == {"n0": 8, "n1": 4}
+
+
+def _poll_count(client, index, query, want, timeout=15.0):
+    """Assert the count converges to ``want``: a resize's create-shard /
+    node-status propagation between loopback servers is eventually
+    consistent across handler threads, so a read fired the instant the
+    coordinator returns may catch a sub-second availability window.
+    The final assert keeps real undercounts fatal."""
+    import time as _time
+
+    deadline = _time.time() + timeout
+    out = None
+    while _time.time() < deadline:
+        out = client.query(index, query)
+        if out["results"] == [want]:
+            return
+        _time.sleep(0.25)
+    assert out is not None and out["results"] == [want], out
+
+
+def test_heterogeneous_resize_on_join(tmp_path):
+    """A 6-device node joining a 2x1-device cluster takes the
+    supermajority of shards through a real resize over HTTP, with no
+    bit lost from any node's view."""
+    h = run_cluster(tmp_path, 2)
+    try:
+        client = h.client(0)
+        client.create_index("i")
+        client.create_field("i", "f")
+        n_shards = 8
+        cols = [s * SHARD_WIDTH + 1 for s in range(n_shards)]
+        client.import_bits("i", "f", 0, [10] * len(cols), cols)
+
+        from pilosa_tpu.cluster import Cluster, Node
+        from pilosa_tpu.config import Config
+        from pilosa_tpu.server import Server
+
+        cfg = Config()
+        cfg.data_dir = str(tmp_path / "node2")
+        cfg.bind = "localhost:0"
+        srv = Server(cfg)
+        srv.node_id = "node2"
+        srv.open(port_override=0)
+        new_node = Node(
+            "node2", f"http://localhost:{srv.port}", devices=6
+        )
+        cluster = Cluster(node=new_node, replica_n=1, path=srv.data_dir)
+        cluster.holder = srv.holder
+        cluster.state = "NORMAL"
+        srv.cluster = cluster
+        srv.api.attach_cluster(cluster, new_node)
+        h.servers.append(srv)
+
+        h.client(2).send_message(
+            {"type": "create-index", "index": "i", "meta": {}}
+        )
+        h.client(2).send_message(
+            {
+                "type": "create-field",
+                "index": "i",
+                "field": "f",
+                "meta": {"type": "set"},
+            }
+        )
+        cluster.nodes = sorted(
+            h[0].cluster.nodes + [new_node], key=lambda n: n.id
+        )
+        h[0].cluster.add_node(new_node)  # coordinator resize, weighted
+        h[1].cluster.add_node(new_node, resize=False)
+
+        for i in range(3):
+            _poll_count(h.client(i), "i", "Count(Row(f=10))", len(cols))
+        # The 6-device joiner owns the supermajority (6/8 expected).
+        owned2 = [
+            s
+            for s in range(n_shards)
+            if h[0].cluster.owns_shard("node2", "i", s)
+        ]
+        assert len(owned2) >= n_shards // 2, owned2
+        view = srv.holder.index("i").field("f").view("standard")
+        assert view is not None
+        assert set(view.fragments) >= set(owned2)
+    finally:
+        h.close()
+
+
+def test_reweigh_on_rejoin_triggers_resize(tmp_path):
+    """A known member re-announcing itself with a different device count
+    (host re-provisioned 1 -> 8 chips) moves shards through a resize job
+    — weights land only after fragments moved, queries stay exact, and
+    nothing is orphaned."""
+    h = run_cluster(tmp_path, 2)
+    try:
+        client = h.client(0)
+        client.create_index("i")
+        client.create_field("i", "f")
+        n_shards = 8
+        cols = [s * SHARD_WIDTH + 1 for s in range(n_shards)]
+        client.import_bits("i", "f", 0, [10] * len(cols), cols)
+
+        node1_uri = h[0].cluster.node_by_id("node1").uri
+        jobs_before = len(h[0].cluster.jobs)
+        h[0].cluster.add_node(Node("node1", node1_uri, devices=8))
+        h[1].cluster.add_node(
+            Node("node1", node1_uri, devices=8), resize=False
+        )
+
+        assert h[0].cluster.node_by_id("node1").devices == 8
+        assert h[1].cluster.node_by_id("node1").devices == 8
+        assert len(h[0].cluster.jobs) > jobs_before  # a real resize ran
+        assert h[0].cluster.state == "NORMAL"
+
+        for i in range(2):
+            _poll_count(h.client(i), "i", "Count(Row(f=10))", len(cols))
+        owned1 = [
+            s
+            for s in range(n_shards)
+            if h[0].cluster.owns_shard("node1", "i", s)
+        ]
+        assert len(owned1) > n_shards // 2, owned1  # ~8/9 expected
+        # Same-weight re-announce is a no-op (no new job).
+        jobs_now = len(h[0].cluster.jobs)
+        h[0].cluster.add_node(Node("node1", node1_uri, devices=8))
+        assert len(h[0].cluster.jobs) == jobs_now
+    finally:
+        h.close()

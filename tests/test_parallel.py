@@ -127,6 +127,62 @@ def test_pad_shards(mesh):
     assert pad_shards(9, mesh) == 16
 
 
+def test_pad_shards_edges(mesh):
+    """Zero shards still pads to one full mesh round; a mesh of one pads
+    to the identity."""
+    assert pad_shards(0, mesh) == 8
+    m1 = make_mesh(1)
+    for n in (0, 1, 2, 7):
+        assert pad_shards(n, m1) == max(n, 1)
+
+
+def test_shard_owner_differential(mesh):
+    """shard_owner vs a direct python owner map (contiguous blocks of
+    padded/n_dev per device), for shard counts NOT divisible by the mesh
+    size and for a mesh of 1."""
+    from pilosa_tpu.parallel.mesh import shard_owner
+
+    for m in (mesh, make_mesh(1)):
+        n_dev = int(m.devices.size)
+        for n_shards in (1, 2, 7, 8, 9, 13, 16, 100):
+            padded = pad_shards(n_shards, m)
+            per_dev = padded // n_dev
+            want = {p: p // per_dev for p in range(padded)}
+            got = {p: shard_owner(p, padded, m) for p in range(padded)}
+            assert got == want, (n_dev, n_shards)
+            assert set(got.values()) <= set(range(n_dev))
+
+
+def test_shard_owner_rejects_bad_padding(mesh):
+    from pilosa_tpu.parallel.mesh import shard_owner
+
+    with pytest.raises(ValueError):
+        shard_owner(0, 0, mesh)  # would divide by zero
+    with pytest.raises(ValueError):
+        shard_owner(0, 9, mesh)  # not a multiple of the mesh size
+
+
+def test_stack_sharded_edges(mesh):
+    """Non-divisible shard counts zero-pad; a mesh of 1 round-trips; an
+    empty shard list is a loud ValueError, not an IndexError."""
+    from pilosa_tpu.parallel.mesh import stack_sharded
+
+    arrays = [np.full(4, i + 1, dtype=np.uint32) for i in range(3)]
+    out = np.asarray(stack_sharded(arrays, mesh))
+    assert out.shape == (8, 4)
+    for i in range(3):
+        assert (out[i] == i + 1).all()
+    assert (out[3:] == 0).all()  # padding shards are zero
+
+    m1 = make_mesh(1)
+    out1 = np.asarray(stack_sharded(arrays, m1))
+    assert out1.shape == (3, 4)
+    assert (out1 == np.stack(arrays)).all()
+
+    with pytest.raises(ValueError, match="empty shard list"):
+        stack_sharded([], mesh)
+
+
 def test_mesh_uneven_shards(holder, mesh):
     """Shard count not a multiple of mesh size: padding shards are zero."""
     idx = holder.create_index("i")
