@@ -1364,6 +1364,40 @@ def _field_import_rowloop(field, row_ids, column_ids):
     return changed
 
 
+def _id_pairs_headline(rng, idx, col_span=8 << 20):
+    """The guarded id-pairs headline, shared by --ingest-sweep and
+    --streaming-sweep so the measurement protocol can never diverge
+    between the two while bench_guard compares both against one
+    baseline: field.import_bulk (native shard split + native sparse
+    merge + concurrent fragments) vs the pre-PR put()-loop + row walk.
+    Each path gets its NATURAL input form — arrays for the vectorized
+    path (the documented surface since the no-list-round-trip change),
+    lists for the per-bit rowloop (it iterates python; feeding it numpy
+    scalars would unfairly slow the baseline).  Conversions happen
+    outside both timers."""
+    fa, fb = idx.create_field("fa"), idx.create_field("fb")
+    tn = to = bits = 0
+    for _ in range(ING_CHUNKS):
+        rows = rng.integers(0, 2048, 1 << 20)
+        cols = rng.integers(0, col_span, 1 << 20)
+        rows_l, cols_l = rows.tolist(), cols.tolist()
+        bits += rows.size
+        t0 = time.perf_counter()
+        ca = fa.import_bulk(rows, cols)
+        tn += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        cb = _field_import_rowloop(fb, rows_l, cols_l)
+        to += time.perf_counter() - t0
+        assert ca == cb
+    mb_new, mb_old = bits / tn / 1e6, bits / to / 1e6
+    emit_raw("ingest_bits_mbits_s", mb_new, "Mbits/s", mb_new / mb_old)
+    emit_raw("ingest_bits_rowloop_mbits_s", mb_old, "Mbits/s", 1.0)
+    progress(
+        f"id-pairs: {mb_new:.1f} vs rowloop {mb_old:.2f} Mbits/s "
+        f"({mb_new / mb_old:.1f}x)"
+    )
+
+
 def ingest_sweep():
     """Sustained bulk-import throughput, new vectorized paths vs the
     retained pre-PR per-row implementations on the SAME machine and
@@ -1434,33 +1468,11 @@ def ingest_sweep():
     )
     progress(f"decode: np {t_np * 1e3:.0f}ms vs py {t_py * 1e3:.0f}ms")
 
-    # ---- id-pairs surface: field.import_bulk (vectorized shard split +
-    # concurrent fragments) vs the pre-PR put()-loop + row walk ------------
+    # ---- id-pairs surface old-vs-new (shared with --streaming-sweep) -----
     holder = Holder()
     holder.open()
     idx = holder.create_index("ing")
-    N_SHARDS_ING = 8
-    fa, fb = idx.create_field("fa"), idx.create_field("fb")
-    tn = to = bits = 0
-    for _ in range(ING_CHUNKS):
-        rows = rng.integers(0, 2048, 1 << 20)
-        cols = rng.integers(0, N_SHARDS_ING << 20, 1 << 20)
-        rows_l, cols_l = rows.tolist(), cols.tolist()
-        bits += len(rows_l)
-        t0 = time.perf_counter()
-        ca = fa.import_bulk(rows_l, cols_l)
-        tn += time.perf_counter() - t0
-        t0 = time.perf_counter()
-        cb = _field_import_rowloop(fb, rows_l, cols_l)
-        to += time.perf_counter() - t0
-        assert ca == cb
-    mb_new, mb_old = bits / tn / 1e6, bits / to / 1e6
-    emit_raw("ingest_bits_mbits_s", mb_new, "Mbits/s", mb_new / mb_old)
-    emit_raw("ingest_bits_rowloop_mbits_s", mb_old, "Mbits/s", 1.0)
-    progress(
-        f"id-pairs: {mb_new:.1f} vs rowloop {mb_old:.2f} Mbits/s "
-        f"({mb_new / mb_old:.1f}x)"
-    )
+    _id_pairs_headline(rng, idx)
 
     # ---- pipelined write -> query freshness through a live engine --------
     mesh = make_mesh(len(jax.devices()))
@@ -1502,7 +1514,11 @@ def ingest_sweep():
     syncer.flush()
     assert eng.stack_rebuilds == rebuilds0, "ingest sync forced a rebuild"
     fresh_p50 = statistics.median(lat)
-    emit_raw("ingest_freshness_p50_ms", fresh_p50 * 1e3, "ms", 1.0)
+    # "idle" = no concurrent query load: the guarded under-load headline
+    # ingest_freshness_p50_ms belongs to --streaming-sweep alone — both
+    # sweeps into one capture must not overwrite it (last-line-wins in
+    # bench_guard would make the guarded value run-order dependent).
+    emit_raw("ingest_freshness_idle_p50_ms", fresh_p50 * 1e3, "ms", 1.0)
     snap = syncer.snapshot()
     emit_raw("ingest_sync_chunks", snap["chunks"], "chunks", 1.0)
     emit_raw("ingest_sync_coalesced", snap["coalesced"], "chunks", 1.0)
@@ -1510,6 +1526,155 @@ def ingest_sweep():
         f"freshness p50 {fresh_p50 * 1e3:.1f}ms; sync {snap['syncs']} passes "
         f"over {snap['chunks']} chunks ({snap['coalesced']} coalesced)"
     )
+
+
+# ---- streaming: sustained concurrent write+read (--streaming-sweep) ------
+
+STREAM_SHARDS = 4
+STREAM_ROWS = 64
+STREAM_BATCH_BITS = 1 << 17  # bits per import batch under load
+STREAM_BATCHES = 16
+STREAM_IDLE_QUERY_REPS = 40
+STREAM_QUERY_PACE_S = 0.005  # ~200 QPS read load: an unthrottled
+#                              closed loop of sub-ms memo-hit queries
+#                              measures GIL spin, not serving behavior
+
+
+def streaming_sweep():
+    """Guarded streaming headline (docs/ingest.md): continuous id-pairs
+    imports through a LIVE engine while a query load runs on another
+    thread.  Emits, from the same run:
+
+    - ``ingest_bits_mbits_s`` — the id-pairs surface old-vs-new (same
+      protocol as --ingest-sweep: arrays to the vectorized path, lists
+      to the retained rowloop oracle, conversions untimed);
+    - ``ingest_streaming_mbits_s`` — sustained import throughput WHILE
+      the query load runs;
+    - ``ingest_freshness_p50_ms`` — write->readable latency under load
+      (import ack + a count that reflects the write);
+    - ``query_p50_under_ingest_ms`` vs ``query_p50_idle_ms`` — read
+      latency with and without the concurrent write stream.
+
+    bench_guard AUTO-REQUIREs the ingest/freshness headlines once a
+    baseline records them."""
+    import threading
+
+    progress("importing jax (streaming sweep)")
+    import jax
+
+    from pilosa_tpu import pql
+    from pilosa_tpu.api import API, ImportRequest
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+    rng = np.random.default_rng(29)
+
+    # -- phase A: the id-pairs old-vs-new headline (oracle in-run) ---------
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("stream")
+    _id_pairs_headline(rng, idx)
+
+    # -- phase B: concurrent write+read through a live engine --------------
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh)
+    api = API(holder=holder, mesh_engine=eng)
+    fq = idx.create_field("q")
+    seed_rows, seed_cols = [], []
+    for s in range(STREAM_SHARDS):
+        for r in range(STREAM_ROWS):
+            seed_rows.append(r)
+            seed_cols.append((s << 20) + r)
+    fq.import_bulk(seed_rows, seed_cols)
+    call = pql.parse("Intersect(Row(q=1), Row(q=2))").calls[0]
+    shards = list(range(STREAM_SHARDS))
+    eng.count("stream", call, shards)  # warm: builds the stack
+    syncer = eng.ingest_syncer()
+
+    # Idle read baseline (no concurrent writes).
+    idle = []
+    for _ in range(STREAM_IDLE_QUERY_REPS):
+        t0 = time.perf_counter()
+        eng.count("stream", call, shards)
+        idle.append(time.perf_counter() - t0)
+    idle_p50 = statistics.median(idle)
+
+    stop = threading.Event()
+    q_lat = []
+
+    def query_load():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            eng.count("stream", call, shards)
+            q_lat.append(time.perf_counter() - t0)
+            time.sleep(STREAM_QUERY_PACE_S)
+
+    qt = threading.Thread(target=query_load, name="stream-query", daemon=True)
+    qt.start()
+    fresh_lat = []
+    t_import = 0.0
+    bits_in = 0
+    nonce = iter(range(1, 1 << 30))
+    try:
+        for _ in range(STREAM_BATCHES):
+            n = next(nonce)
+            # Bulk stream batch: fresh random bits across the live shards.
+            rows = rng.integers(0, 2048, STREAM_BATCH_BITS)
+            cols = rng.integers(0, STREAM_SHARDS << 20, STREAM_BATCH_BITS)
+            t0 = time.perf_counter()
+            api.import_bits(
+                ImportRequest("stream", "fa", row_ids=rows, column_ids=cols)
+            )
+            t_import += time.perf_counter() - t0
+            bits_in += rows.size
+            # Freshness probe: a marked write followed by a count that
+            # reflects it (write -> readable round trip, PR 5 protocol,
+            # now under concurrent query load).
+            wcols = [
+                (s << 20) + (7919 * n + 131 * s) % (1 << 20)
+                for s in range(STREAM_SHARDS)
+            ]
+            t0 = time.perf_counter()
+            api.import_bits(
+                ImportRequest(
+                    "stream", "q",
+                    row_ids=[1 + (n % 2)] * STREAM_SHARDS, column_ids=wcols,
+                )
+            )
+            got = eng.count("stream", call, shards)
+            fresh_lat.append(time.perf_counter() - t0)
+            assert got >= 0
+    finally:
+        stop.set()
+        qt.join(timeout=10)
+    syncer.flush()
+    fresh_p50 = statistics.median(fresh_lat)
+    under_p50 = statistics.median(q_lat) if q_lat else float("nan")
+    emit_raw(
+        "ingest_streaming_mbits_s", bits_in / t_import / 1e6, "Mbits/s", 1.0
+    )
+    emit_raw("ingest_freshness_p50_ms", fresh_p50 * 1e3, "ms", 1.0)
+    emit_raw("query_p50_under_ingest_ms", under_p50 * 1e3, "ms", 1.0)
+    # p50 under write-invalidated memo churn is mostly memo-served (the
+    # dashboard shape); p95 carries the invalidation-miss device reads.
+    q_sorted = sorted(q_lat)
+    under_p95 = (
+        q_sorted[int(len(q_sorted) * 0.95)] if q_sorted else float("nan")
+    )
+    emit_raw("query_p95_under_ingest_ms", under_p95 * 1e3, "ms", 1.0)
+    emit_raw("query_p50_idle_ms", idle_p50 * 1e3, "ms", 1.0)
+    snap = syncer.snapshot()
+    emit_raw("ingest_sync_chunks", snap["chunks"], "chunks", 1.0)
+    emit_raw("ingest_sync_coalesced", snap["coalesced"], "chunks", 1.0)
+    progress(
+        f"streaming: {bits_in / t_import / 1e6:.1f} Mbits/s under load; "
+        f"freshness p50 {fresh_p50 * 1e3:.1f}ms; query p50 "
+        f"{under_p50 * 1e3:.1f}ms under ingest vs {idle_p50 * 1e3:.1f}ms "
+        f"idle; {len(q_lat)} queries during {STREAM_BATCHES} batches "
+        f"({snap['coalesced']}/{snap['chunks']} sync chunks coalesced)"
+    )
+    eng.close()
+    holder.close()
 
 
 def force_cpu_host_devices(n):
@@ -1747,6 +1912,17 @@ if __name__ == "__main__":
         "headline JSONL metric ingest_mbits_s — docs/ingest.md)",
     )
     ap.add_argument(
+        "--streaming-sweep",
+        action="store_true",
+        help="run the streaming write+read sweep ONLY: the id-pairs "
+        "old-vs-new headline (ingest_bits_mbits_s, arrays vs the "
+        "retained rowloop oracle), then continuous imports through a "
+        "live engine under a concurrent query load, emitting "
+        "ingest_streaming_mbits_s, ingest_freshness_p50_ms, and "
+        "query_p50_under_ingest_ms vs query_p50_idle_ms "
+        "(docs/ingest.md)",
+    )
+    ap.add_argument(
         "--conn-sweep",
         action="store_true",
         help="also sweep client connection counts (1/4/16/64, open-loop "
@@ -1801,6 +1977,8 @@ if __name__ == "__main__":
         )
     elif args.ingest_sweep:
         ingest_sweep()
+    elif args.streaming_sweep:
+        streaming_sweep()
     elif args.density_sweep:
         density_sweep()
     else:

@@ -91,11 +91,13 @@ class ImportRequest:
         self.index = index
         self.field = field
         self.shard = shard
-        self.row_ids = row_ids or []
-        self.column_ids = column_ids or []
+        # `is None` (not truthiness): id/timestamp vectors may be numpy
+        # arrays.
+        self.row_ids = row_ids if row_ids is not None else []
+        self.column_ids = column_ids if column_ids is not None else []
         self.row_keys = row_keys or []
         self.column_keys = column_keys or []
-        self.timestamps = timestamps or []
+        self.timestamps = timestamps if timestamps is not None else []
 
 
 class ImportValueRequest:
@@ -111,9 +113,9 @@ class ImportValueRequest:
         self.index = index
         self.field = field
         self.shard = shard
-        self.column_ids = column_ids or []
+        self.column_ids = column_ids if column_ids is not None else []
         self.column_keys = column_keys or []
-        self.values = values or []
+        self.values = values if values is not None else []
 
 
 class API:
@@ -476,8 +478,11 @@ class API:
         self._check_writable()
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
-        col_ids = list(req.column_ids)
-        row_ids = list(req.row_ids)
+        # Keep the caller's arrays as-is (field.import_bulk is
+        # array-native); only the per-bit cluster grouping below and key
+        # translation need python lists.
+        col_ids = req.column_ids
+        row_ids = req.row_ids
         if req.column_keys:
             if not idx.keys:
                 raise ApiError("importing keys into unkeyed index")
@@ -490,7 +495,13 @@ class API:
             row_ids = self.translate_store.translate_rows_to_uint64(
                 req.index, req.field, req.row_keys
             )
-        timestamps = req.timestamps if any(t for t in req.timestamps) else []
+        # .tolist() for the same json.dumps reason as the id vectors
+        # (None entries survive the object-array round trip).
+        timestamps = (
+            np.asarray(req.timestamps).tolist()
+            if any(t for t in req.timestamps)
+            else []
+        )
         # Validate BEFORE any mutation (field.go Import validation): a
         # late ValueError from field.import_bulk would land after the
         # existence field already recorded the columns (phantom
@@ -518,6 +529,11 @@ class API:
         # re-splits by shard and fans fragments out concurrently); the
         # remote per-(shard, node) RPCs run through the bounded import
         # fan-out instead of serially awaiting each round trip.
+        # .tolist() (not list()) so numpy inputs become python ints — the
+        # remote per-shard slices go through InternalClient's json.dumps,
+        # which rejects np.int64 scalars.
+        col_ids = np.asarray(col_ids).tolist()
+        row_ids = np.asarray(row_ids).tolist()
         groups: Dict[int, list] = {}
         for i, c in enumerate(col_ids):
             groups.setdefault(c // SHARD_WIDTH, []).append(i)
@@ -575,8 +591,9 @@ class API:
         # Clears do NOT retract existence: other fields may still hold
         # the column (handler clear semantics affect only this field).
         ef = idx.existence_field()
-        if not clear and ef is not None and col_ids:
-            ef.import_bulk([0] * len(col_ids), col_ids)
+        # len() (not truthiness): col_ids may be a numpy array now.
+        if not clear and ef is not None and len(col_ids):
+            ef.import_bulk(np.zeros(len(col_ids), dtype=np.int64), col_ids)
         f.import_bulk(row_ids, col_ids, ts, clear=clear)
 
     def import_values(
@@ -588,7 +605,9 @@ class API:
         self._check_writable()
         idx = self.index(req.index)
         f = self.field(req.index, req.field)
-        col_ids = list(req.column_ids)
+        # .tolist() (not list()): numpy inputs must become python ints
+        # before the cluster fan-out's json.dumps (same as import_bits).
+        col_ids = np.asarray(req.column_ids).tolist()
         if req.column_keys:
             if not idx.keys:
                 raise ApiError("importing keys into unkeyed index")
@@ -608,6 +627,7 @@ class API:
             self._ingest_done("values", req.index, len(col_ids), t0,
                               remote=remote)
             return
+        vals = np.asarray(req.values).tolist()
         groups: Dict[int, list] = {}
         for i, c in enumerate(col_ids):
             groups.setdefault(c // SHARD_WIDTH, []).append(i)
@@ -615,7 +635,7 @@ class API:
         remote_jobs = []
         for shard, idxs in sorted(groups.items()):
             cols = [col_ids[i] for i in idxs]
-            values = [req.values[i] for i in idxs]
+            values = [vals[i] for i in idxs]
             for node in self.cluster.shard_nodes(req.index, shard):
                 if node.id == self.cluster.node.id:
                     local_idxs.extend(idxs)
@@ -632,7 +652,7 @@ class API:
             remote_jobs.append(
                 lambda: apply_local(
                     [col_ids[i] for i in local_idxs],
-                    [req.values[i] for i in local_idxs],
+                    [vals[i] for i in local_idxs],
                 )
             )
         fanout.run_fanout(remote_jobs)

@@ -6,15 +6,37 @@ keeps up to maxEntries sorted pairs, admits new entries above the current
 threshold value, and trims at thresholdFactor (1.1) * maxEntries
 (cache.go:30-31,145-290).  The LRU variant evicts by recency
 (cache.go:57-131).
+
+``RankCache`` is array-native (docs/ingest.md): entries live as contiguous
+id-sorted (ids, counts) int64 columns, bulk imports merge whole sorted
+batches in vectorized passes, and recalculation is a C-speed lexsort (or,
+after monotone bulk updates, an incremental merge of the touched batch
+into the standing rankings — O(batch + top-k) instead of re-ranking every
+entry).  A zero count always POPS the entry, on the scalar and both bulk
+paths — a row cleared during a bulk import must evict its stale pair
+(pre-fix, ``bulk_add`` returned early on below-threshold counts and a
+stale entry could survive forever).
+
+Maintenance cost is exported as ``pilosa_cache_recalculate_seconds{path}``
+and ``pilosa_cache_entries{cache_type}`` (util/stats REGISTRY;
+``refresh_entries_gauges`` is called at /metrics scrape time).
 """
 
 from __future__ import annotations
 
+import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+from ..util.stats import (
+    METRIC_CACHE_ENTRIES,
+    METRIC_CACHE_RECALC,
+    REGISTRY,
+)
 
 THRESHOLD_FACTOR = 1.1
 
@@ -26,6 +48,41 @@ CACHE_TYPE_NONE = "none"
 
 VALID_CACHE_TYPES = {CACHE_TYPE_RANKED, CACHE_TYPE_LRU, CACHE_TYPE_NONE}
 
+_EMPTY_I64 = np.empty(0, dtype=np.int64)
+
+_RECALC_FULL = REGISTRY.histogram(METRIC_CACHE_RECALC, path="full")
+_RECALC_MERGE = REGISTRY.histogram(METRIC_CACHE_RECALC, path="merge")
+
+# Every live cache, for the pull-time pilosa_cache_entries{cache_type}
+# gauge refresh (weak: fragments drop caches on close/eviction).  The
+# lock covers add + snapshot: WeakSet iteration only defers REMOVALS,
+# so a fragment created on an import thread mid-scrape would otherwise
+# raise "set changed size during iteration".
+_ALL_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+_ALL_CACHES_LOCK = threading.Lock()
+
+
+def _register_cache(c):
+    with _ALL_CACHES_LOCK:
+        _ALL_CACHES.add(c)
+
+
+# Incremental-rank bookkeeping: beyond this many unflushed touched-id
+# batches a full re-rank is cheaper than the merge.
+_PENDING_MAX = 64
+
+
+def refresh_entries_gauges():
+    """Sum live entries per cache type into pilosa_cache_entries — called
+    at /metrics scrape time (net/server) and cheap enough for tests."""
+    totals = {CACHE_TYPE_RANKED: 0, CACHE_TYPE_LRU: 0, CACHE_TYPE_NONE: 0}
+    with _ALL_CACHES_LOCK:
+        caches = list(_ALL_CACHES)
+    for c in caches:
+        totals[c.cache_type] = totals.get(c.cache_type, 0) + len(c)
+    for ct, v in totals.items():
+        REGISTRY.set_gauge(METRIC_CACHE_ENTRIES, v, cache_type=ct)
+
 
 def pair_sort_key(pair: Tuple[int, int]):
     """Sort pairs by count desc, then id desc (matches the reference's
@@ -34,56 +91,186 @@ def pair_sort_key(pair: Tuple[int, int]):
 
 
 class RankCache:
-    """Sorted row-count cache with admission threshold."""
+    """Sorted row-count cache with admission threshold (array-native)."""
+
+    cache_type = CACHE_TYPE_RANKED
 
     def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE, debounce_seconds: float = 10.0):
         self.max_entries = max_entries
         self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
         self.threshold_value = 0
-        self.entries: Dict[int, int] = {}
-        self.rankings: List[Tuple[int, int]] = []
+        # Entry store: parallel int64 columns, ids ascending, counts > 0.
+        self._ids = _EMPTY_I64
+        self._counts = _EMPTY_I64
+        # O(1) scalar-write overlay, folded into the columns before any
+        # bulk/whole-store operation; a 0 value marks a pending pop.
+        self._extra: Dict[int, int] = {}
+        # Rankings: ONE tuple of parallel columns in (count desc,
+        # id desc) order, swapped atomically — top() runs on executor
+        # threads without the fragment lock, so it must never read two
+        # attributes that a concurrent recalculate updates separately.
+        self._rank: Tuple[np.ndarray, np.ndarray] = (_EMPTY_I64, _EMPTY_I64)
+        self._top_cache = None  # (rank tuple identity, materialized list)
+        # Touched-id batches since the last recalculate, for the
+        # incremental merge path; None = merge invalid (non-monotone
+        # update or overflow), full re-rank required.
+        self._pending: list = []
         self._update_time = 0.0
         # The reference hard-codes a 10s invalidation debounce
         # (cache.go:236-240); configurable here so tests are deterministic.
         self.debounce_seconds = debounce_seconds
+        _register_cache(self)
+
+    # -- scalar ops --------------------------------------------------------
 
     def add(self, row_id: int, n: int):
-        # Below-threshold counts are ignored unless zero (zero clears).
+        # Below-threshold counts are ignored unless zero (zero POPS).
         if n < self.threshold_value and n > 0:
             return
-        self.entries[row_id] = n
+        self._extra[row_id] = n
         self.invalidate()
 
     def bulk_add(self, row_id: int, n: int):
-        if n < self.threshold_value:
+        # Same admission as add() — including the zero-pops rule, which
+        # the pre-array implementation dropped on this path (a row
+        # cleared mid-bulk-import could never evict its stale entry).
+        if n < self.threshold_value and n > 0:
             return
-        self.entries[row_id] = n
-
-    def bulk_update(self, row_ids, counts):
-        """Vectorized bulk_add: one C-speed dict.update for a whole
-        import batch (admission threshold applied as a numpy mask).
-        Caller invalidates once afterwards, same as bulk_add."""
-        if self.threshold_value > 0:
-            keep = np.asarray(counts) >= self.threshold_value
-            row_ids, counts = (
-                np.asarray(row_ids)[keep],
-                np.asarray(counts)[keep],
-            )
-        self.entries.update(
-            zip(
-                np.asarray(row_ids).tolist(),
-                np.asarray(counts).tolist(),
-            )
-        )
+        self._extra[row_id] = n
 
     def get(self, row_id: int) -> int:
-        return self.entries.get(row_id, 0)
+        n = self._extra.get(row_id)
+        if n is not None:
+            return n
+        i = int(np.searchsorted(self._ids, row_id))
+        if i < self._ids.size and self._ids[i] == row_id:
+            return int(self._counts[i])
+        return 0
+
+    # -- bulk ops ----------------------------------------------------------
+
+    def bulk_update(self, row_ids, counts):
+        """Vectorized bulk_add: merge a whole import batch's (id, count)
+        pairs into the entry columns in sorted array passes (admission
+        threshold applied as a mask; zero counts pop their entries).
+        Caller invalidates once afterwards, same as bulk_add."""
+        ids = np.asarray(row_ids, dtype=np.int64)
+        cnts = np.asarray(counts, dtype=np.int64)
+        if ids.size == 0:
+            return
+        if ids.size > 1 and not np.all(ids[1:] > ids[:-1]):
+            # General input: sort by id, last write per id wins.
+            order = np.argsort(ids, kind="stable")
+            ids, cnts = ids[order], cnts[order]
+            last = np.r_[ids[1:] != ids[:-1], True]
+            ids, cnts = ids[last], cnts[last]
+        if self.threshold_value > 0:
+            keep = (cnts >= self.threshold_value) | (cnts == 0)
+            if not keep.all():
+                ids, cnts = ids[keep], cnts[keep]
+        if ids.size == 0:
+            return
+        self._flush_extra()
+        self._merge_entries(ids, cnts)
+
+    def _flush_extra(self):
+        """Fold the scalar overlay into the sorted columns."""
+        if not self._extra:
+            return
+        items = sorted(self._extra.items())
+        self._extra = {}
+        self._merge_entries(
+            np.fromiter((k for k, _ in items), dtype=np.int64, count=len(items)),
+            np.fromiter((v for _, v in items), dtype=np.int64, count=len(items)),
+        )
+
+    def _merge_entries(self, ids: np.ndarray, cnts: np.ndarray):
+        """Merge an id-sorted unique batch into the entry columns;
+        zeros delete.  Tracks the touched ids (and whether the update
+        was monotone) for the incremental rank merge."""
+        eids, ecnts = self._ids, self._counts
+        if ids.size == 1:
+            # Scalar-write shape (set_bit -> add -> flush): almost
+            # always an in-place count update of an existing entry.
+            i = int(np.searchsorted(eids, ids[0]))
+            hit1 = i < eids.size and eids[i] == ids[0]
+            n1 = int(cnts[0])
+            if self._pending is not None:
+                if (hit1 and n1 < ecnts[i]) or (hit1 and n1 == 0) or (
+                    len(self._pending) >= _PENDING_MAX
+                ):
+                    self._pending = None
+                elif n1 != 0:
+                    self._pending.append(ids)
+            if hit1:
+                if n1 == 0:
+                    self._ids = np.delete(eids, i)
+                    self._counts = np.delete(ecnts, i)
+                else:
+                    ecnts[i] = n1
+            elif n1 != 0:
+                self._ids = np.insert(eids, i, ids[0])
+                self._counts = np.insert(ecnts, i, n1)
+            return
+        idx = np.searchsorted(eids, ids)
+        hit = np.zeros(ids.size, dtype=bool)
+        inb = idx < eids.size
+        hit[inb] = eids[idx[inb]] == ids[inb]
+        zero = cnts == 0
+        upd = hit & ~zero
+        fresh = ~hit & ~zero
+        dead = hit & zero
+        if self._pending is not None:
+            # Monotone = counts only grew and nothing was popped: the
+            # standing rankings plus the touched ids then provably
+            # contain the new top-k (see recalculate).
+            if dead.any() or bool(np.any(cnts[upd] < ecnts[idx[upd]])):
+                self._pending = None
+            elif len(self._pending) >= _PENDING_MAX:
+                self._pending = None
+            else:
+                self._pending.append(ids[upd | fresh])
+        if upd.any():
+            ecnts[idx[upd]] = cnts[upd]
+        if fresh.any() or dead.any():
+            keep = np.ones(eids.size, dtype=bool)
+            keep[idx[dead]] = False
+            all_ids = np.concatenate([eids[keep], ids[fresh]])
+            all_cnts = np.concatenate([ecnts[keep], cnts[fresh]])
+            order = np.argsort(all_ids)
+            self._ids = all_ids[order]
+            self._counts = all_cnts[order]
+
+    # -- introspection -----------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self.entries)
+        # Deliberately non-mutating: /metrics scrapes reach here OFF the
+        # fragment lock (refresh_entries_gauges), racing locked writers —
+        # folding the overlay here could drop a concurrent add() or leave
+        # the parallel columns mismatched.  Count the overlay against a
+        # one-shot snapshot of the sorted ids instead.
+        ids = self._ids
+        n = ids.size
+        for k, v in list(self._extra.items()):
+            i = int(np.searchsorted(ids, k))
+            hit = i < ids.size and ids[i] == k
+            if v == 0:
+                n -= 1 if hit else 0
+            elif not hit:
+                n += 1
+        return n
 
     def ids(self) -> List[int]:
-        return sorted(self.entries)
+        self._flush_extra()
+        return self._ids.tolist()
+
+    @property
+    def entries(self) -> Dict[int, int]:
+        """Dict view of the entry store (tests/compat; not a hot path)."""
+        self._flush_extra()
+        return dict(zip(self._ids.tolist(), self._counts.tolist()))
+
+    # -- ranking -----------------------------------------------------------
 
     def invalidate(self):
         if time.monotonic() - self._update_time < self.debounce_seconds:
@@ -91,32 +278,106 @@ class RankCache:
         self.recalculate()
 
     def recalculate(self):
-        rankings = sorted(self.entries.items(), key=pair_sort_key)
-        remove_items: List[Tuple[int, int]] = []
-        if len(rankings) > self.max_entries:
-            self.threshold_value = rankings[self.max_entries][1]
-            remove_items = rankings[self.max_entries :]
-            rankings = rankings[: self.max_entries]
+        t0 = time.monotonic()
+        self._flush_extra()
+        pending = self._pending
+        if pending is not None and self._update_time and len(pending) > 0:
+            touched = (
+                np.unique(np.concatenate(pending))
+                if len(pending) > 1
+                else pending[0]
+            )
+            self._recalculate_merge(touched)
+            _RECALC_MERGE.observe(time.monotonic() - t0)
+        else:
+            order = np.lexsort((self._ids, self._counts))[::-1]
+            self._finish_rank(self._ids[order], self._counts[order])
+            _RECALC_FULL.observe(time.monotonic() - t0)
+        self._pending = []
+        self._update_time = time.monotonic()
+
+    def _recalculate_merge(self, touched: np.ndarray):
+        """Incremental re-rank: merge the touched ids' current counts
+        into the standing rankings — O((batch + k) log(batch + k))
+        instead of re-sorting every entry.  Valid because every update
+        since the last full rank was monotone (enforced by
+        _merge_entries): entries outside rankings ∪ touched were below
+        the old k-th pair and nothing above them shrank, so the new
+        top-k is contained in the candidates.  The admission threshold
+        is still computed over ALL entries (linear select) so it never
+        diverges from the full path."""
+        rk_ids, rk_cnts = self._rank
+        if rk_ids.size:
+            stale = np.isin(rk_ids, touched)
+            if stale.any():
+                rk_ids, rk_cnts = rk_ids[~stale], rk_cnts[~stale]
+        pos = np.searchsorted(self._ids, touched)
+        inb = pos < self._ids.size
+        alive = np.zeros(touched.size, dtype=bool)
+        alive[inb] = self._ids[pos[inb]] == touched[inb]
+        cand_ids = np.concatenate([rk_ids, touched[alive]])
+        cand_cnts = np.concatenate([rk_cnts, self._counts[pos[alive]]])
+        order = np.lexsort((cand_ids, cand_cnts))[::-1]
+        self._finish_rank(cand_ids[order], cand_cnts[order], all_entries=False)
+
+    def _finish_rank(
+        self, s_ids: np.ndarray, s_cnts: np.ndarray, all_entries: bool = True
+    ):
+        """Install rankings from (count desc, id desc)-sorted candidate
+        columns; set the admission threshold and trim the entry store at
+        threshold_buffer, exactly like the reference (cache.go:261-290):
+        threshold = the (max_entries+1)-th pair's count over ALL
+        entries, 1 when everything fits."""
+        k = self.max_entries
+        n_all = self._ids.size
+        if n_all > k:
+            if all_entries:
+                self.threshold_value = int(s_cnts[k])
+            else:
+                # Candidates are a subset: take the (k+1)-th largest
+                # count over the whole store (linear partition select).
+                self.threshold_value = int(
+                    np.partition(self._counts, n_all - 1 - k)[n_all - 1 - k]
+                )
+            self._rank = (s_ids[:k], s_cnts[:k])
+            if n_all > self.threshold_buffer:
+                # Trim: only the ranked pairs survive in the store.
+                rk_ids, rk_cnts = self._rank
+                order = np.argsort(rk_ids)
+                self._ids = rk_ids[order]
+                self._counts = rk_cnts[order]
         else:
             self.threshold_value = 1
-        self.rankings = rankings
-        self._update_time = time.monotonic()
-        if len(self.entries) > self.threshold_buffer:
-            for row_id, _ in remove_items:
-                self.entries.pop(row_id, None)
+            self._rank = (s_ids, s_cnts)
 
     def top(self) -> List[Tuple[int, int]]:
-        return self.rankings
+        rank = self._rank
+        cached = self._top_cache
+        if cached is not None and cached[0] is rank:
+            return cached[1]
+        lst = list(zip(rank[0].tolist(), rank[1].tolist()))
+        # Identity-tagged cache: a racing recalculate swaps self._rank
+        # first, so a stale write here misses the tag and self-corrects
+        # on the next call.
+        self._top_cache = (rank, lst)
+        return lst
 
 
 class LRUCache:
     """Recency-evicting row-count cache."""
 
+    cache_type = CACHE_TYPE_LRU
+
     def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE, **_):
         self.max_entries = max_entries
         self._od: OrderedDict[int, int] = OrderedDict()
+        _register_cache(self)
 
     def add(self, row_id: int, n: int):
+        if n == 0:
+            # Zero pops, matching RankCache's clear semantics.
+            self._od.pop(row_id, None)
+            return
         if row_id in self._od:
             self._od.move_to_end(row_id)
         self._od[row_id] = n
@@ -126,7 +387,9 @@ class LRUCache:
     bulk_add = add
 
     def bulk_update(self, row_ids, counts):
-        for r, n in zip(row_ids.tolist(), counts.tolist()):
+        for r, n in zip(
+            np.asarray(row_ids).tolist(), np.asarray(counts).tolist()
+        ):
             self.add(r, n)
 
     def get(self, row_id: int) -> int:
@@ -154,8 +417,10 @@ class LRUCache:
 class NopCache:
     """No cache (cacheType: none)."""
 
+    cache_type = CACHE_TYPE_NONE
+
     def __init__(self, *_, **__):
-        pass
+        _register_cache(self)
 
     def add(self, row_id: int, n: int):
         pass

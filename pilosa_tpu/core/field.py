@@ -25,6 +25,7 @@ import numpy as np
 from ..roaring import Bitmap
 from ..util import fanout
 from . import cache as cache_mod
+from . import rowstore
 from . import timequantum
 from .fragment import SHARD_WIDTH, FALSE_ROW_ID, TRUE_ROW_ID  # noqa: F401
 from .row import Row
@@ -483,15 +484,28 @@ class Field:
                 changed += frag.bulk_import(rows, cols, clear=clear)
         return changed
 
+    # Distinct-shard ceiling for the native partition's output tables;
+    # batches spanning more shards fall back to the argsort path.
+    _NATIVE_SPLIT_MAX_SHARDS = 4096
+
     @staticmethod
     def _shard_groups(view, cols: np.ndarray, *parallel: np.ndarray):
         """Group column-parallel arrays by shard: yields
-        ``(fragment, cols_slice, *parallel_slices)`` per shard.  ONE
-        stable argsort over the shard keys (order within a shard is
-        preserved — last-write-wins paths depend on it); fragments are
-        created serially here because the view/fragment registries are
-        not concurrent-creation safe, then the caller fans the per-
-        fragment applies out."""
+        ``(fragment, cols_slice, *parallel_slices)`` per shard, order
+        within a shard preserved (last-write-wins paths depend on it).
+        Native stable counting sort when available (two linear passes,
+        native/sparse_merge.cpp sm_shard_split), ONE stable argsort over
+        the shard keys otherwise; fragments are created serially here
+        because the view/fragment registries are not concurrent-creation
+        safe, then the caller fans the per-fragment applies out."""
+        if len(parallel) == 1 and cols.dtype == np.int64:
+            lib = rowstore._merge_lib()
+            if lib is not None:
+                groups = Field._shard_groups_native(
+                    lib, view, cols, parallel[0]
+                )
+                if groups is not None:
+                    return groups
         shards = cols // SHARD_WIDTH
         uniq = np.unique(shards)
         if uniq.size == 1:
@@ -508,6 +522,40 @@ class Field:
             lo, hi = bounds[k], bounds[k + 1]
             out.append(
                 (frag, cols[lo:hi]) + tuple(a[lo:hi] for a in parallel)
+            )
+        return out
+
+    @staticmethod
+    def _shard_groups_native(lib, view, cols, par):
+        """Native shard partition; None when the kernel declines (more
+        distinct shards than the table bound)."""
+        n = cols.size
+        cols_c = np.ascontiguousarray(cols)
+        par_c = np.ascontiguousarray(par, dtype=np.int64)
+        cols_out = np.empty(n, dtype=np.int64)
+        par_out = np.empty(n, dtype=np.int64)
+        cap = Field._NATIVE_SPLIT_MAX_SHARDS
+        sids = np.empty(cap, dtype=np.int64)
+        bnds = np.empty(cap + 1, dtype=np.int64)
+        ns = lib.sm_shard_split(
+            cols_c.ctypes.data,
+            par_c.ctypes.data,
+            n,
+            int(SHARD_WIDTH.bit_length() - 1),
+            cap,
+            cols_out.ctypes.data,
+            par_out.ctypes.data,
+            sids.ctypes.data,
+            bnds.ctypes.data,
+        )
+        if ns < 0:
+            return None
+        out = []
+        b = bnds.tolist()
+        for k, s in enumerate(sids[:ns].tolist()):
+            frag = view.fragment_if_not_exists(int(s))
+            out.append(
+                (frag, cols_out[b[k] : b[k + 1]], par_out[b[k] : b[k + 1]])
             )
         return out
 

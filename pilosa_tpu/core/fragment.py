@@ -181,21 +181,30 @@ class Fragment:
         # syncs reaching back past it must rebuild.
         self._mutlog: Dict[int, int] = {}
         self._mut_floor = 0
-        # Word-level dirty tracking: {row: [(version, int32 word idxs)]}
-        # lets the engine sync a point write by shipping the CHANGED
-        # 4-byte words instead of the whole 128 KiB row — the
-        # host->device transfer is the dominant cost of incremental sync
-        # through a slow transport.  Chunks (version-stamped word
-        # arrays) replace the old per-word dict: a bulk batch logs ONE
-        # append per dirty row instead of one dict store per word (the
-        # dict bookkeeping dominated the old ingest path).  Chunks
-        # compact (unique-merge to the newest version — safe: a too-new
-        # version only reships idempotent words) when entries exceed
-        # WORD_LOG_MAX, and flip to whole-row dirty when the distinct
-        # words still exceed it.  ``_word_floor[row]`` marks the last
-        # whole-row-dirty version (dense load, clear_row, log overflow):
-        # syncs reaching back past it take the full row.
-        self._word_log: Dict[int, List[tuple]] = {}
+        # Word-level dirty tracking, as whole-batch RECORDS:
+        # [(version, packed ``row << 15 | word`` int64 keys)].  Lets the
+        # engine sync a point write by shipping the CHANGED 4-byte words
+        # instead of the whole 128 KiB row — the host->device transfer
+        # is the dominant cost of incremental sync through a slow
+        # transport.  A bulk batch logs ONE record for ALL its rows (the
+        # packed keys come out of the batch sort for free), so the
+        # ingest path has no per-row bookkeeping at all; the per-row
+        # split happens vectorized at SYNC time (sync_snapshot), where
+        # coalescing already amortizes it.  Past WORD_LOG_RECORDS fresh
+        # records the TAIL compacts (concatenate, stamped at the newest
+        # version — safe: a too-new version only reships idempotent
+        # words) into a tier that keeps that stamp forever; the leading
+        # ``_word_log_tiers`` records are such tiers and are never
+        # restamped, so history a sync already consumed is not reshipped
+        # every compaction.  Only a log past WORD_LOG_GLOBAL_MAX pays a
+        # full np.unique merge (which does restamp — the one remaining,
+        # budget-amortized reship); rows whose distinct dirty words
+        # exceed WORD_LOG_MAX flip to whole-row dirty there.
+        # ``_word_floor[row]`` marks the last whole-row-dirty version
+        # (dense load, clear_row, log overflow): syncs reaching back
+        # past it take the full row.
+        self._word_log: List[tuple] = []
+        self._word_log_tiers = 0
         self._word_floor: Dict[int, int] = {}
 
         # Lazily-built mutex occupancy vector: column -> owning row (-1 none).
@@ -247,8 +256,8 @@ class Fragment:
             return
         rows, bounds, pos = self._split_packed(_sorted_unique_u64(positions))
         new_counts, _, _ = self._store.bulk_merge(rows, bounds, pos)
-        for i in range(len(rows)):
-            self.cache.bulk_add(int(rows[i]), int(new_counts[i]))
+        # Whole-array cache feed (no per-row bulk_add loop).
+        self.cache.bulk_update(rows, new_counts)
         self.cache.invalidate()
         self._mutex_owners = None
         self._version += 1
@@ -366,75 +375,105 @@ class Fragment:
         if cols is None:
             self._word_row_dirty(row_id, v)
         else:
+            base = np.int64(row_id << 15)
             if isinstance(cols, (int, np.integer)):
-                words = np.asarray([int(cols) >> 5], dtype=np.int32)
+                packed = np.asarray([base | (int(cols) >> 5)], dtype=np.int64)
             else:
-                words = np.unique(
+                packed = base | np.unique(
                     np.asarray(cols, dtype=np.int64) >> 5
-                ).astype(np.int32)
-            self._word_log_append(row_id, v, words)
+                )
+            if packed.size > self.WORD_LOG_MAX:
+                self._word_row_dirty(row_id, v)
+            else:
+                self._word_log_push(v, packed)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         WRITE_SEQ.v += 1
         if self._on_touch is not None:
             self._on_touch()
 
     def _word_row_dirty(self, row_id: int, v: int):
+        # The row's packed keys (if any) stay in the log — the sync's
+        # floor check routes the row to a whole-row payload regardless.
         self._word_floor[row_id] = v
-        self._word_log.pop(row_id, None)
 
-    # Word-log chunks per row before a compaction pass (bounds both the
-    # entry count and how many batch parent arrays a row's views pin).
-    WORD_LOG_CHUNKS = 8
+    # Record count before a compaction pass, and the packed-key budget
+    # past which compaction dedups (and flips over-budget rows to
+    # whole-row dirty) instead of just concatenating.
+    WORD_LOG_RECORDS = 16
+    WORD_LOG_GLOBAL_MAX = 1 << 20
 
-    def _word_log_append(self, row_id: int, v: int, words: np.ndarray):
-        """Log a row's dirty device words as ONE (version, array) chunk;
-        every WORD_LOG_CHUNKS appends the chunks compact (unique-merge,
-        stamped at the newest version — over-stamping only reships
-        idempotent words), and the row flips to whole-row dirty once its
-        distinct dirty words exceed WORD_LOG_MAX anyway."""
-        if words.size > self.WORD_LOG_MAX:
-            self._word_row_dirty(row_id, v)
+    def _word_log_push(self, v: int, packed: np.ndarray):
+        """Append one batch's packed ``row << 15 | word`` keys as ONE
+        record.  Past WORD_LOG_RECORDS fresh records the TAIL compacts
+        by concatenation into one record stamped at the newest version
+        (over-stamping only reships idempotent words — and only the
+        tail's own few batches), which then becomes a TIER: tiers keep
+        their stamps across later compactions, so words a sync already
+        consumed are not restamped newer and reshipped on every
+        compaction (pre-tiering, steady-state ingest reshipped the
+        whole accumulated log every WORD_LOG_RECORDS batches).  Only a
+        log past WORD_LOG_GLOBAL_MAX pays a real np.unique over
+        everything (restamping it — the one remaining reship, amortized
+        over the budget), at which point rows holding more than
+        WORD_LOG_MAX distinct dirty words flip to whole-row dirty and
+        leave the log."""
+        log = self._word_log
+        log.append((v, packed))
+        tiers = self._word_log_tiers
+        if len(log) - tiers < self.WORD_LOG_RECORDS:
             return
-        chunks = self._word_log.setdefault(row_id, [])
-        chunks.append((v, words))
-        if len(chunks) >= self.WORD_LOG_CHUNKS:
-            merged = np.unique(np.concatenate([w for _, w in chunks]))
-            if merged.size > self.WORD_LOG_MAX:
-                self._word_row_dirty(row_id, v)
-                return
-            self._word_log[row_id] = [(v, merged.astype(np.int32))]
+        cat = np.concatenate([p for _, p in log[tiers:]])
+        del log[tiers:]
+        log.append((v, cat))
+        self._word_log_tiers = len(log)
+        if sum(p.size for _, p in log) > self.WORD_LOG_GLOBAL_MAX:
+            cat = np.unique(
+                np.concatenate([p for _, p in log])
+                if len(log) > 1
+                else log[0][1]
+            )
+            if cat.size > self.WORD_LOG_GLOBAL_MAX:
+                rk = cat >> np.int64(15)
+                starts = np.flatnonzero(np.r_[True, rk[1:] != rk[:-1]])
+                bnds = np.append(starts, cat.size)
+                over = np.flatnonzero(np.diff(bnds) > self.WORD_LOG_MAX)
+                if over.size:
+                    keep = np.ones(cat.size, dtype=bool)
+                    floor = self._word_floor
+                    for k in over.tolist():
+                        keep[bnds[k] : bnds[k + 1]] = False
+                        floor[int(rk[starts[k]])] = v
+                    cat = cat[keep]
+            log[:] = [(v, cat)]
+            self._word_log_tiers = 1
 
     def _touch_rows(self, rows, words, wbounds):
-        """Bulk ``_touch``: ONE version bump covers every row of a batch
-        (sync_snapshot only needs ordering, not per-row versions), the
-        row log updates through one C-speed ``dict.update``, and each
-        row's dirty device words land as ONE word-log chunk —
-        ``words[wbounds[i]:wbounds[i+1]]`` (sorted unique int32,
-        precomputed from the batch's packed keys in one pass)."""
+        """Bulk ``_touch``: ONE version bump and ONE word-log record
+        cover every row of a batch (sync_snapshot only needs ordering,
+        not per-row versions).  ``words[wbounds[i]:wbounds[i+1]]`` are
+        row ``rows[i]``'s sorted unique dirty device words (precomputed
+        from the batch's packed keys in one pass); they re-pack into the
+        record's global keys in one vectorized pass — the ingest side
+        has no per-row word bookkeeping at all, the per-row split moved
+        to sync_snapshot where coalescing amortizes it."""
         self._version += 1
         v = self._version
-        row_list = rows.tolist()
-        self._mutlog.update(dict.fromkeys(row_list, v))
-        word_log = self._word_log
-        wb = wbounds.tolist() if isinstance(wbounds, np.ndarray) else wbounds
-        max_words = self.WORD_LOG_MAX
-        max_chunks = self.WORD_LOG_CHUNKS
-        for i, r in enumerate(row_list):
-            w = words[wb[i] : wb[i + 1]]
-            if w.size > max_words:
+        self._mutlog.update(dict.fromkeys(rows.tolist(), v))
+        wb = np.asarray(wbounds, dtype=np.int64)
+        sizes = np.diff(wb)
+        over = sizes > self.WORD_LOG_MAX
+        if over.any():
+            for r in rows[over].tolist():
                 self._word_row_dirty(r, v)
-                continue
-            chunks = word_log.get(r)
-            if chunks is None:
-                word_log[r] = [(v, w)]
-                continue
-            chunks.append((v, w))
-            if len(chunks) >= max_chunks:
-                merged = np.unique(np.concatenate([x for _, x in chunks]))
-                if merged.size > max_words:
-                    self._word_row_dirty(r, v)
-                else:
-                    word_log[r] = [(v, merged.astype(np.int32))]
+            keep = np.repeat(~over, sizes)
+            packed = (
+                np.repeat(rows[~over].astype(np.int64) << 15, sizes[~over])
+                | words[keep]
+            )
+        else:
+            packed = np.repeat(rows.astype(np.int64) << 15, sizes) | words
+        if packed.size:
+            self._word_log_push(v, packed)
         checksums = self._checksums
         for blk in np.unique(rows // HASH_BLOCK_SIZE).tolist():
             checksums.pop(blk, None)
@@ -451,7 +490,7 @@ class Fragment:
         Returns None when the sync point predates the last
         unattributed version bump (storage load) — only then is a
         rebuild required; ordinary writes and bulk imports of ANY size
-        are covered by the per-row log.
+        are covered by the record-structured word log.
 
         Each dirty row maps to either ``("row", words, occ)`` (full
         uint32 row) or ``("words", widxs, vals, occ)`` — just the
@@ -467,27 +506,44 @@ class Fragment:
                 return self._version, {}
             if version < self._mut_floor:
                 return None
+            # Vectorized word-map build: dedup + per-row split of every
+            # record newer than the sync point, ONCE for the whole
+            # drain (the ingest path logs whole-batch records and does
+            # no per-row work — this is where it lands instead).
+            fresh = [p for rv, p in self._word_log if rv > version]
+            if fresh:
+                packed = np.unique(
+                    np.concatenate(fresh) if len(fresh) > 1 else fresh[0]
+                )
+                rk = packed >> np.int64(15)
+                starts = np.flatnonzero(np.r_[True, rk[1:] != rk[:-1]])
+                bnds = np.append(starts, packed.size).tolist()
+                wlow = (packed & np.int64(bitops.WORDS - 1)).astype(
+                    np.int32
+                )
+                word_map = {
+                    int(rk[bnds[k]]): wlow[bnds[k] : bnds[k + 1]]
+                    for k in range(len(bnds) - 1)
+                }
+            else:
+                word_map = {}
             out = {}
+            max_words = self.WORD_LOG_MAX
             for r, rv in self._mutlog.items():
                 if rv <= version:
                     continue
                 occ = self._store.occupancy64(r)
-                wlog = self._word_log.get(r)
-                if version < self._word_floor.get(r, 0) or not wlog:
+                if version < self._word_floor.get(r, 0):
                     out[r] = ("row", self.row_words(r), occ)
                     continue
-                fresh = [w for wv, w in wlog if wv > version]
-                if not fresh:
-                    # The row version advanced but no word chunk did:
-                    # only a whole-row touch can do that, and the floor
-                    # check above would have caught it — defensive.
+                widxs = word_map.get(r)
+                if widxs is None or widxs.size > max_words:
+                    # No word attribution (defensive: only a whole-row
+                    # touch can do that, and the floor check above
+                    # catches it) or a payload past the word-path
+                    # bound: ship the whole row.
                     out[r] = ("row", self.row_words(r), occ)
                     continue
-                widxs = (
-                    np.unique(np.concatenate(fresh))
-                    if len(fresh) > 1
-                    else fresh[0]
-                ).astype(np.int32)
                 words = self.row_words(r)
                 out[r] = ("words", widxs, words[widxs], occ)
             return self._version, out

@@ -16,6 +16,7 @@ All positions are in-row (0 .. SHARD_WIDTH).
 
 from __future__ import annotations
 
+import ctypes
 from typing import Dict, List
 
 import numpy as np
@@ -23,6 +24,24 @@ import numpy as np
 from ..ops import bitops
 
 WORDS64 = bitops.WORDS64
+
+# Lazily-resolved native sparse-merge library (pilosa_tpu/native/
+# sparse_merge.cpp): None = not yet resolved, False = unavailable or
+# disabled (PILOSA_NATIVE_MERGE=0).  The numpy implementations below are
+# the automatic fallback AND the differential oracle
+# (tests/test_native_merge.py); both produce bit-identical stores.
+_MERGE = None
+
+_ERR_RANGE = -(1 << 63)  # sm_apply_dense out-of-range sentinel
+
+
+def _merge_lib():
+    global _MERGE
+    if _MERGE is None:
+        from .. import native
+
+        _MERGE = native.load_merge() or False
+    return _MERGE or None
 
 # Rows with more set bits than this are stored dense.  At the threshold a
 # sparse row costs 16 KiB vs 128 KiB dense (8x); above it dense wins on
@@ -58,12 +77,19 @@ def densify(positions: np.ndarray) -> np.ndarray:
 class RowStore:
     """Per-fragment hybrid row storage with maintained cardinalities."""
 
-    __slots__ = ("sparse", "dense", "counts")
+    __slots__ = ("sparse", "dense", "counts", "_pack")
 
     def __init__(self):
         self.sparse: Dict[int, np.ndarray] = {}
         self.dense: Dict[int, np.ndarray] = {}
         self.counts: Dict[int, int] = {}
+        # Packed-parent cache: (positions uint32, rows int64, bounds
+        # int64) from the last whole-store sparse merge, valid while it
+        # still describes EVERY sparse row (every out-of-band sparse
+        # mutation clears it).  Lets the next merge's native gather
+        # compute its pointer table vectorized from one parent instead
+        # of fetching 2k .ctypes pointers per batch.
+        self._pack = None
 
     # -- introspection -----------------------------------------------------
 
@@ -103,6 +129,7 @@ class RowStore:
     def set(self, row_id: int, pos: int) -> bool:
         sp = self.sparse.get(row_id)
         if sp is not None:
+            self._pack = None
             p32 = np.uint32(pos)
             i = int(np.searchsorted(sp, p32))
             if i < len(sp) and int(sp[i]) == pos:
@@ -120,6 +147,7 @@ class RowStore:
             return True
         d = self.dense.get(row_id)
         if d is None:
+            self._pack = None
             self.sparse[row_id] = np.array([pos], dtype=np.uint32)
             self.counts[row_id] = 1
             return True
@@ -136,6 +164,7 @@ class RowStore:
             i = int(np.searchsorted(sp, np.uint32(pos)))
             if i >= len(sp) or int(sp[i]) != pos:
                 return False
+            self._pack = None
             self.sparse[row_id] = np.delete(sp, i)
             self.counts[row_id] = self.counts.get(row_id, 1) - 1
             return True
@@ -154,6 +183,7 @@ class RowStore:
     def union(self, row_id: int, positions: np.ndarray) -> int:
         """OR sorted-unique in-row positions into a row; returns new count."""
         positions = np.asarray(positions, dtype=np.uint32)
+        self._pack = None
         sp = self.sparse.get(row_id)
         if sp is not None or row_id not in self.dense:
             merged = (
@@ -182,6 +212,7 @@ class RowStore:
     def difference(self, row_id: int, positions: np.ndarray) -> int:
         """ANDNOT sorted-unique in-row positions out of a row; new count."""
         positions = np.asarray(positions, dtype=np.uint32)
+        self._pack = None
         sp = self.sparse.get(row_id)
         if sp is not None:
             kept = np.setdiff1d(sp, positions, assume_unique=True)
@@ -259,20 +290,7 @@ class RowStore:
             d = dense.get(r)
             if d is not None:
                 before = counts.get(r, 0)
-                widx = (pos >> np.uint32(6)).astype(np.int64)
-                starts = np.flatnonzero(
-                    np.r_[True, widx[1:] != widx[:-1]]
-                )
-                uw = widx[starts]
-                deltas = np.bitwise_or.reduceat(
-                    _ONE << (pos.astype(np.uint64) & _M63), starts
-                )
-                pc_before = bitops.popcount_np(d[uw])
-                if clear:
-                    d[uw] &= ~deltas
-                else:
-                    d[uw] |= deltas
-                n = before + bitops.popcount_np(d[uw]) - pc_before
+                n = before + self._apply_dense(d, pos, clear)
                 counts[r] = n
                 new_counts[i] = n
                 changed[i] = abs(n - before)
@@ -293,6 +311,7 @@ class RowStore:
                 if n > SPARSE_MAX:
                     dense[r] = densify(pos)
                 else:
+                    self._pack = None
                     sparse[r] = pos
                 counts[r] = n
                 new_counts[i] = n
@@ -326,21 +345,8 @@ class RowStore:
         exp = bitops.SHARD_WIDTH_EXP
         counts = self.counts
         sparse = self.sparse
-        sel_list = rows.tolist() if sp_sel is None else rows[sp_sel].tolist()
-        get = sparse.get
-        a_rows, a_chunks, a_lens = [], [], []
-        befores_l = []
-        for r in sel_list:
-            sp = get(r)
-            if sp is not None and sp.size:
-                a_rows.append(r)
-                a_chunks.append(sp)
-                # len(sparse[r]) IS the maintained count for sparse rows,
-                # so this single pass also yields the before-counts.
-                a_lens.append(sp.size)
-                befores_l.append(sp.size)
-            else:
-                befores_l.append(0)
+        sel_arr = rows if sp_sel is None else rows[sp_sel]
+        sel_list = sel_arr.tolist()
         if sp_sel is None and b_packed is not None:
             b = (
                 b_packed.view(np.int64)
@@ -358,6 +364,121 @@ class RowStore:
                     [positions[bounds[i] : bounds[i + 1]] for i in sel_idx]
                 ).astype(np.int64)
             )
+        lib = _merge_lib()
+        pack = self._pack if lib is not None else None
+        if pack is not None:
+            # Steady-state fast lane: the pack cache describes every
+            # sparse row, so the existing side's (rows, lens, pointers)
+            # come out of it in a few vectorized passes — no per-row
+            # dict walk, no per-chunk .ctypes pointer fetch.
+            p_pos, p_rows, p_bounds, p_base = pack
+            sel_i64 = sel_arr.astype(np.int64, copy=False)
+            idx = np.searchsorted(p_rows, sel_i64)
+            inb = idx < p_rows.size
+            exists = np.zeros(sel_i64.size, dtype=bool)
+            exists[inb] = p_rows[idx[inb]] == sel_i64[inb]
+            hit_idx = idx[exists]
+            starts = p_bounds[hit_idx]
+            a_rows_arr = sel_i64[exists]
+            a_lens_arr = p_bounds[hit_idx + 1] - starts
+            ptrs = (p_base + (starts << 2)).astype(np.uintp)
+            befores = np.zeros(sel_i64.size, dtype=np.int64)
+            befores[exists] = a_lens_arr
+            m_rows, m_pos, m_bounds_arr = self._merge_native_raw(
+                lib, a_rows_arr, a_lens_arr, ptrs,
+                int(a_lens_arr.sum()), b, clear, exp, len(sel_list),
+            )
+        else:
+            get = sparse.get
+            a_rows, a_chunks, a_lens = [], [], []
+            befores_l = []
+            for r in sel_list:
+                sp = get(r)
+                if sp is not None and sp.size:
+                    a_rows.append(r)
+                    a_chunks.append(sp)
+                    # len(sparse[r]) IS the maintained count for sparse
+                    # rows, so this single pass also yields the
+                    # before-counts.
+                    a_lens.append(sp.size)
+                    befores_l.append(sp.size)
+                else:
+                    befores_l.append(0)
+            if lib is not None:
+                m_rows, m_pos, m_bounds_arr = self._merge_native(
+                    lib, a_rows, a_chunks, a_lens, b, clear, exp,
+                    len(sel_list),
+                )
+            else:
+                m_rows, m_pos, m_bounds_arr = self._merge_np(
+                    a_rows, a_chunks, a_lens, b, clear, exp
+                )
+            befores = np.asarray(befores_l, dtype=np.int64)
+        # The merge is about to swap row views: the old pack no longer
+        # describes the store.  The fast path below rebuilds it when the
+        # result still covers every sparse row.
+        self._pack = None
+        lens = np.diff(m_bounds_arr)
+        if not clear and len(m_rows) == len(sel_list) and (
+            not lens.size or int(lens.max()) <= SPARSE_MAX
+        ):
+            # Union keeps every selected row (merged rows == sel rows in
+            # order) and nothing promoted: assign views + counts through
+            # C-speed dict.update, no per-row branches.
+            m_b = m_bounds_arr.tolist()
+            sparse.update(
+                zip(
+                    sel_list,
+                    (m_pos[m_b[j] : m_b[j + 1]] for j in range(len(sel_list))),
+                )
+            )
+            counts.update(zip(sel_list, lens.tolist()))
+            if sp_sel is None:
+                new_counts[:] = lens
+                changed[:] = lens - befores
+            else:
+                new_counts[sp_sel] = lens
+                changed[sp_sel] = lens - befores
+            if len(sparse) == len(sel_list):
+                # The merged views ARE the whole sparse store: cache the
+                # parent for the next merge's vectorized gather.
+                self._pack = (
+                    m_pos,
+                    sel_arr.astype(np.int64, copy=False),
+                    m_bounds_arr,
+                    m_pos.ctypes.data,
+                )
+            return
+        m_bounds = m_bounds_arr.tolist()
+        n_m = len(m_rows)
+        j = 0
+        sel_idx_iter = range(len(rows)) if sp_sel is None else sp_sel
+        for k, i in enumerate(sel_idx_iter):
+            r = sel_list[k]
+            before = befores[k]
+            if j < n_m and m_rows[j] == r:
+                seg = m_pos[m_bounds[j] : m_bounds[j + 1]]
+                j += 1
+            else:
+                seg = m_pos[:0]
+            n = seg.size
+            if n > SPARSE_MAX:
+                # Publish dense before dropping sparse (lock-free
+                # reader rule, same as set()).
+                self.dense[r] = densify(seg)
+                sparse.pop(r, None)
+            else:
+                sparse[r] = seg
+            counts[r] = n
+            new_counts[i] = n
+            changed[i] = abs(n - before)
+
+    @staticmethod
+    def _merge_np(a_rows, a_chunks, a_lens, b, clear, exp):
+        """Numpy merge backend (fallback + differential oracle): packs
+        the existing side into sorted int64 keys, merges (union) or
+        deletes (difference) against the sorted batch, and re-splits.
+        Returns ``(row_ids list, positions uint32, bounds int64)``."""
         if a_rows:
             a = np.repeat(
                 np.asarray(a_rows, dtype=np.int64) << exp, a_lens
@@ -393,56 +514,95 @@ class RowStore:
         else:
             m_starts = np.empty(0, dtype=np.int64)
         m_bounds_arr = np.append(m_starts, merged.size)
-        befores = np.asarray(befores_l, dtype=np.int64)
-        lens = np.diff(m_bounds_arr)
-        if not clear and len(m_starts) == len(sel_list) and (
-            not lens.size or int(lens.max()) <= SPARSE_MAX
-        ):
-            # Union keeps every selected row (merged rows == sel rows in
-            # order) and nothing promoted: assign views + counts through
-            # C-speed dict.update, no per-row branches.
-            m_b = m_bounds_arr.tolist()
-            sparse.update(
-                zip(
-                    sel_list,
-                    (m_pos[m_b[j] : m_b[j + 1]] for j in range(len(sel_list))),
-                )
+        return m_rowkeys[m_starts].tolist(), m_pos, m_bounds_arr
+
+    @staticmethod
+    def _merge_native(lib, a_rows, a_chunks, a_lens, b, clear, exp, n_sel):
+        """Native merge backend: ONE linear C pass over both sides
+        (native/sparse_merge.cpp) — the existing side's per-row arrays
+        feed the kernel through a pointer table, so no packed-key
+        materialization, searchsorted, or shifted-offset gymnastics.
+        Same output contract as ``_merge_np``.  ``a_chunks`` must stay
+        alive across the call (the caller's locals hold them)."""
+        a_rows_arr = np.asarray(a_rows, dtype=np.int64)
+        a_lens_arr = np.asarray(a_lens, dtype=np.int64)
+        # Per-row sparse arrays are always contiguous (created by
+        # np.insert/delete/unique or as slices of a merged parent).
+        ptrs = np.fromiter(
+            (c.ctypes.data for c in a_chunks), dtype=np.uintp,
+            count=len(a_rows),
+        )
+        return RowStore._merge_native_raw(
+            lib, a_rows_arr, a_lens_arr, ptrs, int(sum(a_lens)), b, clear,
+            exp, n_sel,
+        )
+
+    @staticmethod
+    def _merge_native_raw(
+        lib, a_rows_arr, a_lens_arr, ptrs, na, b, clear, exp, n_sel
+    ):
+        nb = int(b.size)
+        n_a_rows = a_rows_arr.size
+        cap_pos = max(na + (0 if clear else nb), 1)
+        cap_rows = n_a_rows + n_sel + 1
+        pos_out = np.empty(cap_pos, dtype=np.uint32)
+        rows_out = np.empty(cap_rows, dtype=np.int64)
+        bounds_out = np.empty(cap_rows + 1, dtype=np.int64)
+        n_merged = ctypes.c_int64(0)
+        fn = lib.sm_diff_split if clear else lib.sm_union_split
+        nr = fn(
+            a_rows_arr.ctypes.data,
+            ptrs.ctypes.data,
+            a_lens_arr.ctypes.data,
+            n_a_rows,
+            b.ctypes.data,
+            nb,
+            int(exp),
+            bitops.SHARD_WIDTH - 1,
+            pos_out.ctypes.data,
+            rows_out.ctypes.data,
+            bounds_out.ctypes.data,
+            ctypes.byref(n_merged),
+        )
+        if nr < 0:  # bad args never happen in-tree; don't limp on
+            raise RuntimeError(f"sparse_merge kernel rejected args: {nr}")
+        m = n_merged.value
+        m_pos = pos_out[:m]
+        if m * 2 < cap_pos:
+            # Don't let long-lived row views pin a >2x-oversized parent.
+            m_pos = m_pos.copy()
+        return rows_out[:nr].tolist(), m_pos, bounds_out[: nr + 1]
+
+    @staticmethod
+    def _apply_dense(d: np.ndarray, pos: np.ndarray, clear: bool) -> int:
+        """Apply sorted unique in-row positions to a dense word vector in
+        place; returns the signed cardinality delta.  Native single pass
+        when available (popcounts only the touched words), numpy
+        reduceat fallback with identical semantics."""
+        lib = _merge_lib()
+        if lib is not None:
+            delta = lib.sm_apply_dense(
+                d.ctypes.data, WORDS64, pos.ctypes.data, pos.size,
+                1 if clear else 0,
             )
-            counts.update(zip(sel_list, lens.tolist()))
-            if sp_sel is None:
-                new_counts[:] = lens
-                changed[:] = lens - befores
-            else:
-                new_counts[sp_sel] = lens
-                changed[sp_sel] = lens - befores
-            return
-        m_rows = m_rowkeys[m_starts].tolist()
-        m_bounds = m_bounds_arr.tolist()
-        n_m = len(m_rows)
-        j = 0
-        sel_idx_iter = range(len(rows)) if sp_sel is None else sp_sel
-        for k, i in enumerate(sel_idx_iter):
-            r = sel_list[k]
-            before = befores[k]
-            if j < n_m and m_rows[j] == r:
-                seg = m_pos[m_bounds[j] : m_bounds[j + 1]]
-                j += 1
-            else:
-                seg = m_pos[:0]
-            n = seg.size
-            if n > SPARSE_MAX:
-                # Publish dense before dropping sparse (lock-free
-                # reader rule, same as set()).
-                self.dense[r] = densify(seg)
-                sparse.pop(r, None)
-            else:
-                sparse[r] = seg
-            counts[r] = n
-            new_counts[i] = n
-            changed[i] = abs(n - before)
+            if delta != _ERR_RANGE:
+                return int(delta)
+        widx = (pos >> np.uint32(6)).astype(np.int64)
+        starts = np.flatnonzero(np.r_[True, widx[1:] != widx[:-1]])
+        uw = widx[starts]
+        deltas = np.bitwise_or.reduceat(
+            _ONE << (pos.astype(np.uint64) & _M63), starts
+        )
+        pc_before = bitops.popcount_np(d[uw])
+        if clear:
+            d[uw] &= ~deltas
+        else:
+            d[uw] |= deltas
+        return int(bitops.popcount_np(d[uw]) - pc_before)
 
     def set_dense(self, row_id: int, words: np.ndarray) -> int:
         """Overwrite a row with a dense uint64 word vector (SetRow path)."""
+        self._pack = None
         self.sparse.pop(row_id, None)
         self.dense[row_id] = words
         n = bitops.popcount_np(words)
@@ -452,6 +612,7 @@ class RowStore:
     def drop(self, row_id: int) -> bool:
         """Remove a row; True only if it actually held bits."""
         had = self.counts.get(row_id, 0) > 0
+        self._pack = None
         self.sparse.pop(row_id, None)
         self.dense.pop(row_id, None)
         self.counts[row_id] = 0
@@ -497,7 +658,13 @@ class RowStore:
 
     def compact(self) -> None:
         """Demote dense rows that shrank below the hysteresis threshold."""
-        for r in [r for r, d in self.dense.items() if self.counts.get(r, 0) <= DEMOTE_AT]:
+        demote = [
+            r for r, d in self.dense.items()
+            if self.counts.get(r, 0) <= DEMOTE_AT
+        ]
+        if demote:
+            self._pack = None
+        for r in demote:
             pos = bitops.words_to_positions(self.dense[r].view("<u4")).astype(
                 np.uint32
             )

@@ -3,7 +3,15 @@
 The reference's performance-critical host code is Go with unsafe casts
 (roaring/roaring.go:934-944); here it is C++ compiled on demand with the
 system toolchain.  Import never fails: when no compiler is available the
-callers fall back to the pure-NumPy paths.
+callers fall back to the pure-NumPy paths, which are retained as the
+differential oracles (tests/test_native_codec.py,
+tests/test_native_merge.py).  ``scripts/build_native.sh`` compiles both
+libraries ahead of time (with an ``--asan`` mode for debugging).
+
+Two libraries share the loader:
+- ``roaring_codec``  — fragment-file decode/encode (PR 5);
+- ``sparse_merge``   — the bulk-ingest sorted-merge + dense-apply kernels
+  (docs/ingest.md); disable with ``PILOSA_NATIVE_MERGE=0``.
 """
 
 from __future__ import annotations
@@ -14,15 +22,13 @@ import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "roaring_codec.cpp")
-_LIB = os.path.join(_HERE, "libroaring_codec.so")
 
 _lock = threading.Lock()
-_lib = None
-_tried = False
+# name -> loaded CDLL | None; presence means a load was attempted.
+_libs: dict = {}
 
 
-def _build() -> bool:
+def _build(src: str, lib: str) -> bool:
     cmd = [
         "g++",
         "-O3",
@@ -31,8 +37,8 @@ def _build() -> bool:
         "-fPIC",
         "-std=c++17",
         "-o",
-        _LIB,
-        _SRC,
+        lib,
+        src,
     ]
     try:
         subprocess.run(
@@ -43,39 +49,109 @@ def _build() -> bool:
         return False
 
 
-def load():
-    """The codec library, building it on first use; None if unavailable."""
-    global _lib, _tried
+def _load(name: str, configure) -> ctypes.CDLL | None:
+    """Get-or-build-or-fail ``lib<name>.so``; ``configure(lib)`` checks
+    the ABI stamp and sets prototypes, returning False to reject."""
     with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        stale = not os.path.exists(_LIB) or os.path.getmtime(
-            _LIB
-        ) < os.path.getmtime(_SRC)
-        if stale and not _build():
+        if name in _libs:
+            return _libs[name]
+        _libs[name] = None  # one attempt per process
+        src = os.path.join(_HERE, name + ".cpp")
+        libpath = os.path.join(_HERE, "lib" + name + ".so")
+        stale = not os.path.exists(libpath) or os.path.getmtime(
+            libpath
+        ) < os.path.getmtime(src)
+        if stale and not _build(src, libpath):
             return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(libpath)
         except OSError:
             return None
-        lib.rc_abi_version.restype = ctypes.c_int32
-        if lib.rc_abi_version() != 1:
+        if not configure(lib):
             return None
-        lib.rc_deserialize.restype = ctypes.c_int64
-        lib.rc_deserialize.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_size_t,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.rc_serialize.restype = ctypes.c_int64
-        lib.rc_serialize.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-        ]
-        _lib = lib
-        return _lib
+        _libs[name] = lib
+        return lib
+
+
+def _configure_codec(lib) -> bool:
+    lib.rc_abi_version.restype = ctypes.c_int32
+    if lib.rc_abi_version() != 1:
+        return False
+    lib.rc_deserialize.restype = ctypes.c_int64
+    lib.rc_deserialize.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.rc_serialize.restype = ctypes.c_int64
+    lib.rc_serialize.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    return True
+
+
+def _configure_merge(lib) -> bool:
+    lib.sm_abi_version.restype = ctypes.c_int32
+    if lib.sm_abi_version() != 1:
+        return False
+    split_args = [
+        ctypes.c_void_p,  # a_rows (int64*)
+        ctypes.c_void_p,  # a_ptrs (const uint32* const*)
+        ctypes.c_void_p,  # a_lens (int64*)
+        ctypes.c_int64,   # a_nrows
+        ctypes.c_void_p,  # b (int64*)
+        ctypes.c_int64,   # nb
+        ctypes.c_int32,   # exp
+        ctypes.c_uint32,  # mask
+        ctypes.c_void_p,  # pos_out (uint32*)
+        ctypes.c_void_p,  # rows_out (int64*)
+        ctypes.c_void_p,  # bounds_out (int64*)
+        ctypes.POINTER(ctypes.c_int64),  # n_merged
+    ]
+    for fn in (lib.sm_union_split, lib.sm_diff_split):
+        fn.restype = ctypes.c_int64
+        fn.argtypes = split_args
+    lib.sm_apply_dense.restype = ctypes.c_int64
+    lib.sm_apply_dense.argtypes = [
+        ctypes.c_void_p,  # words (uint64*)
+        ctypes.c_int64,   # n_words
+        ctypes.c_void_p,  # pos (uint32*)
+        ctypes.c_int64,   # n
+        ctypes.c_int32,   # clear
+    ]
+    lib.sm_shard_split.restype = ctypes.c_int64
+    lib.sm_shard_split.argtypes = [
+        ctypes.c_void_p,  # cols (int64*)
+        ctypes.c_void_p,  # rows (int64*)
+        ctypes.c_int64,   # n
+        ctypes.c_int32,   # exp
+        ctypes.c_int64,   # max_shards
+        ctypes.c_void_p,  # cols_out
+        ctypes.c_void_p,  # rows_out
+        ctypes.c_void_p,  # shard_ids_out
+        ctypes.c_void_p,  # bounds_out
+    ]
+    return True
+
+
+def load():
+    """The roaring codec library, building it on first use; None if
+    unavailable."""
+    return _load("roaring_codec", _configure_codec)
+
+
+def load_merge():
+    """The sparse-merge library (``PILOSA_NATIVE_MERGE=0`` disables it);
+    None when disabled or unavailable — callers take the numpy path."""
+    if os.environ.get("PILOSA_NATIVE_MERGE", "1").lower() in (
+        "0",
+        "false",
+        "no",
+    ):
+        return None
+    return _load("sparse_merge", _configure_merge)

@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..api import API, ApiError, ImportRequest, ImportValueRequest, NotFoundError, QueryRequest
+from ..core import cache as cache_mod
 from ..executor.executor import Error as ExecError, FieldNotFoundError, IndexNotFoundError
 from ..executor.translate import TranslateError
 from ..pql import ParseError
@@ -615,6 +616,9 @@ class Handler:
             self.server.refresh_gauges()
         elif self.admission is not None:
             self.admission.refresh_gauges()
+        # TopN rank-cache maintenance gauges (entries per cache type):
+        # summed over live fragment caches at pull time (docs/ingest.md).
+        cache_mod.refresh_entries_gauges()
         return REGISTRY.prometheus_text()
 
     def _metrics(self, q, b, **kw):
@@ -791,6 +795,10 @@ class Handler:
             out["server"] = self.server.snapshot()
         elif self.admission is not None:
             out["server"] = {"admission": self.admission.snapshot()}
+        # Rank-cache maintenance gauges refresh before the registry
+        # snapshot so pilosa_cache_entries{cache_type} is current here
+        # exactly as it is at /metrics.
+        cache_mod.refresh_entries_gauges()
         # The histogram registry's JSON view: same data /metrics serves,
         # merged here so one curl shows counters + stages + quantiles.
         out["metrics"] = REGISTRY.snapshot()

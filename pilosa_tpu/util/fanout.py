@@ -20,10 +20,18 @@ DEFAULT_IMPORT_FANOUT = 8
 
 
 def fanout_width(n_tasks: int) -> int:
-    try:
-        cap = int(os.environ.get("PILOSA_IMPORT_FANOUT", DEFAULT_IMPORT_FANOUT))
-    except ValueError:
-        cap = DEFAULT_IMPORT_FANOUT
+    """Width cap: the env value verbatim when set; otherwise
+    min(DEFAULT, cpu_count) — oversubscribing threads past the cores
+    measurably HURTS the import path (the python glue between the
+    GIL-releasing numpy/native kernels thrashes under contention)."""
+    env = os.environ.get("PILOSA_IMPORT_FANOUT")
+    if env is not None:
+        try:
+            cap = int(env)
+        except ValueError:
+            cap = DEFAULT_IMPORT_FANOUT
+    else:
+        cap = min(DEFAULT_IMPORT_FANOUT, os.cpu_count() or 1)
     return max(1, min(cap, n_tasks))
 
 

@@ -341,6 +341,17 @@ METRIC_INGEST_SYNC_COALESCED = "pilosa_ingest_sync_coalesced_total"
 METRIC_INGEST_SYNC_DISPATCHES = "pilosa_ingest_sync_dispatches_total"
 INGEST_PATHS = ("bits", "values", "roaring")
 
+# -- TopN rank-cache maintenance (docs/ingest.md) ---------------------------
+#   pilosa_cache_recalculate_seconds{path=} histogram: ranked-cache
+#                                           recalculation latency
+#                                           (full | merge — the incremental
+#                                           sorted-batch path)
+#   pilosa_cache_entries{cache_type=}       gauge: live cache entries summed
+#                                           over every fragment cache of
+#                                           that type (pull-time refresh)
+METRIC_CACHE_RECALC = "pilosa_cache_recalculate_seconds"
+METRIC_CACHE_ENTRIES = "pilosa_cache_entries"
+
 PIPELINE_STAGES = ("queue_wait", "lower_dispatch", "device_readback", "decode")
 
 # -- serving tier (docs/serving.md) -----------------------------------------
@@ -463,6 +474,14 @@ REGISTRY.counter(
     METRIC_INGEST_SYNC_DISPATCHES,
     help="Warm-sync passes the ingest sync worker ran",
 )
+for _path in ("full", "merge"):
+    REGISTRY.histogram(
+        METRIC_CACHE_RECALC,
+        help="Ranked-cache recalculation latency (seconds)",
+        path=_path,
+    )
+for _ct in ("ranked", "lru", "none"):
+    REGISTRY.set_gauge(METRIC_CACHE_ENTRIES, 0, cache_type=_ct)
 REGISTRY.set_gauge(METRIC_MESH_DEVICES, 0)
 REGISTRY.set_gauge(METRIC_MESH_LOCAL_DEVICES, 0)
 REGISTRY.set_gauge(METRIC_MESH_SHARDS_PER_DEVICE, 0)

@@ -62,6 +62,13 @@ AUTO_REQUIRE = (
     # required as soon as a baseline records it, so a later round cannot
     # silently drop the multi-device lane.
     "count_intersect_8B_cols_p50",
+    # The id-pairs ingest surface (native sparse merge) and the
+    # streaming write+read freshness SLO (bench.py --streaming-sweep).
+    # Mbits/s regresses DOWN, ms regresses UP — the unit-direction map
+    # above already applies; listing them here makes their ABSENCE a
+    # failure once a baseline records them (docs/ingest.md).
+    "ingest_bits_mbits_s",
+    "ingest_freshness_p50_ms",
 )
 
 

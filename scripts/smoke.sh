@@ -183,6 +183,47 @@ for line in text.splitlines():
 else:
     raise AssertionError("no pilosa_ingest_batches_total{path=roaring} sample")
 
+# Id-pairs ingest smoke: import -> query -> /metrics round trip — a JSON
+# id-pairs batch lands through the native sparse-merge path, a read of
+# the JUST-written bits reflects them immediately (freshness), and the
+# path="bits" ingest series + the rank-cache maintenance series moved
+# (docs/ingest.md).
+_r = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/field/f/import",
+    data=json.dumps(
+        {"rowIDs": [7, 7, 7, 8], "columnIDs": [11, 12, 70000, 11]}
+    ).encode(),
+    method="POST",
+)
+urllib.request.urlopen(_r, timeout=60).read()
+_r = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/query",
+    data=b"Count(Row(f=7))", method="POST",
+)
+assert json.loads(
+    urllib.request.urlopen(_r, timeout=60).read()
+)["results"][0] == 3, "fresh read of just-written id-pairs bits"
+
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+for line in text.splitlines():
+    if line.startswith("pilosa_ingest_batches_total") and 'path="bits"' in line:
+        assert float(line.rsplit(" ", 1)[1]) >= 1, line
+        break
+else:
+    raise AssertionError("no pilosa_ingest_batches_total{path=bits} sample")
+cache_required = [
+    'pilosa_cache_entries{cache_type="ranked"}',
+    "pilosa_cache_recalculate_seconds_bucket",
+]
+missing = [s for s in cache_required if s not in text]
+assert not missing, f"/metrics is missing cache series: {missing}"
+for line in text.splitlines():
+    if line.startswith('pilosa_cache_entries{cache_type="ranked"}'):
+        assert float(line.rsplit(" ", 1)[1]) >= 1, line
+        break
+
 # The root span registers from a completion callback moments after the
 # response is written; poll briefly instead of racing it.
 import time

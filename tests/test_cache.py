@@ -46,3 +46,67 @@ def test_new_cache_types():
 def test_merge_pairs():
     merged = merge_pairs([[(1, 5), (2, 3)], [(1, 2), (3, 9)]])
     assert merged == [(3, 9), (1, 7), (2, 3)]
+
+
+def test_rank_cache_bulk_add_zero_clears():
+    """Regression: bulk_add(row, 0) must evict the entry even when the
+    admission threshold is positive (pre-fix it returned early and the
+    stale pair survived forever)."""
+    c = RankCache(3, debounce_seconds=0)
+    for i in range(10):
+        c.bulk_add(i, i + 1)
+    c.recalculate()
+    assert c.threshold_value == 7
+    c.bulk_add(9, 0)
+    c.recalculate()
+    assert c.get(9) == 0
+    assert all(rid != 9 for rid, _ in c.top())
+
+
+def test_rank_cache_bulk_update_zero_clears():
+    import numpy as np
+
+    c = RankCache(3, debounce_seconds=0)
+    for i in range(10):
+        c.bulk_add(i, i + 1)
+    c.recalculate()
+    c.bulk_update(np.array([8, 9]), np.array([0, 12]))
+    c.recalculate()
+    assert c.get(8) == 0 and c.get(9) == 12
+    assert all(rid != 8 for rid, _ in c.top())
+
+
+def test_rank_cache_bulk_update_threshold_mask():
+    import numpy as np
+
+    c = RankCache(3, debounce_seconds=0)
+    for i in range(10):
+        c.bulk_add(i, i + 1)
+    c.recalculate()  # threshold 7
+    c.bulk_update(np.array([100, 101]), np.array([3, 20]))
+    c.recalculate()
+    assert c.get(100) == 0  # below threshold: masked out
+    assert c.get(101) == 20
+
+
+def test_rank_cache_len_is_non_mutating():
+    """len() must be side-effect-free: /metrics scrapes call it off the
+    fragment lock (refresh_entries_gauges), so folding the scalar
+    overlay there would race locked writers.  It still has to count the
+    overlay — pending inserts, in-place updates, and zero-pops."""
+    c = RankCache(10, debounce_seconds=1e9)  # debounce: adds stay in overlay
+    for i in range(5):
+        c.add(i, i + 1)
+    assert len(c) == 5
+    assert c._extra and c._ids.size == 0  # overlay NOT flushed by len()
+    c.recalculate()
+    assert len(c) == 5 and not c._extra
+    c.add(2, 9)  # in-place update: no size change
+    c.add(7, 8)  # fresh insert: +1
+    c.add(0, 0)  # zero-pop of a stored entry: -1
+    c.add(99, 0)  # zero-pop of nothing: no change
+    before = dict(c._extra)
+    assert len(c) == 5
+    assert c._extra == before  # still not flushed
+    c.recalculate()
+    assert len(c) == 5

@@ -469,3 +469,104 @@ def test_bench_guard_auto_requires_ingest_metric(tmp_path):
         capture_output=True, text=True, cwd="/root/repo",
     )
     assert rc.returncode == 0, rc.stderr
+
+
+def test_bench_guard_auto_requires_streaming_headlines(tmp_path):
+    """The id-pairs surface and the freshness SLO auto-require once a
+    baseline records them, with correct polarity (Mbits/s regresses
+    DOWN, ms regresses UP)."""
+    import subprocess
+    import sys
+
+    base = tmp_path / "base.jsonl"
+    cur = tmp_path / "cur.jsonl"
+    base.write_text(
+        '{"metric": "ingest_bits_mbits_s", "value": 9.0, "unit": "Mbits/s"}\n'
+        '{"metric": "ingest_freshness_p50_ms", "value": 20.0, "unit": "ms"}\n'
+    )
+
+    def run():
+        return subprocess.run(
+            [sys.executable, "scripts/bench_guard.py", str(cur),
+             "--baseline", str(base)],
+            capture_output=True, text=True, cwd="/root/repo",
+        )
+
+    # Missing from the new run -> both required -> fail, both named.
+    cur.write_text('{"metric": "other", "value": 1.0, "unit": "us"}\n')
+    rc = run()
+    assert rc.returncode == 1
+    assert "ingest_bits_mbits_s" in rc.stderr
+    assert "ingest_freshness_p50_ms" in rc.stderr
+    # Throughput down / freshness up beyond tolerance -> fail.
+    cur.write_text(
+        '{"metric": "ingest_bits_mbits_s", "value": 4.0, "unit": "Mbits/s"}\n'
+        '{"metric": "ingest_freshness_p50_ms", "value": 60.0, "unit": "ms"}\n'
+    )
+    rc = run()
+    assert rc.returncode == 1
+    assert "ingest_bits_mbits_s" in rc.stderr
+    assert "ingest_freshness_p50_ms" in rc.stderr
+    # Throughput UP and freshness DOWN are improvements -> pass.
+    cur.write_text(
+        '{"metric": "ingest_bits_mbits_s", "value": 30.0, "unit": "Mbits/s"}\n'
+        '{"metric": "ingest_freshness_p50_ms", "value": 5.0, "unit": "ms"}\n'
+    )
+    rc = run()
+    assert rc.returncode == 0, rc.stderr
+
+
+def test_cluster_import_bits_accepts_numpy_arrays(tmp_path):
+    """The cluster fan-out paths must serialize numpy inputs: the
+    per-shard slices go through InternalClient's json.dumps, which
+    rejects np.int64 scalars — list(ndarray) kept them, .tolist()
+    converts (arrays are the documented import-request surface).
+    Covers bits (ids + timestamps) and values."""
+    from pilosa_tpu.api import ImportRequest, ImportValueRequest
+    from pilosa_tpu.core.field import FieldOptions
+    from harness import run_cluster
+
+    h = run_cluster(tmp_path, 2)
+    try:
+        client = h.client(0)
+        client.create_index("npi")
+        client.create_field("npi", "f")
+        cols = np.array(
+            [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3, 5 * SHARD_WIDTH + 4],
+            dtype=np.int64,
+        )
+        rows = np.full(cols.size, 10, dtype=np.int64)
+        # In-process API call with arrays while a cluster is attached:
+        # some shard groups fan out over HTTP to node 1.
+        h[0].api.import_bits(
+            ImportRequest("npi", "f", row_ids=rows, column_ids=cols)
+        )
+        res = client.query("npi", "Count(Row(f=10))")
+        assert res["results"][0] == cols.size
+        # time field + numpy timestamps ride the same fan-out
+        h[0].api.create_field(
+            "npi", "t", FieldOptions(type="time", time_quantum="YMD")
+        )
+        ts = np.full(cols.size, 1136188800000000000, dtype=np.int64)
+        h[0].api.import_bits(
+            ImportRequest(
+                "npi", "t", row_ids=rows, column_ids=cols, timestamps=ts
+            )
+        )
+        assert client.query("npi", "Count(Row(t=10))")["results"][0] == (
+            cols.size
+        )
+        # int field + numpy values
+        h[0].api.create_field(
+            "npi", "v", FieldOptions(type="int", min=0, max=255)
+        )
+        h[0].api.import_values(
+            ImportValueRequest(
+                "npi", "v", column_ids=cols,
+                values=np.full(cols.size, 7, dtype=np.int64),
+            )
+        )
+        out = client.query("npi", "Sum(field=v)")["results"][0]
+        assert out == {"value": 7 * cols.size, "count": cols.size}
+    finally:
+        h.close()
