@@ -57,6 +57,7 @@ Methodology, stated plainly:
 """
 
 import json
+import math
 import statistics
 import time
 
@@ -1677,6 +1678,138 @@ def streaming_sweep():
     holder.close()
 
 
+# ---- plan-recording overhead (--profile-overhead) -------------------------
+
+OVH_SHARDS = 8
+OVH_P50_REPS = 48  # wall p50 of the real query (denominator)
+OVH_REPLAY_N = 20000  # total replays of the plan sequence (numerator)
+OVH_REPLAY_LOOPS = 8  # numerator = best (min) mean over this many loops
+
+
+def profile_overhead_bench():
+    """--profile-overhead: plan-recording overhead on the
+    count_intersect-shaped hot path (docs/observability.md "Query plans
+    & cost attribution").
+
+    Estimator design note: a wall-clock A/B (plans on vs off around the
+    same api.query) CANNOT resolve this on the bench container — the
+    per-dispatch transport jitter is 0.1-3ms (the same reason
+    device_p50 exists) and a null test of paired/blocked A/B estimators
+    read -1%..+9% when the true delta was ZERO; process_time is
+    quantized at ~15ms here.  So the two factors are measured where
+    each is measurable: (numerator) the plan layer's per-query host
+    cost, by replaying the EXACT record sequence a real profiled
+    count_intersect query just produced — begin/attach, the dispatch
+    notes with the real decision fields, op/stage/device stamps,
+    finish, ring+ledger record — as the best (min) per-replay mean over
+    several tight loops (a single loop wobbles 2-3x when a GC pause or
+    preemption lands inside it; the min estimates the undisturbed cost,
+    slightly optimistic on cache effects, slightly pessimistic on
+    branch warmth); (denominator) the wall p50 of the real query with
+    plans ON, the shipping config.  Emits
+    count_intersect_plans_on_p50, plan_record_us, and
+    profile_overhead_pct = plan_record_us / p50 (target <2%;
+    bench_guard holds the line once a baseline records it)."""
+    progress("importing jax (profile overhead)")
+    import jax
+
+    from pilosa_tpu.api import API, QueryRequest
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.ops import bitops
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+    from pilosa_tpu.util import plans
+
+    rng = np.random.default_rng(11)
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("ovh")
+    f = idx.create_field("f")
+    view = f.view_if_not_exists("standard")
+    shards = list(range(OVH_SHARDS))
+    for s in shards:
+        frag = view.fragment_if_not_exists(s)
+        for r in (0, 1):
+            frag.load_row_words(r, __rand(rng, bitops.WORDS64))
+    for frag in view.fragments.values():
+        frag.cache.invalidate()
+    progress("overhead build done")
+
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh)
+    eng.result_memo.maxsize = 0  # every rep must dispatch
+    api = API(holder=holder, mesh_engine=eng)
+    req = QueryRequest("ovh", "Count(Intersect(Row(f=0), Row(f=1)))")
+    want = int(api.query(req).results[0])  # warm the compile caches
+    assert int(api.query(req).results[0]) == want
+
+    # Denominator: real-query wall p50, plans ON (the shipping config).
+    p50, resp = sync_p50(lambda i: api.query(req), reps=OVH_P50_REPS)
+    assert int(resp.results[0]) == want
+
+    # Numerator: replay the EXACT record sequence the query above just
+    # produced.  Take the recorded plan (the ring keeps it) and drive
+    # the same calls the engine/batcher made — note_dispatch with the
+    # real decision fields (split as the engine publishes them: the
+    # occupancy verdict from _sparse_plan, then the path/bytes fields
+    # from the dispatch), note-claim + op stamp, the stage/device
+    # stamps, finish, ring + tenant-ledger record.
+    real = plans.STORE.find(resp.trace_id)
+    assert real is not None, "query plan not recorded (PILOSA_PLANS=0?)"
+    op_fields = dict(real.ops[0]) if real.ops else {"op": "Count",
+                                                    "path": "direct"}
+    occ = {
+        k: op_fields.pop(k)
+        for k in ("blocks_surviving", "blocks_total", "occ_fraction",
+                  "threshold")
+        if k in op_fields
+    }
+    stage_events = list(real._stage_events)
+    dur = real.duration or p50
+    trace_id = resp.trace_id or "bench"
+
+    def replay():
+        p = plans.begin("ovh", req.query)
+        with plans.attach(p):
+            if occ:
+                plans.note_dispatch(**occ)
+            plans.note_dispatch(**op_fields)
+            note = plans.take_dispatch_note()
+            p.note_op(**note)
+            for st, s in stage_events:
+                p.note_stage(st, s)
+            p.finish(dur, trace_id=trace_id)
+        plans.record(p)
+
+    for _ in range(OVH_REPLAY_N // 10):  # warm branches/allocator
+        replay()
+    # Best-of-K loops: a single tight loop still wobbles 2-3x run to
+    # run on this container (GC pauses, allocator growth, scheduler
+    # preemption land INSIDE one loop and inflate its mean); the
+    # minimum over several loops is the standard microbench estimator
+    # for the undisturbed cost, and it is what the guarded
+    # profile_overhead_pct headline must be stable over.
+    loop_n = max(1, OVH_REPLAY_N // OVH_REPLAY_LOOPS)
+    best = math.inf
+    for _ in range(OVH_REPLAY_LOOPS):
+        t0 = time.perf_counter()
+        for _ in range(loop_n):
+            replay()
+        best = min(best, (time.perf_counter() - t0) / loop_n)
+    plan_record = best
+
+    overhead_pct = plan_record / p50 * 100.0
+    c_cpu = cpu_time(lambda: api.query(req))
+    emit("count_intersect_plans_on_p50", p50, c_cpu)
+    emit_raw("plan_record_us", plan_record * 1e6, "us", 1.0)
+    emit_raw("profile_overhead_pct", overhead_pct, "pct", 1.0)
+    progress(
+        f"plan-recording overhead: record {plan_record * 1e6:.2f}us / "
+        f"query p50 {p50 * 1e6:.1f}us = {overhead_pct:.3f}% (target <2%)"
+    )
+    eng.close()
+    holder.close()
+
+
 def force_cpu_host_devices(n):
     """Pin the CPU platform with ``n`` virtual host devices.  Must run
     BEFORE jax initializes a backend (the __main__ pre-import window);
@@ -1960,6 +2093,16 @@ if __name__ == "__main__":
         "is ~8.05B columns — else 24 for the CPU lane)",
     )
     ap.add_argument(
+        "--profile-overhead",
+        action="store_true",
+        help="run the plan-recording overhead micro-mode ONLY: replays "
+        "the exact plan-record sequence a real count_intersect-shaped "
+        "Count produced in a tight loop over the query's wall p50, "
+        "emitting count_intersect_plans_on_p50, plan_record_us, and "
+        "profile_overhead_pct (target <2%%; guarded by bench_guard once "
+        "baselined — docs/observability.md)",
+    )
+    ap.add_argument(
         "--scrape",
         action="store_true",
         help="append the post-run /metrics device gauges (resident "
@@ -1975,6 +2118,8 @@ if __name__ == "__main__":
             args.multichip,
             shards_per_device=args.multichip_shards_per_device,
         )
+    elif args.profile_overhead:
+        profile_overhead_bench()
     elif args.ingest_sweep:
         ingest_sweep()
     elif args.streaming_sweep:

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import __version__, pql
-from .util import fanout, tracing
+from .util import fanout, plans, tracing
 from .util.stats import (
     INGEST_PATHS,
     METRIC_INGEST_BATCHES,
@@ -60,6 +60,8 @@ class QueryRequest:
         exclude_columns: bool = False,
         remote: bool = False,
         trace_context=None,
+        profile: bool = False,
+        tenant: str = "default",
     ):
         self.index = index
         self.query = query
@@ -72,6 +74,12 @@ class QueryRequest:
         # the handler sets it so a remote fan-out joins the caller's
         # trace instead of rooting a fresh one.
         self.trace_context = trace_context
+        # ?profile=1: return the recorded QueryPlan inline with the
+        # response (docs/observability.md); ``tenant`` keys the plan's
+        # cost-ledger attribution (X-Pilosa-Tenant, else the index name
+        # — the same key admission fairness uses).
+        self.profile = profile
+        self.tenant = tenant or "default"
 
 
 class ImportRequest:
@@ -246,12 +254,27 @@ class API:
         )
         start = time.monotonic()
         parent = getattr(req, "trace_context", None)
+        # Per-query plan record (util/plans.py): decisions stamp onto it
+        # from the executor/engine/batcher while the span carries the
+        # timing tree.  Remote replays are excluded — the initiator's
+        # plan already attributes the whole query, and a replay plan
+        # would double-charge the tenant ledger.
+        plan = None if req.remote else plans.begin(
+            req.index, req.query, tenant=getattr(req, "tenant", "default"),
+            profile=getattr(req, "profile", False),
+        )
         with self.tracer.start_span(
             "api.Query", parent=parent, index=req.index, remote=req.remote
-        ) as span:
+        ) as span, plans.attach(plan):
             resp = self.executor.execute(req.index, req.query, req.shards, opt)
         elapsed = time.monotonic() - start
-        self._h_query_sync.observe(elapsed)
+        trace_id = span.trace_id if span is not None else None
+        self._h_query_sync.observe(elapsed, exemplar=trace_id)
+        if plan is not None:
+            plan.finish(elapsed, trace_id=trace_id)
+            plans.record(plan)
+            if plan.profile:
+                resp.plan = plan.to_dict()
         if span is not None:
             resp.trace_id = span.trace_id
         # Long-query logging (api.go:1021, server LongQueryTime).
@@ -288,7 +311,13 @@ class API:
         span = self.tracer.begin(
             "api.Query", parent=parent, index=req.index, pipelined=True
         )
-        with tracing.attach(span):
+        plan = None if req.remote else plans.begin(
+            req.index, req.query, tenant=getattr(req, "tenant", "default"),
+            profile=getattr(req, "profile", False),
+        )
+        if plan is not None:
+            plan.pipelined = True
+        with tracing.attach(span), plans.attach(plan):
             fut = self.executor.execute_async(
                 req.index, req.query, req.shards, opt
             )
@@ -303,12 +332,21 @@ class API:
                     pass
             return None
         fut.trace_span = span
+        fut.query_plan = plan
 
         def _finish(_f):
             elapsed = time.monotonic() - start
             if span is not None:
                 span.finish()
-            self._h_query_pipelined.observe(elapsed)
+            if plan is not None:
+                plan.finish(
+                    elapsed,
+                    trace_id=span.trace_id if span is not None else None,
+                )
+                plans.record(plan)
+            self._h_query_pipelined.observe(
+                elapsed, exemplar=span.trace_id if span is not None else None
+            )
             if self.long_query_time and elapsed > self.long_query_time:
                 self.logger.printf(
                     "%.3fs > %.1fs: %s %s (trace %s)",
@@ -1226,6 +1264,13 @@ class API:
                 self._mesh_replay_readback(dev, payload)
             except Exception as e:
                 self.logger.printf("mesh replay failed: %s", e)
+            finally:
+                # Replayed dispatches publish plan notes like any engine
+                # dispatch, but no query on this thread ever claims them
+                # — the initiator's plan attributes the whole query.
+                # Drop the note so it can't accrue fields across
+                # unrelated replays in this long-lived thread's TLS.
+                plans.take_dispatch_note()
 
     def _mesh_replay_readback(self, dev, payload: dict):
         """Bounded wait for a replayed collective's result: a collective

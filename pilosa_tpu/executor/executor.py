@@ -45,6 +45,8 @@ def _op_hist(op: str):
 
 from .. import ops, pql
 from ..parallel.errors import PeerlessMeshError
+from ..util import plans as plans_mod
+from ..util import tracing as tracing_mod
 from ..core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_SET, FIELD_TYPE_TIME
 from ..core.fragment import SHARD_WIDTH
 from ..core import cache as cache_mod
@@ -234,7 +236,7 @@ class ColumnAttrSet:
 
 
 class QueryResponse:
-    __slots__ = ("results", "column_attr_sets", "trace_id")
+    __slots__ = ("results", "column_attr_sets", "trace_id", "plan")
 
     def __init__(self, results=None, column_attr_sets=None):
         self.results = results if results is not None else []
@@ -242,6 +244,9 @@ class QueryResponse:
         # Stamped by the API layer when tracing is on, surfaced as the
         # response's "traceID" so clients can join /debug/traces.
         self.trace_id: Optional[str] = None
+        # The recorded QueryPlan dict when the request asked ?profile=1
+        # (util/plans.py), surfaced as the response's "plan".
+        self.plan: Optional[dict] = None
 
 
 def _merge_row_ids(a: List[int], b: List[int], limit: int) -> List[int]:
@@ -341,13 +346,17 @@ class _QueryFuture:
         "_response",
         "_error",
         "_callbacks",
+        "_cb_lock",
+        "_draining",
         "_pending",
         "_lock",
         "trace_span",
+        "query_plan",
     )
 
     def __init__(self, executor, index, query, shards, opt, slots, items):
         self.trace_span = None  # set by api.query_async for stamping
+        self.query_plan = None  # set by api.query_async (util/plans.py)
         self._executor = executor
         self._index = index
         self._query = query
@@ -359,6 +368,8 @@ class _QueryFuture:
         self._response: Optional[QueryResponse] = None
         self._error: Optional[BaseException] = None
         self._callbacks: list = []
+        self._cb_lock = threading.Lock()
+        self._draining = False
         self._pending = len(items)
         self._lock = threading.Lock()
         if not items:
@@ -396,11 +407,21 @@ class _QueryFuture:
 
     def _resolve(self):
         self._event.set()
-        while self._callbacks:
-            try:
-                fn = self._callbacks.pop()
-            except IndexError:
-                break
+        # FIFO drain under _cb_lock: registration order is completion
+        # order, so api.query_async's _finish — which stamps and
+        # records the query plan — runs BEFORE the HTTP layer's payload
+        # callback that may embed that plan (?profile=1).  The
+        # _draining flag closes the race where a late registrant sees
+        # the event set while an earlier callback is still mid-flight
+        # on this thread and would otherwise run itself inline ahead of
+        # it; callbacks themselves run OUTSIDE the lock.
+        while True:
+            with self._cb_lock:
+                if not self._callbacks:
+                    self._draining = False
+                    break
+                self._draining = True
+                fn = self._callbacks.pop(0)
             try:
                 fn(self)
             except Exception:  # noqa: BLE001
@@ -410,15 +431,16 @@ class _QueryFuture:
         return self._event.is_set()
 
     def add_done_callback(self, fn):
-        """Run ``fn(self)`` on resolution (immediately if resolved);
-        same lock-free append-then-claim protocol as the batcher items."""
-        self._callbacks.append(fn)
-        if self._event.is_set():
-            try:
-                self._callbacks.remove(fn)
-            except ValueError:
+        """Run ``fn(self)`` on resolution — immediately when already
+        resolved AND fully drained; if the resolver is still draining
+        earlier callbacks, enqueue behind them instead (ordering is the
+        ?profile=1 contract: the plan recorder registered first must
+        finish before the payload encoder reads the plan)."""
+        with self._cb_lock:
+            if not self._event.is_set() or self._draining:
+                self._callbacks.append(fn)
                 return
-            fn(self)
+        fn(self)
 
     def result(self, timeout: Optional[float] = None) -> QueryResponse:
         if not self._event.wait(
@@ -773,7 +795,17 @@ class Executor:
             with self.tracer.start_span(f"executor.{c.name}", index=index):
                 return self._dispatch_call(index, c, shards, opt)
         finally:
-            _op_hist(c.name).observe(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            sp = tracing_mod.current_span()
+            _op_hist(c.name).observe(
+                dt, exemplar=sp.trace_id if sp is not None else None
+            )
+            # Per-op plan entry for the host-path ops (TopN, Sum,
+            # GroupBy, ...): Count's decision record is stamped by the
+            # engine/batcher seam with the real dispatch detail.
+            p = plans_mod.current_plan()
+            if p is not None and c.name not in ("Count", "Explain"):
+                p.note_op(op=c.name, seconds=round(dt, 6))
 
     def _dispatch_call(self, index: str, c: Call, shards, opt):
         self._validate_call_args(c)
@@ -806,6 +838,8 @@ class Executor:
             return self._execute_set_row(index, c, shards, opt)
         if name == "Count":
             return self._execute_count(index, c, shards, opt)
+        if name == "Explain":
+            return self._execute_explain(index, c, shards, opt)
         if name == "Set":
             return self._execute_set(index, c, opt)
         if name == "SetRowAttrs":
@@ -878,11 +912,19 @@ class Executor:
                 continue
             try:
                 self.remote_fanouts += 1
+                t_rpc = time.monotonic()
                 with self.tracer.start_span(
                     "executor.RemoteQuery", node=node_id, shards=len(node_shards)
                 ):
                     doc = self.cluster.client(node).query(
                         index, str(call), shards=node_shards, remote=True
+                    )
+                p = plans_mod.current_plan()
+                if p is not None:
+                    # Per-node fan-out latency attribution: the plan's
+                    # "which peer was slow" record.
+                    p.note_fanout(
+                        node_id, time.monotonic() - t_rpc, len(node_shards)
                     )
             except Exception:
                 # Retry this node's shards on other replicas.
@@ -1156,6 +1198,14 @@ class Executor:
                 raise Error("unexpected local shard in fused count")
 
             remote = [s for s in shards if s not in local_shards]
+            if remote:
+                p = plans_mod.current_plan()
+                if p is not None:
+                    p.note_op(
+                        op="Count", path="fanout_split",
+                        local_shards=len(local_shards),
+                        remote_shards=len(remote),
+                    )
             result = (
                 self.map_reduce(
                     index,
@@ -1170,10 +1220,65 @@ class Executor:
             )
             return (result or 0) + fused_count
 
+        # No fused local dispatch (engine absent, not lowerable, or no
+        # locally-owned shards): the whole Count runs through the
+        # host-loop / remote fan-out map-reduce.  Record the
+        # coordinator-side split so the plan still names a path — the
+        # per-peer RPC latencies land via map_reduce's note_fanout.
+        p = plans_mod.current_plan()
+        if p is not None:
+            if self.cluster is not None:
+                local = set(self._local_shards(index, shards, opt.remote))
+            else:
+                local = set(shards)
+            n_local = sum(1 for s in shards if s in local)
+            n_remote = len(shards) - n_local
+            p.note_op(
+                op="Count", path="fanout" if n_remote else "host",
+                local_shards=n_local, remote_shards=n_remote,
+            )
         result = self.map_reduce(
             index, shards, c, opt, map_fn, lambda p, v: (p or 0) + v
         )
         return result or 0
+
+    def _execute_explain(self, index, c: Call, shards, opt) -> dict:
+        """``Explain(<query>)``: plan WITHOUT dispatching (the EXPLAIN /
+        dry-run half of docs/observability.md "Query plans & cost
+        attribution").  Reports the path the real execution would take —
+        fast-cardinality lane, memo, occupancy-guided sparse vs dense
+        (projected from exact host-side fragment occupancy), or the host
+        loop — plus shard locality, touching neither the device nor the
+        memo contents."""
+        if len(c.children) != 1:
+            raise Error("Explain() requires a single query input")
+        child = c.children[0]
+        doc: dict = {"dryRun": True, "query": str(child)}
+        target = child
+        if child.name == "Count" and len(child.children) == 1:
+            target = child.children[0]
+            inner = target
+            doc["fastCardinalityEligible"] = bool(
+                inner.name == "Row" and not inner.children
+                and len(inner.args) == 1
+                and isinstance(next(iter(inner.args.values()), None), int)
+                and not isinstance(next(iter(inner.args.values()), None), bool)
+            )
+        if self.cluster is not None:
+            local = set(self._local_shards(index, shards, opt.remote))
+            doc["localShards"] = sum(1 for s in shards if s in local)
+            doc["remoteShards"] = sum(1 for s in shards if s not in local)
+        else:
+            doc["localShards"] = len(shards)
+            doc["remoteShards"] = 0
+        eng = self.mesh_engine
+        if eng is None:
+            doc.update(op=child.name, plannedPath="host", lowerable=False)
+            return doc
+        doc.update(eng.explain_count(index, target, shards))
+        if doc.get("remoteShards"):
+            doc["plannedPath"] = f"{doc.get('plannedPath', 'dense')}+fanout"
+        return doc
 
     def _count_from_cardinalities(self, index, child: Call, shards, remote=False):
         """O(1)-per-shard Count of an unfiltered Row: sum the maintained
@@ -1196,6 +1301,11 @@ class Executor:
             if any(s not in local for s in shards):
                 return None
         view = f.view(VIEW_STANDARD)
+        p = plans_mod.current_plan()
+        if p is not None:
+            # This lane WILL answer (every gate passed): O(1) host-side
+            # cardinality sum, zero device work.
+            p.note_op(op="Count", path="fast_cardinality")
         if view is None:
             return 0
         frags = view.fragments  # resolve once, not per shard
@@ -1268,6 +1378,15 @@ class Executor:
                 return None  # remote shards: the per-call path splits
         results: list = [None] * len(children)
         rem_idx, rem_calls = [], []
+        plan = plans_mod.current_plan()
+        # Where this query's plan op list stood before the peel: on a
+        # decline the per-call fallback re-executes EVERY call (stamping
+        # its own fast_cardinality ops), so the peel pass's stamps must
+        # be unwound or each peeled Count appears twice in the plan.
+        # Safe: the whole batch attempt runs on this one thread and no
+        # item of this query is in the batcher yet, so nothing else can
+        # have appended ops since the mark.
+        ops_mark = len(plan.ops) if plan is not None else 0
         for k, ch in enumerate(children):
             fast = self._count_from_cardinalities(index, ch, shards)
             if fast is not None:
@@ -1276,12 +1395,33 @@ class Executor:
                 rem_idx.append(k)
                 rem_calls.append(ch)
         if rem_calls:
+            t0 = time.monotonic()
             try:
-                counts = self.mesh_engine.count_many(
-                    index, rem_calls, [list(shards)] * len(rem_calls)
-                )
+                try:
+                    counts = self.mesh_engine.count_many(
+                        index, rem_calls, [list(shards)] * len(rem_calls)
+                    )
+                finally:
+                    # Claim the note on EVERY exit: a half-written note
+                    # left in this pooled thread's TLS would be merged
+                    # into the next unrelated query's dispatch record.
+                    note = plans_mod.take_dispatch_note()
             except (PeerlessMeshError, ValueError):
+                if plan is not None:
+                    del plan.ops[ops_mark:]
                 return None
+            # The consecutive-Count batch dispatched on THIS thread:
+            # stamp the claimed note once per fused call.  The blocking
+            # dispatch+readback is the query's one "execute" stage and
+            # its whole device attribution (same accounting as the
+            # batcher's direct path).
+            elapsed = time.monotonic() - t0
+            if plan is not None and note is not None:
+                d = plans_mod.rider_note(note, len(rem_calls))
+                for _ in rem_calls:
+                    plan.note_op(**d)
+                plan.note_stage("execute", elapsed)
+                plan.note_device_seconds(elapsed)
             for k, v in zip(rem_idx, counts):
                 results[k] = v
         self.stats.count("Count", len(calls), tags=[f"index:{index}"])

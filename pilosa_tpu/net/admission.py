@@ -108,6 +108,13 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._inflight = 0
         self._tenants: Dict[str, int] = {}
+        # tenant -> EWMA device-seconds per query, fed by the tenant
+        # ledger (util/plans.py LEDGER.bind_admission): fairness prices
+        # a tenant's MEASURED cost, so ten heavy dense sweeps occupy as
+        # much share as a hundred memo hits.  Empty until plans flow —
+        # with no cost signal the check degrades to pure request count
+        # (the pre-ledger behavior, byte-for-byte).
+        self._cost: Dict[str, float] = {}
         # Cached per-series handles: the admit path must not take the
         # process-global registry lock per request.
         self._c_admitted = REGISTRY.counter(
@@ -147,20 +154,68 @@ class AdmissionController:
         self._c_shed[reason].inc()
         return status, reason
 
+    # EWMA smoothing for the measured-cost signal, and the band the
+    # relative cost multiplier is clamped to: an expensive tenant can be
+    # priced at most 4x a request, a cheap one at least 1/4 — fairness
+    # feedback must throttle hogs, never starve a tenant outright.
+    COST_EWMA = 0.2
+    COST_CLAMP = (0.25, 4.0)
+
+    def note_cost(self, tenant: str, device_seconds: float):
+        """Measured-cost feedback from the tenant ledger: one query's
+        attributed device-seconds.  Keeps an EWMA per tenant that
+        ``_over_fair_share`` prices in-flight occupancy with."""
+        with self._lock:
+            prev = self._cost.get(tenant)
+            if prev is None:
+                self._cost[tenant] = device_seconds
+            else:
+                a = self.COST_EWMA
+                self._cost[tenant] = (1 - a) * prev + a * device_seconds
+            # Cardinality is bounded upstream: the only caller is the
+            # tenant ledger, which folds tenants past its MAX_TENANTS
+            # cap into "_other" before accounting.
+
+    def _rel_cost(self, tenant: str, active) -> float:
+        """Tenant's cost multiplier vs the active-set mean, clamped.
+        Called under the lock.  1.0 when no cost signal exists yet."""
+        known = [self._cost[t] for t in active if t in self._cost]
+        if not known or tenant not in self._cost:
+            return 1.0
+        mean = sum(known) / len(known)
+        if mean <= 0:
+            return 1.0
+        lo, hi = self.COST_CLAMP
+        return min(hi, max(lo, self._cost[tenant] / mean))
+
     def _over_fair_share(self, tenant: str) -> bool:
         """True when admitting ``tenant`` would push it past its
         weighted-fair share while the node is loaded enough for
         fairness to be on.  Called under the lock.  The active set
         includes the candidate, so a lone tenant's share is the whole
         pipe and a newly-arriving light tenant's share is computed
-        against the hog it shares the node with."""
+        against the hog it shares the node with.  In-flight occupancy
+        is priced by measured device cost (``note_cost``): a tenant
+        whose queries measure 4x the mean saturates its share with a
+        quarter of the requests."""
         if self._inflight < self.fair_start * self.max_inflight:
+            return False
+        cur = self._tenants.get(tenant, 0)
+        if cur == 0:
+            # Never-starve floor: a tenant with NOTHING in flight is
+            # always admitted, whatever its cost multiplier — without
+            # this, a 4x-cost tenant whose share is < 4 slots would be
+            # shed at zero in-flight, and since the cost EWMA only moves
+            # when a query completes it could never recover.  (This is
+            # also the pre-cost-pricing behavior: +1 > max(share, 1.0)
+            # was unsatisfiable at cur == 0.)
             return False
         active = set(self._tenants)
         active.add(tenant)
         total_w = sum(self.weight(t) for t in active)
         share = self.weight(tenant) / total_w * self.max_inflight
-        return self._tenants.get(tenant, 0) + 1 > max(share, 1.0)
+        occupancy = (cur + 1) * self._rel_cost(tenant, active)
+        return occupancy > max(share, 1.0)
 
     def release(self, tenant: str):
         with self._lock:
@@ -204,6 +259,11 @@ class AdmissionController:
                 "inflight": self._inflight,
                 "tenants": dict(self._tenants),
                 "weights": dict(self.weights),
+                # Measured device-seconds-per-query EWMA per tenant —
+                # the fairness pricing signal (util/plans.py ledger).
+                "costEwma": {
+                    t: round(v, 6) for t, v in self._cost.items()
+                },
             }
 
 

@@ -54,6 +54,7 @@ from http.client import responses as STATUS_REASONS
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..util import plans as plans_mod
 from ..util.stats import (
     METRIC_SERVER_CONNECTIONS,
     METRIC_SERVER_CONNECTIONS_TOTAL,
@@ -587,6 +588,9 @@ class _Reactor(threading.Thread):
             if decision is not None:
                 status, reason = decision
                 srv._c_req_shed.inc()
+                # Charge the shed to the tenant's cost ledger
+                # (pilosa_tenant_sheds_total{tenant}).
+                plans_mod.LEDGER.note_shed(tenant)
                 self._complete(conn, slot, self._render(
                     status, "application/json",
                     json.dumps(
@@ -654,6 +658,7 @@ class _Reactor(threading.Thread):
             release_once()
             if admission is not None:
                 status, reason = admission.shed_queue_full()
+                plans_mod.LEDGER.note_shed(tenant)
             else:
                 status, reason = 503, "queue_full"
             srv._c_req_shed.inc()

@@ -24,10 +24,17 @@ from ..core import cache as cache_mod
 from ..executor.executor import Error as ExecError, FieldNotFoundError, IndexNotFoundError
 from ..executor.translate import TranslateError
 from ..pql import ParseError
+from ..util import plans as plans_mod
 from ..util.stats import REGISTRY
+from .admission import tenant_of
 from .wire import count_response_bytes, response_to_json
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# Served at GET /metrics when the scraper negotiates OpenMetrics — the
+# exposition that may carry exemplars (util/stats prometheus_text).
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 # Serving backend selection (docs/serving.md): "async" is the event-loop
 # reactor (net/aserver.py); "threaded" is the stdlib thread-per-connection
@@ -206,6 +213,7 @@ class Handler:
         r("GET", "/debug/vars", self._debug_vars)
         r("GET", "/debug/traces", self._debug_traces)
         r("GET", "/debug/events", self._debug_events)
+        r("GET", "/debug/plans", self._debug_plans)
         r("GET", "/debug/pprof", self._debug_pprof)
         r("GET", "/debug/pprof/goroutine", self._debug_pprof)
         r("GET", "/debug/pprof/profile", self._debug_pprof_profile)
@@ -448,6 +456,11 @@ class Handler:
             # (X-Trace-Id from a coordinator's shard fan-out, or an
             # external client propagating its own trace).
             trace_context=self.api.tracer.extract_headers(headers or {}),
+            # ?profile=1 returns the recorded query plan inline; the
+            # tenant keys plan/cost attribution with the SAME resolution
+            # admission fairness uses (header, else index name).
+            profile=_qflag(q, "profile") or doc.get("profile", False),
+            tenant=tenant_of(headers or {}, f"/index/{index}/query"),
         )
 
     def _defer_query(self, req: QueryRequest):
@@ -467,11 +480,17 @@ class Handler:
                 resp = f.result(0)
                 span = getattr(f, "trace_span", None)
                 trace_id = span.trace_id if span is not None else None
-                payload = count_response_bytes(resp, trace_id)
+                plan = getattr(f, "query_plan", None) if req.profile else None
+                payload = (
+                    count_response_bytes(resp, trace_id)
+                    if plan is None else None  # profiled: full encoder
+                )
                 if payload is None:
                     out = response_to_json(resp)
                     if trace_id is not None:
                         out["traceID"] = trace_id
+                    if plan is not None:
+                        out["plan"] = plan.to_dict()
                     payload = json.dumps(out).encode()
                 d.resolve(200, "application/json", payload)
             except Exception as e:  # noqa: BLE001
@@ -516,6 +535,8 @@ class Handler:
         out = response_to_json(resp)
         if getattr(resp, "trace_id", None):
             out["traceID"] = resp.trace_id
+        if getattr(resp, "plan", None) is not None:
+            out["plan"] = resp.plan
         return out
 
     def _post_import(self, q, b, *, index, field, **kw):
@@ -588,7 +609,7 @@ class Handler:
         old, new = self.api.set_coordinator(doc.get("id", ""))
         return {"old": old, "new": new}
 
-    def _metrics_text(self) -> str:
+    def _metrics_text(self, openmetrics: bool = False) -> str:
         """The local node's Prometheus exposition: the process registry
         with live pipeline gauges and the engine's HBM/compile gauges
         refreshed at pull time (per-node collection, pull-time
@@ -619,12 +640,30 @@ class Handler:
         # TopN rank-cache maintenance gauges (entries per cache type):
         # summed over live fragment caches at pull time (docs/ingest.md).
         cache_mod.refresh_entries_gauges()
-        return REGISTRY.prometheus_text()
+        # Per-tenant cost counters flush their accumulated ledger rows
+        # at pull time too (docs/observability.md): the query hot path
+        # only touches the ledger's own lock.
+        plans_mod.LEDGER.refresh_series()
+        return REGISTRY.prometheus_text(openmetrics=openmetrics)
 
     def _metrics(self, q, b, **kw):
         """GET /metrics: the process registry (latency histograms per
         pipeline stage / query op / fragment op, counters, gauges) in
-        Prometheus text exposition format."""
+        Prometheus text exposition format.  Negotiating
+        ``Accept: application/openmetrics-text`` switches to the
+        OpenMetrics exposition, whose ``_bucket`` samples carry trace-id
+        exemplars (``# {trace_id=...}``) — the Grafana click-through to
+        /debug/plans?trace=<id>."""
+        # Field names are case-insensitive (RFC 7230) and HTTP/2
+        # terminators lowercase them — match the header by name, not
+        # by the casing the client happened to send.
+        headers = kw.get("_headers", {})
+        accept = next(
+            (v for k, v in headers.items() if k.lower() == "accept"), ""
+        )
+        if "application/openmetrics-text" in accept:
+            text = self._metrics_text(openmetrics=True)
+            return 200, OPENMETRICS_CONTENT_TYPE, text.encode()
         return 200, PROMETHEUS_CONTENT_TYPE, self._metrics_text().encode()
 
     def _healthz(self, q, b, **kw):
@@ -753,6 +792,22 @@ class Handler:
         text = "\n".join(head + body) + "\n"
         return 200, PROMETHEUS_CONTENT_TYPE, text.encode()
 
+    def _debug_plans(self, q, b, **kw):
+        """GET /debug/plans: the bounded recent-plan ring plus the
+        slow-query analyzer's worst-plans-per-op retention, each plan
+        annotated with WHY it was slow (docs/observability.md).  Filters:
+        ?op=Count (op type), ?trace=<id> (the exemplar click-through:
+        resolve one trace id to its plan), ?limit=N (newest N recent)."""
+        try:
+            limit = int(q.get("limit", ["64"])[0])
+        except ValueError:
+            raise ValueError("limit must be an integer")
+        return plans_mod.STORE.to_doc(
+            op=q.get("op", [None])[0],
+            limit=limit,
+            trace=q.get("trace", [None])[0],
+        )
+
     def _debug_traces(self, q, b, **kw):
         """GET /debug/traces: recent + slow span trees (JSON), each node
         carrying traceID/spanID/parentSpanID — the join surface for the
@@ -795,10 +850,20 @@ class Handler:
             out["server"] = self.server.snapshot()
         elif self.admission is not None:
             out["server"] = {"admission": self.admission.snapshot()}
-        # Rank-cache maintenance gauges refresh before the registry
-        # snapshot so pilosa_cache_entries{cache_type} is current here
-        # exactly as it is at /metrics.
+        # Query-plan introspection + per-tenant cost attribution
+        # (docs/observability.md): recorded-plan tallies and the tenant
+        # ledger's measured device cost, the JSON twin of
+        # /debug/plans + pilosa_tenant_*.
+        out["queryPlans"] = {
+            "recorded": plans_mod.STORE.recorded,
+            "enabled": plans_mod.ENABLED,
+        }
+        out["tenants"] = plans_mod.LEDGER.snapshot()
+        # Rank-cache maintenance gauges and tenant cost counters refresh
+        # before the registry snapshot so pilosa_cache_entries and
+        # pilosa_tenant_* are current here exactly as at /metrics.
         cache_mod.refresh_entries_gauges()
+        plans_mod.LEDGER.refresh_series()
         # The histogram registry's JSON view: same data /metrics serves,
         # merged here so one curl shows counters + stages + quantiles.
         out["metrics"] = REGISTRY.snapshot()
@@ -817,6 +882,20 @@ class Handler:
             out[threads.get(ident, str(ident))] = traceback.format_stack(frame)
         return {"threads": out, "count": len(out)}
 
+    # Serializes concurrent /debug/pprof/profile requests: two sampling
+    # loops interleaving their sleeps would each see roughly half the
+    # intended rate AND account the other's sampler thread in its own
+    # stacks — one profile runs at a time.  The wait is BOUNDED
+    # (PPROF_WAIT_SECONDS, then 429): a queue of 60s captures must not
+    # pin a worker-pool thread per waiter for minutes.
+    _pprof_profile_lock = threading.Lock()
+    PPROF_WAIT_SECONDS = 15.0
+    # Distinct folded stacks retained per profile: a long capture of a
+    # churny workload (generated code, recursion depth variation) can
+    # mint unbounded distinct stacks; past the cap, samples aggregate
+    # under a single overflow key so ?seconds=60 stays bounded memory.
+    PPROF_MAX_STACKS = 5000
+
     def _debug_pprof_profile(self, q, b, **kw):
         """/debug/pprof/profile (http/handler.go:241 mounts the full
         pprof mux; Go's profile endpoint samples CPU for ?seconds=N).
@@ -824,41 +903,75 @@ class Handler:
         via sys._current_frames() — returns folded-stack lines
         ("fnA;fnB;fnC count", the flamegraph interchange format) plus a
         top-functions table.  Pure stdlib, no tracing overhead between
-        samples, and it sees every serving thread (cProfile cannot)."""
+        samples, and it sees every serving thread (cProfile cannot).
+        Identical stacks aggregate across threads; retention is capped
+        (PPROF_MAX_STACKS) and concurrent requests serialize."""
         import sys
         import time as time_mod
 
-        seconds = min(float(q.get("seconds", ["1"])[0]), 30.0)
+        seconds = min(float(q.get("seconds", ["1"])[0]), 60.0)
         hz = min(int(q.get("hz", ["100"])[0]), 1000)
         period = 1.0 / max(hz, 1)
         me = threading.get_ident()
         folded: dict = {}
         leaf_counts: dict = {}
         n_samples = 0
-        deadline = time_mod.monotonic() + seconds
-        while time_mod.monotonic() < deadline:
-            for ident, frame in sys._current_frames().items():
-                if ident == me:
-                    continue  # not the profiler's own sampling loop
-                stack = []
-                f = frame
-                while f is not None:
-                    code = f.f_code
-                    stack.append(f"{code.co_name} ({code.co_filename}:{code.co_firstlineno})")
-                    f = f.f_back
-                stack.reverse()
-                key = ";".join(stack)
-                folded[key] = folded.get(key, 0) + 1
-                leaf_counts[stack[-1]] = leaf_counts.get(stack[-1], 0) + 1
-            n_samples += 1
-            time_mod.sleep(period)
+        truncated = 0
+        if not Handler._pprof_profile_lock.acquire(
+            timeout=self.PPROF_WAIT_SECONDS
+        ):
+            return 429, "application/json", json.dumps({
+                "error": "a profile capture is already in progress",
+                "retryAfterSeconds": self.PPROF_WAIT_SECONDS,
+            }).encode()
+        try:
+            started = time_mod.monotonic()
+            deadline = started + seconds
+            while time_mod.monotonic() < deadline:
+                for ident, frame in sys._current_frames().items():
+                    if ident == me:
+                        continue  # not the profiler's own sampling loop
+                    stack = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        stack.append(
+                            f"{code.co_name} "
+                            f"({code.co_filename}:{code.co_firstlineno})"
+                        )
+                        f = f.f_back
+                    stack.reverse()
+                    key = ";".join(stack)
+                    n = folded.get(key)
+                    if n is None and len(folded) >= self.PPROF_MAX_STACKS:
+                        key = "<overflow>"
+                        n = folded.get(key)
+                        truncated += 1
+                    folded[key] = (n or 0) + 1
+                    leaf = stack[-1] if key != "<overflow>" else "<overflow>"
+                    leaf_counts[leaf] = leaf_counts.get(leaf, 0) + 1
+                n_samples += 1
+                time_mod.sleep(period)
+            ended = time_mod.monotonic()
+        finally:
+            Handler._pprof_profile_lock.release()
         top = sorted(leaf_counts.items(), key=lambda kv: -kv[1])[:50]
         return {
             "seconds": seconds,
             "hz": hz,
             "samples": n_samples,
+            "distinctStacks": len(folded),
+            "truncatedSamples": truncated,
+            "maxStacks": self.PPROF_MAX_STACKS,
+            # Monotonic capture window: concurrency tests assert two
+            # profiles' windows never overlap (the serialization above).
+            "startedMonotonic": started,
+            "endedMonotonic": ended,
             "top": [{"func": f, "count": c} for f, c in top],
-            "folded": [f"{k} {v}" for k, v in sorted(folded.items(), key=lambda kv: -kv[1])],
+            "folded": [
+                f"{k} {v}"
+                for k, v in sorted(folded.items(), key=lambda kv: -kv[1])
+            ],
         }
 
     def _debug_pprof_heap(self, q, b, **kw):
@@ -1028,6 +1141,12 @@ class Handler:
 
 def _qbool(q: dict, name: str) -> bool:
     return q.get(name, ["false"])[0].lower() == "true"
+
+
+def _qflag(q: dict, name: str) -> bool:
+    """Permissive boolean query flag: ``?profile=1`` and ``?profile=true``
+    both count (the reference's handler accepts either for its flags)."""
+    return q.get(name, ["0"])[0].lower() in ("1", "true", "yes")
 
 
 def _parse_shards(q: dict) -> Optional[List[int]]:
@@ -1359,6 +1478,11 @@ def serve(
         # api.admission lets the API layer (readiness snapshots, debug
         # surfaces) see shed state without reaching into the server.
         api.admission = srv.admission
+        # Measured-cost feedback loop (docs/observability.md): the
+        # tenant ledger streams per-query device-seconds into the
+        # controller, so weighted-fair shares price what a tenant's
+        # queries COST, not how many it sent.
+        plans_mod.LEDGER.bind_admission(srv.admission)
     srv.RequestHandlerClass.handler = handler
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()

@@ -53,6 +53,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..util import plans as plans_mod
 from ..util import tracing
 from ..util.stats import PipelineStats
 
@@ -74,6 +75,8 @@ class _Item:
         "error",
         "t_submit",
         "span",
+        "plan",
+        "memo_note",
         "memo_key",
         "_callbacks",
     )
@@ -87,6 +90,13 @@ class _Item:
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
         self.span = tracing.current_span()
+        # The submitter's query plan, captured exactly like the span:
+        # stage workers stamp decisions and timings onto it across the
+        # accumulate/dispatch/collect thread hops (util/plans.py).
+        self.plan = plans_mod.current_plan()
+        # ("miss", reason) computed at submit time — the memo status the
+        # dispatch-note fan-out merges into this item's plan op.
+        self.memo_note = None
         # Result-memo key computed at SUBMIT time (engine.memo_probe):
         # the collect stage stores the answer under the version tokens
         # the query began with, never newer ones.
@@ -209,11 +219,13 @@ class CountBatcher:
         next fused batch."""
         probed = getattr(self.engine, "memo_probe", None) is not None
         key, hit = self._memo_probe(index, call, shards)
+        memo_note = self._plan_memo_note(probed, key, hit)
         if hit is not None:
             return int(hit)
-        item = self._submit(index, call, shards, allow_direct=True, memo_key=key)
+        item = self._submit(index, call, shards, allow_direct=True,
+                            memo_key=key, memo_note=memo_note)
         if item is None:
-            return self._direct(index, call, shards, key, probed)
+            return self._direct(index, call, shards, key, probed, memo_note)
         if not item.event.wait(self.WAIT_TIMEOUT):
             raise RuntimeError("batched count timed out (engine wedged?)")
         if item.error is not None:
@@ -227,12 +239,35 @@ class CountBatcher:
         it; a lone async query pays ~one accumulation poll.  A memo hit
         returns an already-resolved future."""
         key, hit = self._memo_probe(index, call, shards)
+        memo_note = self._plan_memo_note(
+            getattr(self.engine, "memo_probe", None) is not None, key, hit
+        )
         if hit is not None:
             item = _Item(index, call, list(shards))
             item.result = int(hit)
             item._resolve()
             return item
-        return self._submit(index, call, shards, allow_direct=False, memo_key=key)
+        return self._submit(index, call, shards, allow_direct=False,
+                            memo_key=key, memo_note=memo_note)
+
+    def _plan_memo_note(self, probed: bool, key, hit):
+        """Plan-record the memo outcome on the SUBMIT thread (the plan
+        is ambient here; the dispatch workers only see items).  A hit is
+        a complete op record by itself — no dispatch will follow; a miss
+        becomes a ("miss", reason) note the dispatch fan-out merges into
+        the eventual op record."""
+        plan = plans_mod.current_plan()
+        if plan is None or not probed:
+            return None
+        if hit is not None:
+            plan.note_op(op="Count", path="memo", memo="hit")
+            return None
+        reason = "ineligible"
+        if key is not None:
+            memo = getattr(self.engine, "result_memo", None)
+            if memo is not None and hasattr(memo, "miss_reason"):
+                reason = memo.miss_reason(key)
+        return ("miss", reason)
 
     def _memo_probe(self, index, call, shards):
         """engine.memo_probe, duck-typed: the batcher also runs against
@@ -242,7 +277,8 @@ class CountBatcher:
             return None, None
         return probe(index, call, shards)
 
-    def _submit(self, index, call, shards, allow_direct: bool, memo_key=None):
+    def _submit(self, index, call, shards, allow_direct: bool, memo_key=None,
+                memo_note=None):
         with self._lock:
             hot = time.monotonic() - self._last_fused < self.HOT_WINDOW
             if allow_direct and not self._busy and not self._queue and not hot:
@@ -250,6 +286,7 @@ class CountBatcher:
                 return None  # caller runs the direct path
             item = _Item(index, call, list(shards))
             item.memo_key = memo_key
+            item.memo_note = memo_note
             self._queue.append(item)
             self._ensure_workers()
             # Wake the drain worker on the empty->non-empty transition
@@ -260,7 +297,9 @@ class CountBatcher:
                 self._cond.notify_all()
         return item
 
-    def _direct(self, index, call, shards, memo_key=None, probed=False) -> int:
+    def _direct(self, index, call, shards, memo_key=None, probed=False,
+                memo_note=None) -> int:
+        t0 = time.monotonic()
         try:
             if probed:
                 # submit() already probed (and missed): hand the key
@@ -269,6 +308,22 @@ class CountBatcher:
                 return self.engine.count(index, call, shards, memo_key=memo_key)
             return self.engine.count(index, call, shards)
         finally:
+            # Plan record for the unbatched path: the engine published
+            # its dispatch decisions to this thread's note; the whole
+            # blocking call is this query's device attribution (it held
+            # the dispatch + readback alone).
+            note = plans_mod.take_dispatch_note()
+            plan = plans_mod.current_plan()
+            if plan is not None:
+                d = dict(note) if note else {"op": "Count", "path": "direct"}
+                if memo_note is not None:
+                    d["memo"], d["memo_reason"] = memo_note
+                plan.note_op(**d)
+                elapsed = time.monotonic() - t0
+                # The direct path has no pipeline stages: the whole
+                # blocking dispatch+readback is one "execute" stage.
+                plan.note_stage("execute", elapsed)
+                plan.note_device_seconds(elapsed)
             with self._lock:
                 self._busy = False
                 if self._queue:
@@ -370,14 +425,32 @@ class CountBatcher:
             self.pipeline.add_delta("inflight", 1)
             if not retried:
                 now = time.monotonic()
+                # Wall stages stamp ONCE per distinct plan: a query with
+                # several Counts rides the batch as several items sharing
+                # one plan, and their waits overlap in wall time — summing
+                # them would report stagesMs > durationMs and trip the
+                # analyzer's queue-wait check on a healthy pipeline.  The
+                # longest waiter is the query's wait.
+                plan_wait: dict = {}
                 for it in items:
-                    self.pipeline.record("queue_wait", now - it.t_submit)
+                    self.pipeline.record(
+                        "queue_wait", now - it.t_submit,
+                        exemplar=it.span.trace_id if it.span is not None else None,
+                    )
                     if it.span is not None:
                         it.span.record(
                             "pipeline.queue_wait",
                             start=it.t_submit,
                             duration=now - it.t_submit,
                         )
+                    if it.plan is not None:
+                        pid = id(it.plan)
+                        wait = now - it.t_submit
+                        prev = plan_wait.get(pid)
+                        if prev is None or wait > prev[1]:
+                            plan_wait[pid] = (it.plan, wait)
+                for plan, wait in plan_wait.values():
+                    plan.note_stage("queue_wait", wait)
             try:
                 t0 = time.monotonic()
                 dev = self.engine.count_many_async(
@@ -386,7 +459,15 @@ class CountBatcher:
                     [it.shards for it in items],
                 )
                 t1 = time.monotonic()
-                self.pipeline.record("lower_dispatch", t1 - t0)
+                note = plans_mod.take_dispatch_note()
+                self._stamp_plans(items, note, t1 - t0)
+                self.pipeline.record(
+                    "lower_dispatch", t1 - t0,
+                    exemplar=next(
+                        (it.span.trace_id for it in items if it.span is not None),
+                        None,
+                    ),
+                )
                 for it in items:
                     if it.span is not None:
                         it.span.record(
@@ -398,6 +479,10 @@ class CountBatcher:
             except BaseException as batch_err:  # noqa: BLE001 — the loop
                 # must survive anything; a dead dispatch worker wedges
                 # every later submit at WAIT_TIMEOUT.
+                # A failed dispatch may have half-written its plan note
+                # (e.g. occupancy stamped, then lowering raised): clear
+                # it so the next batch on this thread starts clean.
+                plans_mod.take_dispatch_note()
                 with self._lock:
                     self._live -= 1
                 self.pipeline.add_delta("inflight", -1)
@@ -459,6 +544,27 @@ class CountBatcher:
                     it.error = batch_err
                 it._resolve()
 
+    @staticmethod
+    def _stamp_plans(items: List[_Item], note, lower_seconds: float):
+        """Fan the engine's dispatch note out to every rider's plan
+        (per-rider byte division via plans.rider_note)."""
+        if note is None:
+            return
+        n = len(items)
+        staged = set()
+        for it in items:
+            if it.plan is None:
+                continue
+            d = plans_mod.rider_note(note, n)
+            if it.memo_note is not None:
+                d["memo"], d["memo_reason"] = it.memo_note
+            it.plan.note_op(**d)
+            # One lower_dispatch stamp per distinct plan: the batch
+            # lowered once, however many of this query's Counts rode it.
+            if id(it.plan) not in staged:
+                staged.add(id(it.plan))
+                it.plan.note_stage("lower_dispatch", lower_seconds)
+
     # -- collect stage ------------------------------------------------------
 
     def _collect_loop(self):
@@ -473,7 +579,13 @@ class CountBatcher:
             try:
                 out = np.asarray(jax.device_get(dev))
                 t_ready = time.monotonic()
-                self.pipeline.record("device_readback", t_ready - t_dispatched)
+                self.pipeline.record(
+                    "device_readback", t_ready - t_dispatched,
+                    exemplar=next(
+                        (it.span.trace_id for it in items if it.span is not None),
+                        None,
+                    ),
+                )
                 for i, it in enumerate(items):
                     it.result = int(out[i])
                     # Populate the result memo under the tokens read at
@@ -482,7 +594,24 @@ class CountBatcher:
                         self.engine.memo_store(it.memo_key, it.result)
                 t_done = time.monotonic()
                 self.pipeline.record("decode", t_done - t_ready)
+                # Device-cost attribution: the batch held one device
+                # slot for the readback window; each rider is charged
+                # an even share (the tenant ledger sums these into
+                # pilosa_tenant_device_seconds_total).
+                dev_share = (t_ready - t_dispatched) / max(1, len(items))
+                staged = set()
                 for it in items:
+                    if it.plan is not None:
+                        # Wall stages once per distinct plan (shared batch
+                        # window); the device-cost SHARE stays per item —
+                        # each of a query's Counts consumed its own slice.
+                        if id(it.plan) not in staged:
+                            staged.add(id(it.plan))
+                            it.plan.note_stage(
+                                "device_readback", t_ready - t_dispatched
+                            )
+                            it.plan.note_stage("decode", t_done - t_ready)
+                        it.plan.note_device_seconds(dev_share)
                     if it.span is not None:
                         it.span.record(
                             "pipeline.device_readback",

@@ -48,6 +48,7 @@ from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
 from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
 from ..util import events as events_mod
+from ..util import plans as plans_mod
 from ..util.stats import (
     COMPILE_PHASES,
     ENGINE_CACHES,
@@ -308,7 +309,7 @@ class _ResultMemo:
     readback) — both satisfy int()/jax.device_get, so a hit returns
     "replicated results" with zero device dispatch either way."""
 
-    __slots__ = ("maxsize", "_od", "_lock", "hits", "misses")
+    __slots__ = ("maxsize", "_od", "_lock", "hits", "misses", "_sig_tokens")
 
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
@@ -316,6 +317,10 @@ class _ResultMemo:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # (index, query, shards) -> last-stored version-token tuple: the
+        # plan analyzer's "WHY did this memo miss" signal.  Bounded by
+        # the same LRU discipline as the entries themselves.
+        self._sig_tokens: "OrderedDict" = OrderedDict()
 
     def __len__(self) -> int:
         return len(self._od)
@@ -332,18 +337,45 @@ class _ResultMemo:
             self.hits += 1
             return v
 
+    def peek(self, key) -> bool:
+        """Non-destructive membership probe for the Explain dry-run: no
+        LRU recency bump, no hit/miss accounting — a documented dry-run
+        must not change which entry eviction picks next."""
+        if self.maxsize <= 0 or key is None:
+            return False
+        with self._lock:
+            return key in self._od
+
     def put(self, key, value):
         if self.maxsize <= 0 or key is None or value is None:
             return
         with self._lock:
             self._od[key] = value
             self._od.move_to_end(key)
+            self._sig_tokens[key[:3]] = key[3]
+            self._sig_tokens.move_to_end(key[:3])
             while len(self._od) > self.maxsize:
                 self._od.popitem(last=False)
+            while len(self._sig_tokens) > self.maxsize:
+                self._sig_tokens.popitem(last=False)
+
+    def miss_reason(self, key) -> str:
+        """Attribute a miss for the query-plan record: the same (index,
+        query, shards) signature stored under DIFFERENT tokens means a
+        write advanced a version token since the last run; same tokens
+        means the entry was evicted; an unseen signature is cold."""
+        if key is None:
+            return "ineligible"
+        with self._lock:
+            toks = self._sig_tokens.get(key[:3])
+        if toks is None:
+            return "first_seen"
+        return "evicted" if toks == key[3] else "version_token_advanced"
 
     def clear(self):
         with self._lock:
             self._od.clear()
+            self._sig_tokens.clear()
 
 
 DEFAULT_RESIDENCY_BYTES = 8 << 30  # HBM budget for resident field stacks
@@ -1596,6 +1628,12 @@ class MeshEngine:
             f"to the host path: {err!r}"
         )
 
+    @staticmethod
+    def _operand_bytes(lw: "_Lowering") -> int:
+        """Device bytes a dense dispatch over these operands sweeps —
+        the plan record's bytes_touched estimate."""
+        return sum(int(getattr(op, "nbytes", 0)) for op in lw.operands)
+
     def _dispatch_count(self, index, c, shards, canonical):
         lw = _Lowering(self, canonical)
         prog = self._lower(index, c, lw)
@@ -1604,6 +1642,10 @@ class MeshEngine:
         self._note_fused_dispatch()
         if plan is not None:
             return self._dispatch_sparse(plan, mask)
+        plans_mod.note_dispatch(
+            op="Count", path="dense", fused=True,
+            bytes_touched=self._operand_bytes(lw),
+        )
         return kernels.count_tree(
             self.mesh, prog, tuple(lw.specs), mask, *lw.operands
         )
@@ -1615,6 +1657,9 @@ class MeshEngine:
         self.sparse_dispatches += 1
         self.device_bytes_skipped += skipped
         self._bytes_skipped_counter.inc(skipped)
+        plans_mod.note_dispatch(
+            op="Count", path="sparse", fused=True, bytes_skipped=skipped
+        )
         if self._sparse_pallas:
             try:
                 return sparse_mod.count_tree_blocks_pallas(
@@ -1709,6 +1754,15 @@ class MeshEngine:
         blk_n_np = bits.sum(axis=1).astype(np.int32)
         total_blocks = int(blk_n_np.sum())
         denom = n_req * bitops.OCC_BLOCKS
+        # Plan record: the occupancy decision either way — blocks that
+        # survive the host-side combine vs the total the dense sweep
+        # would read (per leaf), and the threshold it was judged against.
+        plans_mod.note_dispatch(
+            blocks_surviving=total_blocks,
+            blocks_total=denom,
+            occ_fraction=round(total_blocks / denom, 4),
+            threshold=self.sparse_threshold,
+        )
         if total_blocks / denom > self.sparse_threshold:
             return None
         # Occupied block ids first (stable argsort keeps ascending
@@ -1726,6 +1780,9 @@ class MeshEngine:
         n_leaves = len(rowvals)
         block_bytes = bitops.OCC_BLOCK_WORDS * 4
         skipped = n_leaves * (denom - total_blocks) * block_bytes
+        plans_mod.note_dispatch(
+            bytes_touched=n_leaves * total_blocks * block_bytes
+        )
         rowvec = put_global(
             self.mesh, np.asarray(rowvals, dtype=np.int32), P()
         )
@@ -1747,6 +1804,105 @@ class MeshEngine:
         if c.name not in self._LOWERABLE:
             return False
         return all(self.lowerable(ch) for ch in c.children)
+
+    # Call-name -> occupancy combinator for the dry-run planner (the
+    # host-side mirror of _sparse_plan's norm()).
+    _EXPLAIN_NARY = {"Intersect": "and", "Union": "or",
+                     "Difference": "andnot", "Xor": "xor"}
+
+    def explain_count(self, index: str, c: Call, shards) -> dict:
+        """Plan a Count WITHOUT dispatching: the PQL ``Explain(...)``
+        dry-run.  Combines per-(row, shard) block occupancy straight
+        from the HOST fragments (never forcing device residency or a
+        compile), probes the result memo non-destructively, and reports
+        the path the real dispatch would take.  Occupancy is exact —
+        fragments maintain it on every write — so the projected
+        sparse/dense decision matches what _sparse_plan would choose
+        for resident stacks."""
+        canonical = self.canonical_shards(index)
+        doc: dict = {
+            "op": "Count",
+            "query": str(c),
+            "lowerable": self.lowerable(c),
+            "shards": len(shards),
+            "canonicalShards": len(canonical),
+        }
+        key = self._memo_key(index, c, shards)
+        hit = self.result_memo.peek(key)
+        doc["memo"] = "hit" if hit else "miss"
+        if not hit:
+            doc["memoReason"] = self.result_memo.miss_reason(key)
+        if not doc["lowerable"] or not canonical:
+            doc["plannedPath"] = "host" if not doc["lowerable"] else "empty"
+            return doc
+        block_bytes = bitops.OCC_BLOCK_WORDS * 4
+        shard_set = set(shards)
+        n_req = sum(1 for s in canonical if s in shard_set)
+
+        def occ_of(call) -> np.ndarray:
+            if call.name == "Row" and not call.children and len(call.args) == 1:
+                (fname, row), = call.args.items()
+                if isinstance(row, bool) or not isinstance(row, int):
+                    raise _NotSparse
+                out = np.zeros(len(canonical), dtype=np.uint64)
+                for i, s in enumerate(canonical):
+                    if s not in shard_set:
+                        continue
+                    frag = self.holder.fragment(index, fname, VIEW_STANDARD, s)
+                    if frag is not None:
+                        out[i] = np.uint64(frag.row_occupancy(row))
+                return out
+            kind = self._EXPLAIN_NARY.get(call.name)
+            if kind is None or not call.children:
+                raise _NotSparse
+            occ = occ_of(call.children[0])
+            for ch in call.children[1:]:
+                so = occ_of(ch)
+                if kind == "and":
+                    occ = occ & so
+                elif kind != "andnot":  # or/xor widen; andnot keeps left
+                    occ = occ | so
+            return occ
+
+        def leaves(call) -> int:
+            if call.name == "Row":
+                return 1
+            return sum(leaves(ch) for ch in call.children)
+
+        try:
+            occ = occ_of(c)
+        except _NotSparse:
+            doc["plannedPath"] = "dense"
+            doc["sparseEligible"] = False
+            return doc
+        bits = np.unpackbits(
+            occ.view(np.uint8).reshape(len(canonical), 8),
+            axis=1, bitorder="little",
+        )
+        surviving = int(bits.sum())
+        total = max(1, n_req * bitops.OCC_BLOCKS)
+        frac = surviving / total
+        # Mirror _sparse_plan exactly: zero surviving blocks is still the
+        # sparse path (the kernel zero-weights its padding — the dispatch
+        # reads nothing and skips everything).
+        sparse = (
+            self.sparse_enabled and not self.multiproc
+            and frac <= self.sparse_threshold
+        )
+        n_leaves = leaves(c)
+        doc.update(
+            sparseEligible=True,
+            blocksSurviving=surviving,
+            blocksTotal=total,
+            occFraction=round(frac, 4),
+            sparseThreshold=self.sparse_threshold,
+            plannedPath="memo" if hit else ("sparse" if sparse else "dense"),
+            estBytesDense=n_leaves * total * block_bytes,
+            estBytesSkipped=(
+                n_leaves * (total - surviving) * block_bytes if sparse else 0
+            ),
+        )
+        return doc
 
     def batcher(self):
         """The lazily-built cross-request micro-batcher
@@ -1868,9 +2024,16 @@ class MeshEngine:
             mask1 = self._mask_words(u_shards[0], canonical)
             plan = self._sparse_plan(prog1, lw1, u_shards[0], canonical)
             self._note_fused_dispatch()
+            plans_mod.note_dispatch(
+                cse_unique=1, cse_deduped=deduped, batch_size=len(calls)
+            )
             if plan is not None:
                 dev = self._dispatch_sparse(plan, mask1)
             else:
+                plans_mod.note_dispatch(
+                    op="Count", path="dense", fused=True,
+                    bytes_touched=self._operand_bytes(lw1),
+                )
                 dev = kernels.count_tree(
                     self.mesh, prog1, tuple(lw1.specs), mask1, *lw1.operands
                 )
@@ -1899,6 +2062,12 @@ class MeshEngine:
             progs.append((prog, i_mask))
         lw.finish()
         self._note_fused_dispatch()
+        plans_mod.note_dispatch(
+            op="Count", path="dense_batch", fused=True,
+            cse_unique=len(u_calls), cse_deduped=deduped,
+            batch_size=len(calls), tier=K_pad,
+            bytes_touched=self._operand_bytes(lw),
+        )
         dev = kernels.count_batch_tree(
             self.mesh, tuple(progs), tuple(lw.specs), *lw.operands
         )

@@ -12,6 +12,7 @@ from __future__ import annotations
 import bisect
 import re
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 
@@ -28,9 +29,15 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 class Histogram:
     """Fixed-bucket latency histogram with Prometheus-style cumulative
     export and linear-interpolation quantile estimation.  Thread-safe;
-    observe() is a bisect + one locked increment."""
+    observe() is a bisect + one locked increment.
 
-    __slots__ = ("buckets", "_counts", "sum", "count", "_lock")
+    Exemplars (OpenMetrics): ``observe(v, exemplar=trace_id)`` keeps the
+    most recent (trace id, value, wall time) PER BUCKET — bounded memory
+    (one slot per bucket, allocated lazily on the first exemplar), and
+    exactly what links a p99 bucket spike in Grafana to the concrete
+    plan at /debug/plans."""
+
+    __slots__ = ("buckets", "_counts", "sum", "count", "_lock", "_exemplars")
 
     def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
         self.buckets = tuple(buckets)
@@ -38,13 +45,25 @@ class Histogram:
         self.sum = 0.0
         self.count = 0
         self._lock = threading.Lock()
+        self._exemplars = None  # lazy: [ (trace_id, value, wall_ts) | None ]
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[str] = None):
         i = bisect.bisect_left(self.buckets, value)
         with self._lock:
             self._counts[i] += 1
             self.sum += value
             self.count += 1
+            if exemplar:
+                ex = self._exemplars
+                if ex is None:
+                    ex = self._exemplars = [None] * (len(self.buckets) + 1)
+                ex[i] = (exemplar, value, time.time())
+
+    def exemplars(self) -> Optional[list]:
+        """A consistent copy of the per-bucket exemplar slots (None when
+        no exemplar was ever attached)."""
+        with self._lock:
+            return None if self._exemplars is None else list(self._exemplars)
 
     def counts(self) -> List[int]:
         with self._lock:
@@ -211,8 +230,19 @@ class MetricsRegistry:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
 
-    def prometheus_text(self) -> str:
-        """The whole registry in Prometheus text exposition format."""
+    def get_gauge(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name, {}).get(self._labelkey(labels))
+
+    def prometheus_text(self, openmetrics: bool = False) -> str:
+        """The whole registry in Prometheus text exposition format.
+
+        ``openmetrics=True`` is the exemplar escape hatch: ``_bucket``
+        samples carry their most recent exemplar in OpenMetrics syntax
+        (``# {trace_id="..."} value timestamp``) and the exposition ends
+        with ``# EOF``.  Classic Prometheus text (the default) stays
+        exemplar-free — exemplars are only legal in the OpenMetrics
+        format, and classic-format consumers reject the suffix."""
         with self._lock:
             hists = {n: dict(s) for n, s in self._hists.items()}
             counters = {n: dict(s) for n, s in self._counters.items()}
@@ -226,22 +256,44 @@ class MetricsRegistry:
             for key in sorted(hists[name]):
                 h = hists[name][key]
                 counts, h_sum, h_count = h.export()  # one consistent view
+                exemplars = h.exemplars() if openmetrics else None
                 cum, running = [], 0
                 for c in counts:
                     running += c
                     cum.append(running)
+
+                def ex_suffix(i: int) -> str:
+                    if exemplars is None or exemplars[i] is None:
+                        return ""
+                    tid, val, ts = exemplars[i]
+                    esc = str(tid).replace("\\", "\\\\").replace('"', '\\"')
+                    return (
+                        f' # {{trace_id="{esc}"}} '
+                        f"{_prom_float(val)} {_prom_float(ts)}"
+                    )
+
                 for i, bound in enumerate(h.buckets):
                     le = self._fmt_labels(key, f'le="{_prom_float(bound)}"')
-                    lines.append(f"{pname}_bucket{le} {cum[i]}")
+                    lines.append(f"{pname}_bucket{le} {cum[i]}{ex_suffix(i)}")
                 le = self._fmt_labels(key, 'le="+Inf"')
-                lines.append(f"{pname}_bucket{le} {cum[-1]}")
+                lines.append(
+                    f"{pname}_bucket{le} {cum[-1]}{ex_suffix(len(h.buckets))}"
+                )
                 lbl = self._fmt_labels(key)
                 lines.append(f"{pname}_sum{lbl} {_prom_float(h_sum)}")
                 lines.append(f"{pname}_count{lbl} {h_count}")
         for name in sorted(counters):
             pname = _prom_name(name)
-            lines.append(f"# HELP {pname} {helps.get(name, name)}")
-            lines.append(f"# TYPE {pname} counter")
+            # OpenMetrics counter families exclude the type suffix in
+            # HELP/TYPE and require the ``_total`` suffix on samples;
+            # classic exposition uses the sample name throughout.  Our
+            # counters are all registered with a ``_total`` name, so the
+            # sample lines are identical in both formats.
+            fam = pname
+            if openmetrics and fam.endswith("_total"):
+                fam = fam[: -len("_total")]
+            lines.append(f"# HELP {fam} {helps.get(name, name)}")
+            lines.append(f"# TYPE {fam} counter")
             for key in sorted(counters[name]):
                 lbl = self._fmt_labels(key)
                 lines.append(
@@ -254,6 +306,8 @@ class MetricsRegistry:
             for key in sorted(gauges[name]):
                 lbl = self._fmt_labels(key)
                 lines.append(f"{pname}{lbl} {_prom_float(gauges[name][key])}")
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> dict:
@@ -340,6 +394,22 @@ METRIC_INGEST_SYNC_CHUNKS = "pilosa_ingest_sync_chunks_total"
 METRIC_INGEST_SYNC_COALESCED = "pilosa_ingest_sync_coalesced_total"
 METRIC_INGEST_SYNC_DISPATCHES = "pilosa_ingest_sync_dispatches_total"
 INGEST_PATHS = ("bits", "values", "roaring")
+
+# -- per-tenant cost attribution (docs/observability.md) --------------------
+#   pilosa_tenant_queries_total{tenant=}        queries executed
+#   pilosa_tenant_device_seconds_total{tenant=} attributed device-seconds
+#                                               (each query's share of every
+#                                               fused dispatch it rode)
+#   pilosa_tenant_bytes_touched_total{tenant=}  device bytes its plans read
+#   pilosa_tenant_bytes_skipped_total{tenant=}  bytes its sparse plans skipped
+#   pilosa_tenant_sheds_total{tenant=}          admission sheds charged to it
+# Series are created lazily per tenant (bounded by TenantLedger's
+# cardinality cap; util/plans.py).
+METRIC_TENANT_QUERIES = "pilosa_tenant_queries_total"
+METRIC_TENANT_DEVICE_SECONDS = "pilosa_tenant_device_seconds_total"
+METRIC_TENANT_BYTES_TOUCHED = "pilosa_tenant_bytes_touched_total"
+METRIC_TENANT_BYTES_SKIPPED = "pilosa_tenant_bytes_skipped_total"
+METRIC_TENANT_SHEDS = "pilosa_tenant_sheds_total"
 
 # -- TopN rank-cache maintenance (docs/ingest.md) ---------------------------
 #   pilosa_cache_recalculate_seconds{path=} histogram: ranked-cache
@@ -639,7 +709,8 @@ class PipelineStats:
         self._hists: Dict[str, Histogram] = {}
         self._reg_hists: Dict[str, Histogram] = {}
 
-    def record(self, stage: str, seconds: float, n: int = 1):
+    def record(self, stage: str, seconds: float, n: int = 1,
+               exemplar: Optional[str] = None):
         with self._lock:
             s = self._stages.setdefault(stage, [0, 0.0, 0.0])
             s[0] += n
@@ -654,7 +725,7 @@ class PipelineStats:
                     METRIC_PIPELINE_STAGE, stage=stage
                 )
         h.observe(seconds)
-        rh.observe(seconds)
+        rh.observe(seconds, exemplar=exemplar)
 
     def gauge(self, name: str, value: float):
         with self._lock:

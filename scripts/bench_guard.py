@@ -43,7 +43,7 @@ import argparse
 import json
 import sys
 
-LOWER_BETTER = {"us", "ms", "s", "seconds"}
+LOWER_BETTER = {"us", "ms", "s", "seconds", "pct"}
 HIGHER_BETTER = {"qps", "GB/s", "gbs", "Mbits/s"}
 
 # Headline metrics auto-required whenever the BASELINE carries them: a
@@ -69,7 +69,22 @@ AUTO_REQUIRE = (
     # failure once a baseline records them (docs/ingest.md).
     "ingest_bits_mbits_s",
     "ingest_freshness_p50_ms",
+    # Plan-recording overhead (bench.py --profile-overhead): the query-
+    # plan introspection layer is always-on, so its cost is a headline —
+    # "pct" regresses UP and the <2% target holds via ABS_CEILING once a
+    # baseline records it (docs/observability.md).
+    "profile_overhead_pct",
 )
+
+# Built-in per-metric tolerance (used when no --metric-tolerance names
+# the metric): profile_overhead_pct's denominator is a wall p50 subject
+# to this container's transport jitter, so the ratio wobbles ~2x run to
+# run while the binding contract is the absolute <2% ceiling below.
+DEFAULT_METRIC_TOL = {"profile_overhead_pct": 1.0}
+
+# Absolute ceilings enforced regardless of the baseline value: crossing
+# one is a failure even when the relative delta is within tolerance.
+ABS_CEILING = {"profile_overhead_pct": 2.0}
 
 
 def parse_jsonl(text: str) -> dict:
@@ -166,11 +181,15 @@ def check(current: dict, baseline: dict, tolerance: float,
             continue
         cv = float(cur["value"])
         unit = str(base.get("unit", ""))
-        tol = per_metric.get(name, tolerance)
+        tol = per_metric.get(name, DEFAULT_METRIC_TOL.get(name, tolerance))
         checked += 1
         delta = cv / float(bv) - 1.0
         line = f"{name}: {cv:g} vs {bv:g} {unit} ({delta:+.1%}, tol {tol:.0%})"
-        if unit in LOWER_BETTER and delta > tol:
+        ceiling = ABS_CEILING.get(name)
+        if ceiling is not None and cv > ceiling:
+            failures.append(f"{name}: {cv:g} exceeds the absolute "
+                            f"ceiling {ceiling:g} {unit}")
+        elif unit in LOWER_BETTER and delta > tol:
             failures.append(line)
         elif unit in HIGHER_BETTER and -delta > tol:
             failures.append(line)

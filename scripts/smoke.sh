@@ -9,7 +9,7 @@
 #   sh scripts/smoke.sh tests/     # full non-slow suite, same flags
 set -e
 cd "$(dirname "$0")/.."
-TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_asyncserver.py tests/test_observability.py}"
+TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_asyncserver.py tests/test_observability.py tests/test_plans.py}"
 env JAX_PLATFORMS=cpu python -m pytest $TARGETS -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
@@ -427,6 +427,86 @@ assert 'pilosa_admission_shed_total{reason="tenant_fair"} 1' in text, (
     "shed counter did not record the 429"
 )
 
+# Query-plan introspection + tenant cost attribution smoke
+# (docs/observability.md "Query plans & cost attribution"): ?profile=1
+# returns the plan tree inline with per-op decisions and stage timings,
+# the same trace id resolves at /debug/plans, the OpenMetrics
+# negotiation at /metrics carries trace-id exemplars, and the
+# pilosa_tenant_* ledger series are live — including the hog tenant's
+# shed from the drill above.
+r = urllib.request.Request(
+    f"http://localhost:{port}/index/smoke/query?profile=1",
+    data=b"Count(Intersect(Row(f=1), Row(f=7)))", method="POST",
+    headers={"X-Pilosa-Tenant": "gold"},
+)
+doc = json.loads(urllib.request.urlopen(r, timeout=60).read())
+plan = doc.get("plan")
+assert plan and plan["traceID"] == doc["traceID"], doc
+assert plan["tenant"] == "gold" and plan["ops"], plan
+assert plan["stagesMs"], plan
+
+pd = json.loads(urllib.request.urlopen(
+    f"http://localhost:{port}/debug/plans?trace={plan['traceID']}", timeout=30
+).read())
+assert pd["plans"] and pd["plans"][0]["traceID"] == plan["traceID"], pd
+
+om = urllib.request.urlopen(urllib.request.Request(
+    f"http://localhost:{port}/metrics",
+    headers={"Accept": "application/openmetrics-text"},
+), timeout=30).read().decode()
+assert om.rstrip().endswith("# EOF"), "OpenMetrics exposition lacks # EOF"
+assert any(
+    "pilosa_query_seconds_bucket" in l and ' # {trace_id="' in l
+    for l in om.splitlines()
+), "no pilosa_query_seconds exemplar in the OpenMetrics exposition"
+tenant_required = [
+    'pilosa_tenant_queries_total{tenant="gold"}',
+    'pilosa_tenant_device_seconds_total{tenant="gold"}',
+    'pilosa_tenant_bytes_touched_total{tenant="gold"}',
+    'pilosa_tenant_sheds_total{tenant="hog"}',
+]
+missing = [s for s in tenant_required if s not in om]
+assert not missing, f"/metrics is missing tenant series: {missing}"
+# Classic negotiation stays exemplar-free and EOF-free (pre-OpenMetrics
+# scrapers reject both syntaxes).
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+assert "trace_id=" not in text and "# EOF" not in text, (
+    "classic Prometheus exposition leaked OpenMetrics syntax"
+)
+
 srv.shutdown()
-print("observability smoke OK: /metrics + /debug/traces + health/readiness + federation + admission wired")
+
+# Both backends (acceptance): the threaded differential oracle serves
+# the same plan + exemplar surfaces as the reactor.
+srv2, _ = serve(api, port=0, backend="threaded")
+port2 = srv2.server_address[1]
+r = urllib.request.Request(
+    f"http://localhost:{port2}/index/smoke/query?profile=1",
+    data=b"Count(Intersect(Row(f=7), Row(f=8)))", method="POST",
+    headers={"X-Pilosa-Tenant": "gold"},
+)
+doc = json.loads(urllib.request.urlopen(r, timeout=60).read())
+assert doc.get("plan") and doc["plan"]["ops"], doc
+assert doc["plan"]["traceID"] == doc["traceID"], doc
+pd = json.loads(urllib.request.urlopen(
+    f"http://localhost:{port2}/debug/plans?trace={doc['plan']['traceID']}",
+    timeout=30,
+).read())
+assert pd["plans"], pd
+om = urllib.request.urlopen(urllib.request.Request(
+    f"http://localhost:{port2}/metrics",
+    headers={"Accept": "application/openmetrics-text"},
+), timeout=30).read().decode()
+assert any(
+    "pilosa_query_seconds_bucket" in l and ' # {trace_id="' in l
+    for l in om.splitlines()
+), "threaded backend: no query exemplar in the OpenMetrics exposition"
+assert "pilosa_tenant_device_seconds_total" in om, (
+    "threaded backend: tenant ledger series missing"
+)
+srv2.shutdown()
+
+print("observability smoke OK: /metrics + /debug/traces + health/readiness + federation + admission + plans/tenant-ledger wired (both backends)")
 EOF

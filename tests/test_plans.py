@@ -1,0 +1,475 @@
+"""Query plan introspection + per-tenant cost attribution
+(docs/observability.md "Query plans & cost attribution").
+
+Differential discipline: a recorded plan must match OBSERVABLE engine
+behavior — a sparse-path plan coincides with the bytes-skipped counter
+advancing, a memo-hit plan with ZERO new device dispatches, a fused plan
+with ZERO internal-client calls — on both serving backends.  The
+analyzer's annotations are asserted against the conditions that produce
+them, and the ledger/admission feedback loop against measured cost."""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API, QueryRequest
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.net import serve
+from pilosa_tpu.net.admission import AdmissionController
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.ops.bitops import OCC_BLOCK_BITS
+from pilosa_tpu.parallel import MeshEngine, make_mesh
+from pilosa_tpu.util import plans
+from pilosa_tpu.util.stats import REGISTRY
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _api(mesh, rows_blocks=None, n_shards=4):
+    """Holder + engine + API with a clustered field: row r occupies the
+    given occupancy blocks per shard (sparse-eligible by construction)."""
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(7)
+    rows_blocks = rows_blocks or {1: (0, 1), 2: (1, 3)}
+    row_ids, cols = [], []
+    for s in range(n_shards):
+        base = s * SHARD_WIDTH
+        for r, blocks in rows_blocks.items():
+            for b in blocks:
+                for c in rng.choice(OCC_BLOCK_BITS, size=30, replace=False):
+                    row_ids.append(r)
+                    cols.append(base + b * OCC_BLOCK_BITS + int(c))
+    f.import_bulk(row_ids, cols)
+    eng = MeshEngine(holder, mesh)
+    return API(holder=holder, mesh_engine=eng), eng, f
+
+
+INTERSECT = "Count(Intersect(Row(f=1), Row(f=2)))"
+
+
+# -- plan <-> behavior differentials ----------------------------------------
+
+
+def test_sparse_plan_matches_bytes_skipped_counter(mesh):
+    api, eng, _ = _api(mesh)
+    skipped0 = eng.device_bytes_skipped
+    resp = api.query(QueryRequest("i", INTERSECT, profile=True))
+    plan = resp.plan
+    op = plan["ops"][0]
+    assert op["path"] == "sparse", plan
+    assert op["blocks_surviving"] < op["blocks_total"]
+    # The recorded skip must equal what the engine counter observed.
+    assert eng.device_bytes_skipped - skipped0 == plan["bytesSkipped"] > 0
+    assert op["memo"] == "miss" and op["memo_reason"] == "first_seen"
+    # Per-stage timing attribution exists (direct path: one "execute").
+    assert plan["stagesMs"], plan
+    assert plan["deviceSeconds"] > 0
+    eng.close()
+
+
+def test_memo_hit_plan_means_no_new_dispatch(mesh):
+    api, eng, f = _api(mesh)
+    api.query(QueryRequest("i", INTERSECT))
+    disp0 = eng.fused_dispatches
+    resp = api.query(QueryRequest("i", INTERSECT, profile=True))
+    assert resp.plan["ops"] == [
+        {"op": "Count", "path": "memo", "memo": "hit"}
+    ]
+    assert eng.fused_dispatches == disp0, "memo-hit plan still dispatched"
+    # A write advances the version tokens: the next plan records WHY the
+    # memo missed, and the analyzer annotates it.
+    f.import_bulk([1], [3 * OCC_BLOCK_BITS + 5])
+    resp = api.query(QueryRequest("i", INTERSECT, profile=True))
+    op = resp.plan["ops"][0]
+    assert op["memo"] == "miss"
+    assert op["memo_reason"] == "version_token_advanced"
+    assert any("version token advanced" in a for a in resp.plan["annotations"])
+    assert eng.fused_dispatches == disp0 + 1
+    eng.close()
+
+
+def test_dense_fallback_records_occupancy(mesh):
+    # Every block of every shard occupied -> the sparse plan declines
+    # and the plan explains the dense fallback with the occupancy it saw.
+    api, eng, _ = _api(mesh, rows_blocks={1: tuple(range(64)),
+                                          2: tuple(range(64))}, n_shards=1)
+    resp = api.query(QueryRequest("i", INTERSECT, profile=True))
+    op = resp.plan["ops"][0]
+    assert op["path"] == "dense", resp.plan
+    assert op["occ_fraction"] == 1.0
+    assert op["bytes_touched"] > 0
+    assert any(a.startswith("dense fallback") for a in resp.plan["annotations"])
+    eng.close()
+
+
+def test_explain_plans_without_dispatching(mesh):
+    api, eng, _ = _api(mesh)
+    disp0 = eng.fused_dispatches
+    resp = api.query(QueryRequest("i", f"Explain({INTERSECT})"))
+    doc = resp.results[0]
+    assert doc["dryRun"] is True
+    assert doc["plannedPath"] == "sparse"
+    assert 0 < doc["blocksSurviving"] < doc["blocksTotal"]
+    assert doc["estBytesSkipped"] > 0
+    assert doc["memo"] == "miss"
+    assert eng.fused_dispatches == disp0, "Explain() dispatched the device"
+    # The projection must agree with the real execution's decision.
+    real = api.query(QueryRequest("i", INTERSECT, profile=True))
+    assert real.plan["ops"][0]["path"] == doc["plannedPath"]
+    # Fast-lane eligibility is reported for the bare-Row shape.
+    resp = api.query(QueryRequest("i", "Explain(Count(Row(f=1)))"))
+    assert resp.results[0]["fastCardinalityEligible"] is True
+    eng.close()
+
+
+def test_fast_cardinality_plan(mesh):
+    api, eng, _ = _api(mesh)
+    disp0 = eng.fused_dispatches
+    resp = api.query(QueryRequest("i", "Count(Row(f=1))", profile=True))
+    assert resp.plan["ops"][0]["path"] == "fast_cardinality"
+    assert eng.fused_dispatches == disp0
+    eng.close()
+
+
+# -- HTTP surfaces (both backends) ------------------------------------------
+
+
+@pytest.fixture(params=["async", "threaded"])
+def server(request, mesh):
+    api, eng, f = _api(mesh)
+    srv, _thread = serve(api, port=0, backend=request.param)
+    port = srv.server_address[1]
+    yield api, eng, f, port
+    srv.shutdown()
+    eng.close()
+
+
+def _post(port, body, path_extra="", headers=None):
+    r = urllib.request.Request(
+        f"http://localhost:{port}/index/i/query{path_extra}",
+        data=body.encode(), method="POST", headers=headers or {},
+    )
+    return json.loads(urllib.request.urlopen(r, timeout=60).read())
+
+
+def _get(port, path, headers=None):
+    r = urllib.request.Request(
+        f"http://localhost:{port}{path}", headers=headers or {}
+    )
+    return urllib.request.urlopen(r, timeout=30).read().decode()
+
+
+def test_profile_roundtrip_and_debug_plans(server):
+    api, eng, f, port = server
+    calls0 = eng.holder and 0
+    doc = _post(port, INTERSECT, "?profile=1",
+                headers={"X-Pilosa-Tenant": "gold"})
+    plan = doc["plan"]
+    assert plan["traceID"] == doc["traceID"]
+    assert plan["tenant"] == "gold"
+    op = plan["ops"][0]
+    # The acceptance shape: sparse path named, blocks surviving/total,
+    # bytes skipped, memo status, per-stage timings.
+    assert op["path"] == "sparse"
+    assert op["blocks_surviving"] < op["blocks_total"]
+    assert plan["bytesSkipped"] > 0
+    assert op["memo"] in ("miss", "hit")
+    assert plan["stagesMs"]
+    # Fused plan differential: a single-node query must not have made
+    # ANY internal-client calls (the psum IS the reduce).
+    assert op.get("fused") is True
+    assert api.executor.remote_fanouts == 0 == calls0
+    # /debug/plans: findable by trace id (the exemplar click-through)
+    # and present in the recent ring.
+    pd = json.loads(_get(port, f"/debug/plans?trace={plan['traceID']}"))
+    assert pd["plans"][0]["traceID"] == plan["traceID"]
+    pd = json.loads(_get(port, "/debug/plans?op=Count&limit=8"))
+    assert any(p["traceID"] == plan["traceID"] for p in pd["recent"])
+    # ...and the same trace id resolves at /debug/traces.
+    deadline = time.monotonic() + 10
+    while True:
+        tr = json.loads(_get(port, "/debug/traces"))
+        if any(t["traceID"] == plan["traceID"] for t in tr["recent"]):
+            break
+        assert time.monotonic() < deadline, "trace id never registered"
+        time.sleep(0.05)
+
+
+def test_openmetrics_exemplars_negotiated(server):
+    api, eng, f, port = server
+    doc = _post(port, INTERSECT, "?profile=1")
+    om = _get(port, "/metrics",
+              headers={"Accept": "application/openmetrics-text"})
+    assert om.rstrip().endswith("# EOF")
+    ex_lines = [l for l in om.splitlines() if " # {trace_id=" in l]
+    assert ex_lines, "no exemplars in the OpenMetrics exposition"
+    # Exemplars ride _bucket samples only, in OpenMetrics syntax.
+    ex_re = re.compile(
+        r'^[a-zA-Z0-9_:]+_bucket\{.*\} \d+ '
+        r'# \{trace_id="[0-9a-f]+"\} [0-9.e+-]+ [0-9.e+-]+$'
+    )
+    for line in ex_lines:
+        assert ex_re.match(line), line
+    assert any("pilosa_query_seconds_bucket" in l for l in ex_lines)
+    # The tenant cost series is present with a real value.
+    assert "pilosa_tenant_device_seconds_total" in om
+    # Classic negotiation stays exemplar-free and EOF-free (old scrapers).
+    classic = _get(port, "/metrics")
+    assert "trace_id=" not in classic and "# EOF" not in classic
+    # An OM exemplar's trace id resolves to a plan (the click-through).
+    tid = re.search(r'trace_id="([0-9a-f]+)"', ex_lines[0]).group(1)
+    pd = json.loads(_get(port, f"/debug/plans?trace={tid}"))
+    assert isinstance(pd["plans"], list)  # resolvable surface (may be aged out)
+
+
+def test_pipelined_plan_stages_on_async_backend(mesh):
+    api, eng, _ = _api(mesh)
+    srv, _thread = serve(api, port=0, backend="async")
+    port = srv.server_address[1]
+    try:
+        doc = _post(port, INTERSECT, "?profile=1")
+        plan = doc["plan"]
+        assert plan["pipelined"] is True
+        # The batch pipeline's stage attribution made it onto the plan.
+        assert set(plan["stagesMs"]) >= {"queue_wait", "device_readback"}
+        assert plan["deviceSeconds"] > 0
+    finally:
+        srv.shutdown()
+        eng.close()
+
+
+# -- plan store / analyzer ---------------------------------------------------
+
+
+def _mkplan(op="Count", duration=0.2, **opkw):
+    p = plans.QueryPlan("i", "q", tenant="t")
+    p.note_op(op=op, **opkw)
+    p.finish(duration, trace_id=f"t{int(duration * 1e6):x}")
+    return p
+
+
+def test_plan_store_slow_retention_bounded():
+    store = plans.PlanStore(keep=4, keep_slow_per_op=2)
+    for i in range(8):
+        store.record(_mkplan(duration=0.15 + i / 100))
+        store.record(_mkplan(op="TopN", duration=0.15 + i / 100))
+    doc = store.to_doc()
+    assert len(doc["recent"]) == 4  # ring bound
+    assert set(doc["slow"]) == {"Count", "TopN"}
+    for worst in doc["slow"].values():
+        assert len(worst) == 2  # per-op bound
+        # worst-first retention: the slowest two of the eight
+        assert worst[0]["durationMs"] >= worst[1]["durationMs"] >= 200
+    fast = _mkplan(duration=0.001)
+    store.record(fast)
+    assert store.find(fast.trace_id) is fast
+    # Op filter applies to both sections: only TopN plans come back.
+    filtered = store.to_doc(op="TopN", limit=4)
+    assert set(filtered["slow"]) == {"TopN"}
+    assert filtered["recent"] and all(
+        p["ops"][0]["op"] == "TopN" for p in filtered["recent"]
+    )
+
+
+def test_analyzer_queue_wait_and_fanout_annotations():
+    p = plans.QueryPlan("i", "q")
+    p.note_op(op="Count", path="dense_batch", local_shards=6)
+    p.note_stage("queue_wait", 0.09)
+    p.note_fanout("node2", 0.05, 2)
+    p.finish(0.12)
+    notes = plans.analyze(p, slow=True)
+    assert any("queue wait dominated" in n for n in notes)
+    assert any(
+        "remote fan-out: 2/8 shards non-local" in n and "node2" in n
+        for n in notes
+    )
+
+
+def test_analyzer_topn_links_rank_cache_series():
+    p = plans.QueryPlan("i", "TopN(f)")
+    p.note_op(op="TopN", seconds=0.2)
+    p.finish(0.2)
+    notes = plans.analyze(p, slow=True)
+    assert any(
+        "ranked cache" in n and "pilosa_cache_recalculate_seconds" in n
+        for n in notes
+    )
+
+
+# -- tenant ledger + admission feedback --------------------------------------
+
+
+def test_tenant_ledger_accounting_and_cardinality_cap():
+    led = plans.TenantLedger(max_tenants=2)
+    p = plans.QueryPlan("i", "q", tenant="a")
+    p.note_op(op="Count", path="dense", bytes_touched=100)
+    p.note_device_seconds(0.5)
+    p.finish(0.6)
+    led.account(p)
+    led.note_shed("a")
+    snap = led.snapshot()
+    assert snap["a"] == {
+        "queries": 1, "deviceSeconds": 0.5, "bytesTouched": 100,
+        "bytesSkipped": 0, "sheds": 1,
+    }
+    # Past the cap, new tenants accrue under the overflow bucket —
+    # registry cardinality stays bounded.
+    for t in ("b", "c", "d"):
+        q = plans.QueryPlan("i", "q", tenant=t)
+        q.finish(0.1)
+        led.account(q)
+    snap = led.snapshot()
+    assert set(snap) == {"a", "b", plans.TenantLedger.OVERFLOW}
+    assert snap[plans.TenantLedger.OVERFLOW]["queries"] == 2
+    # Registry counters sync at pull time (refresh_series runs at
+    # /metrics scrape), and a second flush adds nothing new.
+    led.refresh_series()
+    c = REGISTRY.counter("pilosa_tenant_queries_total", tenant="a")
+    v = c.get()
+    assert v >= 1
+    led.refresh_series()
+    assert c.get() == v
+
+
+def test_admission_prices_measured_cost():
+    adm = AdmissionController(max_inflight=16, fair_start=0.0,
+                              weights={})
+    # Without a cost signal: pure request-count fairness (two equal
+    # tenants -> 8 each).
+    admitted = 0
+    while adm.admit("hog") is None:
+        admitted += 1
+    assert admitted == 16  # lone tenant: whole pipe (work-conserving)
+    for _ in range(admitted):
+        adm.release("hog")
+    # Feed measured cost: hog queries cost 4x the mean -> its in-flight
+    # occupancy prices 4x and it saturates at ~1/4 the slots.
+    led = plans.TenantLedger()
+    led.bind_admission(adm)
+    for _ in range(8):
+        adm.note_cost("hog", 0.4)
+        adm.note_cost("light", 0.1)
+    assert adm.admit("light") is None  # keeps light active in the set
+    expensive = 0
+    while adm.admit("hog") is None:
+        expensive += 1
+    cheap_share_only = expensive
+    assert 0 < cheap_share_only < 8, (
+        f"cost-priced hog admitted {expensive}; "
+        "expected well under its request-count share"
+    )
+    snap = adm.snapshot()
+    assert snap["costEwma"]["hog"] > snap["costEwma"]["light"]
+
+
+def test_cost_clamp_never_starves():
+    adm = AdmissionController(max_inflight=16, fair_start=0.0)
+    adm.note_cost("heavy", 1000.0)
+    adm.note_cost("light", 0.0001)
+    assert adm.admit("light") is None
+    # Even at a 10^7 cost ratio the clamp (4x) leaves the heavy tenant
+    # admittable: share 8, occupancy 1*4 <= 8.
+    assert adm.admit("heavy") is None
+    # Zero-in-flight floor: with enough active tenants that the fair
+    # share (16/5 = 3.2) falls BELOW the 4x cost clamp, a heavy tenant
+    # with nothing in flight must still be admitted — cost pricing
+    # throttles occupancy, it must never shed a tenant down to zero
+    # (its EWMA only moves on completions, so a full shed could never
+    # recover).
+    adm2 = AdmissionController(max_inflight=16, fair_start=0.0)
+    for t in ("a", "b", "c", "d"):
+        adm2.note_cost(t, 0.001)
+        assert adm2.admit(t) is None
+    adm2.note_cost("heavy", 1.0)  # ~4x the active mean after clamping
+    assert adm2.admit("heavy") is None
+    # ...and once it holds a slot, the multiplier DOES throttle it
+    # below its request-count share: (1+1)*4 = 8 > 3.2.
+    decision = adm2.admit("heavy")
+    assert decision is not None and decision[0] == 429
+
+
+# -- pprof profile satellite -------------------------------------------------
+
+
+def test_pprof_profile_serialized_and_capped(mesh):
+    from pilosa_tpu.net.server import Handler
+
+    api, eng, _ = _api(mesh)
+    handler = Handler(api)
+    results = []
+
+    def run():
+        results.append(
+            handler._debug_pprof_profile({"seconds": ["0.2"], "hz": ["200"]}, b"")
+        )
+
+    threads = [threading.Thread(target=run) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(results) == 2
+    a, b = sorted(results, key=lambda r: r["startedMonotonic"])
+    # Serialized capture windows: the second profile's samples start
+    # after the first finished (no interleaving).
+    assert b["startedMonotonic"] >= a["endedMonotonic"]
+    for r in results:
+        assert r["samples"] > 0
+        assert r["distinctStacks"] <= r["maxStacks"]
+    # Retention cap: with a cap of 1, extra distinct stacks aggregate
+    # under the overflow key instead of growing without bound.
+    old = Handler.PPROF_MAX_STACKS
+    try:
+        Handler.PPROF_MAX_STACKS = 1
+
+        # Three DISTINCT code objects (unique folded stacks) so the
+        # cap-of-1 retention must overflow.
+        spin_fns = []
+        for i in range(3):
+            ns: dict = {"time": time}
+            exec(
+                f"def spin_{i}():\n"
+                "    t_end = time.monotonic() + 0.5\n"
+                "    while time.monotonic() < t_end:\n"
+                "        sum(range(50))\n",
+                ns,
+            )
+            spin_fns.append(ns[f"spin_{i}"])
+        spinners = [threading.Thread(target=fn) for fn in spin_fns]
+        for t in spinners:
+            t.start()
+        out = handler._debug_pprof_profile(
+            {"seconds": ["0.2"], "hz": ["200"]}, b""
+        )
+        for t in spinners:
+            t.join(10)
+        assert out["distinctStacks"] <= 2  # 1 stack + <overflow>
+        assert out["truncatedSamples"] > 0
+    finally:
+        Handler.PPROF_MAX_STACKS = old
+    eng.close()
+
+
+# -- overhead guardrail ------------------------------------------------------
+
+
+def test_plans_disabled_records_nothing(monkeypatch, mesh):
+    monkeypatch.setattr(plans, "ENABLED", False)
+    api, eng, _ = _api(mesh)
+    before = plans.STORE.recorded
+    resp = api.query(QueryRequest("i", INTERSECT, profile=True))
+    assert resp.results == [pytest.approx(resp.results[0])]
+    assert resp.plan is None
+    assert plans.STORE.recorded == before
+    eng.close()
