@@ -354,7 +354,8 @@ def report_scrape(port):
         emit_raw(name, v, "bytes" if "bytes" in name else "", 1.0)
 
 
-def main(depth_sweep=False, conn_sweep=False, scrape=False):
+def main(depth_sweep=False, conn_sweep=False, scrape=False,
+         workers_sweep=False):
     progress("importing jax")
     import jax
     import jax.numpy as jnp
@@ -1059,7 +1060,7 @@ for t in threads: t.join()
 print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
 """
 
-    def run_open_loop(texts, n_conns, per_conn):
+    def run_open_loop(texts, n_conns, per_conn, to_port=None):
         import os as os_mod
         import tempfile
 
@@ -1068,7 +1069,8 @@ print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
         script.close()
         try:
             p = subprocess.Popen(
-                [sys_mod.executable, script.name, str(port), str(n_conns),
+                [sys_mod.executable, script.name,
+                 str(port if to_port is None else to_port), str(n_conns),
                  str(per_conn)],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             )
@@ -1097,6 +1099,178 @@ print(json.dumps({"n": sum(done), "seconds": time.perf_counter() - t0}))
             progress(
                 f"conn sweep c{n_conns}: {c_qps:.1f} qps over {c_total}, "
                 f"occupancy {occ:.2f}"
+            )
+
+    # ---- optional worker-process sweep (--conn-sweep --workers) ----------
+    # The GIL wall, measured: the SAME open-loop load at a fixed
+    # connection count against w worker PROCESSES owning HTTP parse /
+    # PQL decode / response encode behind SO_REUSEPORT, forwarding
+    # decoded frames over AF_UNIX into THIS process's batch pipeline
+    # (docs/serving.md "Process mode").  Every w level — including the
+    # w=0 oracle — boots a FRESH server and is driven by the same
+    # load generator in one run, so the whole w-curve shares one run's
+    # conditions; http_count_qps_w0 is the differential oracle the
+    # acceptance ratio (w2 vs w0) is judged against.
+    #
+    # The load generator here is a single-threaded selectors client
+    # (one thread, nonblocking sockets, pipelined writes): the threaded
+    # per-connection client above spends more scheduler bandwidth than
+    # the servers under test on this class of container (128 runnable
+    # client threads on 2 vCPUs convoy every PROCESS of the system),
+    # which measures the client, not the serving tier.
+    EV_LOOP_SRC = r"""
+import json, selectors, socket, sys, time
+port, n_conns, per_conn = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))
+texts = json.loads(sys.stdin.read())
+
+def build(body):
+    b = body.encode()
+    return (b"POST /index/b10m/query HTTP/1.1\r\nHost: l\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(b)).encode() + b"\r\n\r\n" + b)
+
+reqs = [build(t) for t in texts]
+
+class Conn:
+    __slots__ = ("s", "out", "off", "rbuf", "got", "want")
+    def __init__(self, cid):
+        self.s = socket.create_connection(("localhost", port), timeout=300)
+        self.s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.s.setblocking(False)
+        self.out = b"".join(reqs[(cid * per_conn + j) % len(reqs)]
+                            for j in range(per_conn))
+        self.off = 0
+        self.rbuf = bytearray()
+        self.got = 0
+        self.want = per_conn
+
+def count_responses(c):
+    n = 0
+    buf = c.rbuf
+    while True:
+        end = buf.find(b"\r\n\r\n")
+        if end < 0:
+            break
+        cl = 0
+        for ln in bytes(buf[:end]).lower().split(b"\r\n"):
+            if ln.startswith(b"content-length:"):
+                cl = int(ln.split(b":")[1])
+        total = end + 4 + cl
+        if len(buf) < total:
+            break
+        assert buf.startswith(b"HTTP/1.1 200"), bytes(buf[:40])
+        del buf[:total]
+        n += 1
+    return n
+
+sel = selectors.DefaultSelector()
+conns = [Conn(c) for c in range(n_conns)]
+for c in conns:
+    sel.register(c.s, selectors.EVENT_READ | selectors.EVENT_WRITE, c)
+t0 = time.perf_counter()
+live = len(conns)
+while live:
+    for key, mask in sel.select(timeout=1.0):
+        c = key.data
+        if mask & selectors.EVENT_WRITE:
+            if c.off < len(c.out):
+                try:
+                    c.off += c.s.send(c.out[c.off:])
+                except (BlockingIOError, InterruptedError):
+                    pass
+            if c.off >= len(c.out):
+                sel.modify(c.s, selectors.EVENT_READ, c)
+        if mask & selectors.EVENT_READ:
+            try:
+                chunk = c.s.recv(1 << 18)
+            except (BlockingIOError, InterruptedError):
+                continue
+            if not chunk:
+                # Server closed early: surface the short count instead
+                # of spinning on a level-triggered dead socket forever.
+                sys.stderr.write(
+                    f"conn closed early at {c.got}/{c.want}\n"
+                )
+                sel.unregister(c.s)
+                c.s.close()
+                live -= 1
+                continue
+            c.rbuf += chunk
+            c.got += count_responses(c)
+            if c.got >= c.want:
+                sel.unregister(c.s)
+                c.s.close()
+                live -= 1
+print(json.dumps({"n": sum(c.got for c in conns),
+                  "seconds": time.perf_counter() - t0}))
+"""
+
+    def run_ev_loop(texts, n_conns, per_conn, to_port):
+        import os as os_mod
+        import tempfile
+
+        script = tempfile.NamedTemporaryFile("w", suffix=".py", delete=False)
+        script.write(EV_LOOP_SRC)
+        script.close()
+        try:
+            p = subprocess.Popen(
+                [sys_mod.executable, script.name, str(to_port),
+                 str(n_conns), str(per_conn)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            )
+            out, _ = p.communicate(json.dumps(texts).encode(), timeout=600)
+        finally:
+            os_mod.unlink(script.name)
+        doc = json.loads(out)
+        return doc["n"] / doc["seconds"], doc["n"]
+
+    if conn_sweep and workers_sweep:
+        texts = [t.decode() for t in c2_texts]
+        W_CONNS, W_TOTAL = 128, 8192
+        w_results = {}
+        for w in (0, 1, 2, 4, 8):
+            wsrv, _ = serve(
+                api, "localhost", 0, workers=w,
+                admission=AdmissionController(max_inflight=1 << 17),
+            )
+            if w and not wsrv.wait_ready(120):
+                progress(f"workers={w}: workers never connected; skipped")
+                wsrv.shutdown()
+                continue
+            wport = wsrv.server_address[1]
+            run_ev_loop(texts, 8, 32, wport)  # warm conns + worker boots
+            b = eng._batcher
+            b0, q0 = (b.batches, b.batched_queries) if b else (0, 0)
+            x0 = (
+                b.pipeline.snapshot()["counters"].get(
+                    "cross_worker_fused_batches", 0
+                ) if b else 0
+            )
+            w_qps, w_total = run_ev_loop(
+                texts, W_CONNS, max(32, W_TOTAL // W_CONNS), wport
+            )
+            emit_raw(f"http_count_qps_w{w}", w_qps, "qps", w_qps * c_c2)
+            w_results[w] = w_qps
+            b = eng._batcher
+            occ = (
+                (b.batched_queries - q0) / (b.batches - b0)
+                if b is not None and b.batches > b0 else 0.0
+            )
+            xw = (
+                b.pipeline.snapshot()["counters"].get(
+                    "cross_worker_fused_batches", 0
+                ) - x0 if b else 0
+            )
+            progress(
+                f"workers sweep w{w}: {w_qps:.1f} qps over {w_total}, "
+                f"occupancy {occ:.2f}, cross-worker fused batches {xw}"
+            )
+            wsrv.shutdown()
+        if 0 in w_results and 2 in w_results and w_results[0] > 0:
+            progress(
+                "workers sweep ratio w2/w0: "
+                f"{w_results[2] / w_results[0]:.2f}x"
             )
     if scrape:
         report_scrape(port)
@@ -2064,6 +2238,16 @@ if __name__ == "__main__":
         "curve (docs/serving.md)",
     )
     ap.add_argument(
+        "--workers",
+        action="store_true",
+        help="with --conn-sweep: also sweep shared-nothing worker "
+        "PROCESSES (0/1/2/4/8 behind SO_REUSEPORT, decoded frames over "
+        "AF_UNIX into this process's batcher) at a fixed connection "
+        "count, emitting http_count_qps_w{N} plus the fused-batch "
+        "occupancy and cross-worker fused-batch counter per level — the "
+        "GIL-wall curve (docs/serving.md \"Process mode\")",
+    )
+    ap.add_argument(
         "--multichip",
         nargs="?",
         const=8,
@@ -2131,4 +2315,5 @@ if __name__ == "__main__":
             depth_sweep=args.depth_sweep,
             conn_sweep=args.conn_sweep,
             scrape=args.scrape,
+            workers_sweep=args.workers,
         )

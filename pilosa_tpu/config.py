@@ -85,8 +85,16 @@ class Config:
         # SO_REUSEPORT acceptor/reactor workers (scale-out knob; 1 is
         # right for a single-core host).
         self.server_reactors = 1
-        # Elastic blocking-route worker ceiling + bounded submit queue.
-        self.server_workers = 256
+        # Shared-nothing worker PROCESSES behind SO_REUSEPORT
+        # (docs/serving.md "Process mode"): N worker processes own
+        # accept/parse/decode/encode and forward decoded queries to the
+        # device-owner process over AF_UNIX.  0 (default) keeps the
+        # in-process reactor — byte-identical to pre-process-mode
+        # behavior and the differential oracle alongside "threaded".
+        self.server_workers = 0
+        # Elastic blocking-route worker THREAD ceiling + bounded submit
+        # queue (per process).
+        self.server_pool_workers = 256
         self.server_queue_depth = 1024
         # Admission control: global in-flight bound, the load fraction
         # where per-tenant weighted fairness arms, the tenant weight map
@@ -195,6 +203,9 @@ class Config:
         self.server_backend = srv.get("backend", self.server_backend)
         self.server_reactors = int(srv.get("reactors", self.server_reactors))
         self.server_workers = int(srv.get("workers", self.server_workers))
+        self.server_pool_workers = int(
+            srv.get("pool-workers", self.server_pool_workers)
+        )
         self.server_queue_depth = int(
             srv.get("queue-depth", self.server_queue_depth)
         )
@@ -273,6 +284,7 @@ class Config:
             ("server_backend", "SERVER_BACKEND", str),
             ("server_reactors", "SERVER_REACTORS", int),
             ("server_workers", "SERVER_WORKERS", int),
+            ("server_pool_workers", "SERVER_POOL_WORKERS", int),
             ("server_queue_depth", "SUBMIT_QUEUE", int),
             ("server_max_inflight", "MAX_INFLIGHT", int),
             ("server_fair_start", "FAIR_START", float),
@@ -342,6 +354,7 @@ allowed-origins = [{", ".join(f'"{o}"' for o in self.handler_allowed_origins)}]
 backend = "{self.server_backend}"
 reactors = {self.server_reactors}
 workers = {self.server_workers}
+pool-workers = {self.server_pool_workers}
 queue-depth = {self.server_queue_depth}
 max-inflight = {self.server_max_inflight}
 fair-start = {self.server_fair_start}

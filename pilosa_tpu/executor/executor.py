@@ -625,6 +625,53 @@ class Executor:
         self.stats.count("Count", len(calls), tags=[f"index:{index}"])
         return _QueryFuture(self, index, query, shards, opt, slots, items)
 
+    def memo_counts(self, index, query: str):
+        """Serving-boundary memo lane: the list of counts when EVERY
+        top-level Count of ``query`` hits the engine's versioned result
+        memo against the index's full shard set, else None (the caller
+        runs the full deferred path).  This is what the process-mode
+        device-owner answers a repeat dashboard query with — parse-cache
+        hit + memo lookups, no executor machinery, no batcher touch —
+        so the single device-owner GIL spends its microseconds only on
+        queries that need the device.  Correctness matches the batcher's
+        memo fast path exactly: the key carries the version token of
+        every referenced view, so any write re-keys its readers
+        (engine._memo_key).  Hit counters move only when the lane
+        answers; a partial hit falls through and the full path counts
+        its own probes."""
+        eng = self.mesh_engine
+        if (
+            eng is None
+            or self.cluster is not None
+            or self.translator is not None
+            or getattr(eng, "memo_probe", None) is None
+            or eng._peerless_multiproc
+        ):
+            return None
+        try:
+            q = self._parse_cached(query)
+            calls = q.calls
+            if not calls or any(
+                c.name != "Count" or len(c.children) != 1 for c in calls
+            ):
+                return None
+            shards = self._default_shards(index) or [0]
+            memo = eng.result_memo
+            out = []
+            for c in calls:
+                key = eng._memo_key(index, c.children[0], shards)
+                if key is None:
+                    return None
+                v = memo.get(key)
+                if v is None:
+                    return None
+                out.append(int(v))
+        except Exception:  # noqa: BLE001 — any surprise: full path decides
+            return None
+        for _ in calls:
+            eng._cache_hit("result_memo")
+        return out
+
     def _execute_fast_count(self, index, query, shards):
         """O(1)-lane probe: returns (response, parsed).  ``response`` is
         set when the lane answered; otherwise ``parsed`` (when available)

@@ -238,13 +238,51 @@ class _Reactor(threading.Thread):
         self.conns: set = set()
         self._stopping = False
         self._last_sweep = time.monotonic()
+        self._tid: Optional[int] = None
+        # (sock, callback) pairs registered before start(): extra
+        # readable fds the loop watches alongside its connections —
+        # process-mode workers hook their engine link in here so ONE
+        # thread owns client sockets AND the IPC socket (no cross-
+        # thread handoff, no wake syscalls, no GIL ping-pong on the
+        # query path).
+        self._externals: list = []
+
+    def add_external(self, sock, callback):
+        """Watch ``sock`` for readability and run ``callback`` on the
+        loop thread.  Must be called before the reactor starts."""
+        self._externals.append((sock, callback))
+
+    def register_external_soon(self, sock, callback):
+        """Thread-safe dynamic variant of ``add_external``: the
+        registration runs on the loop thread (selectors are not safe to
+        mutate mid-select from outside).  The process-mode device-owner
+        hooks (re)spawned worker links in with this."""
+        def _do():
+            try:
+                sock.setblocking(False)
+                self.sel.register(sock, selectors.EVENT_READ, ("ext", callback))
+            except (KeyError, ValueError, OSError):
+                pass
+        self.call_soon(_do)
+
+    def unregister_external_soon(self, sock):
+        def _do():
+            try:
+                self.sel.unregister(sock)
+            except (KeyError, ValueError, OSError):
+                pass
+        self.call_soon(_do)
 
     # -- cross-thread marshalling ------------------------------------------
 
     def call_soon(self, fn):
         """Queue ``fn`` to run on the loop (thread-safe; deque append is
-        GIL-atomic).  One wake byte per quiet period, not per call."""
+        GIL-atomic).  One wake byte per quiet period, not per call —
+        and none at all from the loop thread itself (its next select
+        uses a zero timeout while callbacks are pending)."""
         self._pending.append(fn)
+        if threading.get_ident() == self._tid:
+            return
         if not self._signaled:
             self._signaled = True
             try:
@@ -259,11 +297,17 @@ class _Reactor(threading.Thread):
     # -- loop ---------------------------------------------------------------
 
     def run(self):
+        self._tid = threading.get_ident()
         self.sel.register(self.lsock, selectors.EVENT_READ, ("accept", None))
         self.sel.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
+        for s, cb in self._externals:
+            s.setblocking(False)
+            self.sel.register(s, selectors.EVENT_READ, ("ext", cb))
         try:
             while not self._stopping:
-                events = self.sel.select(timeout=0.5)
+                events = self.sel.select(
+                    timeout=0.0 if self._pending else 0.5
+                )
                 self._signaled = False
                 while self._pending:
                     try:
@@ -274,29 +318,51 @@ class _Reactor(threading.Thread):
                         fn()
                     except Exception:  # noqa: BLE001
                         pass
-                for key, mask in events:
-                    kind, conn = key.data
-                    try:
-                        if kind == "accept":
-                            self._accept()
-                        elif kind == "wake":
+                # Batch hooks (process mode): the worker's engine link
+                # is corked across this round's readable-event drain, so
+                # a parsed pipelined burst rides ONE sendall to the
+                # device-owner (net/ipc.FrameSender.cork).  Only the
+                # read/parse phase is corked — the completion callbacks
+                # above ran uncorked, so the engine receives the
+                # previous burst's stragglers while this one parses.
+                hooks = self.srv.loop_hooks
+                if hooks is not None:
+                    hooks[0]()
+                try:
+                    for key, mask in events:
+                        kind, conn = key.data
+                        if kind == "ext":
                             try:
-                                while self._wake_r.recv(4096):
-                                    pass
-                            except (BlockingIOError, OSError):
+                                conn()  # external-fd callback
+                            except Exception:  # noqa: BLE001 — the
+                                # callback owns its own error handling;
+                                # never let it take down the loop.
                                 pass
-                        else:
-                            if conn.handshaking:
-                                self._handshake(conn)
-                                continue
-                            if mask & selectors.EVENT_WRITE:
-                                self._flush(conn)
-                            if mask & selectors.EVENT_READ and not conn.closed:
-                                self._readable(conn)
-                    except Exception:  # noqa: BLE001 — one bad connection
-                        # must never take down the loop.
-                        if conn is not None:
-                            self._close(conn)
+                            continue
+                        try:
+                            if kind == "accept":
+                                self._accept()
+                            elif kind == "wake":
+                                try:
+                                    while self._wake_r.recv(4096):
+                                        pass
+                                except (BlockingIOError, OSError):
+                                    pass
+                            else:
+                                if conn.handshaking:
+                                    self._handshake(conn)
+                                    continue
+                                if mask & selectors.EVENT_WRITE:
+                                    self._flush(conn)
+                                if mask & selectors.EVENT_READ and not conn.closed:
+                                    self._readable(conn)
+                        except Exception:  # noqa: BLE001 — one bad connection
+                            # must never take down the loop.
+                            if conn is not None:
+                                self._close(conn)
+                finally:
+                    if hooks is not None:
+                        hooks[1]()
                 now = time.monotonic()
                 if now - self._last_sweep >= 0.25:
                     self._last_sweep = now
@@ -651,9 +717,13 @@ class _Reactor(threading.Thread):
         if not srv.pool.submit(job):
             if path in ADMISSION_EXEMPT:
                 # A saturated pool must not blind the orchestrator:
-                # probes run inline on the reactor (cheap by
-                # construction) instead of shedding.
-                job()
+                # probes run on a one-shot thread instead of shedding.
+                # NOT inline on the reactor — in process mode a
+                # /metrics aggregation waits on worker STATS frames
+                # that only this reactor thread can drain, so an
+                # inline run would stall the whole query path for the
+                # stats timeout and stamp every worker process down.
+                threading.Thread(target=job, daemon=True).start()
                 return
             release_once()
             if admission is not None:
@@ -890,9 +960,14 @@ class AsyncHTTPServer:
         read_timeout: Optional[float] = None,
         idle_timeout: Optional[float] = None,
         response_timeout: Optional[float] = None,
+        reuseport: Optional[bool] = None,
     ):
         self.ssl_context = ssl_context
         self.handler = None
+        # Optional (cork, uncork) pair bracketing each reactor
+        # iteration — process-mode workers batch their engine-link
+        # frames with it.  None everywhere else.
+        self.loop_hooks = None
         # serve() does ``srv.RequestHandlerClass.handler = Handler(...)``
         # for the threaded server; aliasing the class to the instance
         # keeps that assignment working unchanged.
@@ -901,7 +976,7 @@ class AsyncHTTPServer:
             reactors = _env_int("PILOSA_TPU_SERVER_REACTORS", 1)
         self.n_reactors = max(1, int(reactors))
         if pool_workers is None:
-            pool_workers = _env_int("PILOSA_TPU_SERVER_WORKERS", 256)
+            pool_workers = _env_int("PILOSA_TPU_SERVER_POOL_WORKERS", 256)
         if queue_depth is None:
             queue_depth = _env_int("PILOSA_TPU_SUBMIT_QUEUE", 1024)
         self.pool = _BlockingPool(pool_workers, queue_depth)
@@ -935,12 +1010,17 @@ class AsyncHTTPServer:
         self._c_req_pool = REGISTRY.counter(METRIC_SERVER_REQUESTS, path="pool")
         self._c_req_shed = REGISTRY.counter(METRIC_SERVER_REQUESTS, path="shed")
         self._socks = []
+        if reuseport is None:
+            reuseport = self.n_reactors > 1
         for i in range(self.n_reactors):
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            if self.n_reactors > 1:
+            if reuseport:
                 # The scale-out knob: the kernel load-balances accepts
-                # across the per-reactor listening sockets.
+                # across the per-reactor listening sockets — and, in
+                # process mode, across the sibling WORKER processes'
+                # listeners on the same port (net/worker.py always
+                # passes reuseport=True).
                 s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
             try:
                 s.bind((host, port))
@@ -963,6 +1043,23 @@ class AsyncHTTPServer:
         self._started = False
         self._stop_event = threading.Event()
         self._lock = threading.Lock()
+
+    def register_external(self, sock, callback):
+        """Watch an extra readable fd on reactor 0's loop (before
+        ``serve_forever``).  Process-mode workers register their engine
+        link so the reactor thread owns the whole query path."""
+        self._reactors[0].add_external(sock, callback)
+
+    def register_external_soon(self, sock, callback):
+        """Dynamic, thread-safe external-fd registration on reactor 0
+        (works while the loop is running)."""
+        self._reactors[0].register_external_soon(sock, callback)
+
+    def unregister_external_soon(self, sock):
+        self._reactors[0].unregister_external_soon(sock)
+
+    def call_soon(self, fn):
+        self._reactors[0].call_soon(fn)
 
     # -- ThreadingHTTPServer-compatible lifecycle ---------------------------
 

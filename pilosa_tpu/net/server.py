@@ -435,23 +435,15 @@ class Handler:
         fast path.  The reference reads the body as raw PQL unless it's
         protobuf (http/handler.go handlePostQuery); accept JSON
         {"query": ...} as well as a bare PQL string."""
-        try:
-            doc = json.loads(b) if b else {}
-        except json.JSONDecodeError:
-            doc = {"query": b.decode() if isinstance(b, bytes) else b}
-        if isinstance(doc, str):  # JSON-quoted PQL body
-            doc = {"query": doc}
-        shards = doc.get("shards") or _parse_shards(q)
+        doc = decode_query_doc(q, b)
         return QueryRequest(
             index,
-            doc.get("query", ""),
-            shards=shards,
-            column_attrs=_qbool(q, "columnAttrs") or doc.get("columnAttrs", False),
-            exclude_row_attrs=_qbool(q, "excludeRowAttrs")
-            or doc.get("excludeRowAttrs", False),
-            exclude_columns=_qbool(q, "excludeColumns")
-            or doc.get("excludeColumns", False),
-            remote=_qbool(q, "remote") or doc.get("remote", False),
+            doc["query"],
+            shards=doc["shards"],
+            column_attrs=doc["columnAttrs"],
+            exclude_row_attrs=doc["excludeRowAttrs"],
+            exclude_columns=doc["excludeColumns"],
+            remote=doc["remote"],
             # Join the caller's trace when the request carries one
             # (X-Trace-Id from a coordinator's shard fan-out, or an
             # external client propagating its own trace).
@@ -459,7 +451,7 @@ class Handler:
             # ?profile=1 returns the recorded query plan inline; the
             # tenant keys plan/cost attribution with the SAME resolution
             # admission fairness uses (header, else index name).
-            profile=_qflag(q, "profile") or doc.get("profile", False),
+            profile=doc["profile"],
             tenant=tenant_of(headers or {}, f"/index/{index}/query"),
         )
 
@@ -532,6 +524,16 @@ class Handler:
         if d is not None:
             return d
         resp = self.api.query(req)
+        if getattr(resp, "plan", None) is None:
+            # Fast JSON encode for int and TopN (id, count) results —
+            # byte-identical to the generic walk (net/wire.py).  The
+            # classic dashboard TopN payload previously always paid the
+            # per-pair dict build + json.dumps dispatch chain here.
+            payload = count_response_bytes(
+                resp, getattr(resp, "trace_id", None)
+            )
+            if payload is not None:
+                return 200, "application/json", payload
         out = response_to_json(resp)
         if getattr(resp, "trace_id", None):
             out["traceID"] = resp.trace_id
@@ -646,6 +648,16 @@ class Handler:
         plans_mod.LEDGER.refresh_series()
         return REGISTRY.prometheus_text(openmetrics=openmetrics)
 
+    def _node_metrics_text(self, openmetrics: bool = False) -> str:
+        """The whole NODE's exposition: the local process registry,
+        plus — in process mode — every worker process's registry summed
+        in at scrape time and the per-process liveness/RSS gauges
+        (ProcessHTTPServer.aggregate_metrics, docs/serving.md)."""
+        srv = self.server
+        if srv is not None and hasattr(srv, "aggregate_metrics"):
+            return srv.aggregate_metrics(self, openmetrics=openmetrics)
+        return self._metrics_text(openmetrics=openmetrics)
+
     def _metrics(self, q, b, **kw):
         """GET /metrics: the process registry (latency histograms per
         pipeline stage / query op / fragment op, counters, gauges) in
@@ -662,9 +674,9 @@ class Handler:
             (v for k, v in headers.items() if k.lower() == "accept"), ""
         )
         if "application/openmetrics-text" in accept:
-            text = self._metrics_text(openmetrics=True)
+            text = self._node_metrics_text(openmetrics=True)
             return 200, OPENMETRICS_CONTENT_TYPE, text.encode()
-        return 200, PROMETHEUS_CONTENT_TYPE, self._metrics_text().encode()
+        return 200, PROMETHEUS_CONTENT_TYPE, self._node_metrics_text().encode()
 
     def _healthz(self, q, b, **kw):
         """GET /healthz: liveness — the process is up and the route
@@ -752,7 +764,7 @@ class Handler:
         errors: Dict[str, int] = {local_id: 0}
         if cluster is None:
             body.extend(
-                _relabel_prometheus(self._metrics_text(), local_id, seen_meta)
+                _relabel_prometheus(self._node_metrics_text(), local_id, seen_meta)
             )
         else:
             nodes = list(cluster.nodes)
@@ -768,7 +780,7 @@ class Handler:
             }
             # The local node never scrapes itself over HTTP.
             body.extend(
-                _relabel_prometheus(self._metrics_text(), local_id, seen_meta)
+                _relabel_prometheus(self._node_metrics_text(), local_id, seen_meta)
             )
             deadline = time.monotonic() + timeout
             for n in remote:
@@ -1139,6 +1151,30 @@ class Handler:
         return codec.serialize(frag.positions())
 
 
+def decode_query_doc(q: dict, b: bytes) -> dict:
+    """Decode one POST /index/{i}/query body + query params into plain
+    fields — no API dependency, so the process-mode worker (net/worker.py)
+    runs the SAME decode before framing the query over IPC.  Accepts
+    JSON ``{"query": ...}``, a JSON-quoted PQL string, and raw PQL."""
+    try:
+        doc = json.loads(b) if b else {}
+    except json.JSONDecodeError:
+        doc = {"query": b.decode() if isinstance(b, bytes) else b}
+    if isinstance(doc, str):  # JSON-quoted PQL body
+        doc = {"query": doc}
+    return {
+        "query": doc.get("query", ""),
+        "shards": doc.get("shards") or _parse_shards(q),
+        "columnAttrs": _qbool(q, "columnAttrs") or doc.get("columnAttrs", False),
+        "excludeRowAttrs": _qbool(q, "excludeRowAttrs")
+        or doc.get("excludeRowAttrs", False),
+        "excludeColumns": _qbool(q, "excludeColumns")
+        or doc.get("excludeColumns", False),
+        "remote": _qbool(q, "remote") or doc.get("remote", False),
+        "profile": _qflag(q, "profile") or doc.get("profile", False),
+    }
+
+
 def _qbool(q: dict, name: str) -> bool:
     return q.get(name, ["false"])[0].lower() == "true"
 
@@ -1384,6 +1420,9 @@ def bind_http(
     port: int = 10101,
     ssl_context=None,
     backend: Optional[str] = None,
+    workers: Optional[int] = None,
+    tls_certificate: str = "",
+    tls_key: str = "",
     **server_opts,
 ):
     """Bind the listening socket WITHOUT serving yet: callers that must
@@ -1395,9 +1434,27 @@ def bind_http(
 
     ``backend`` picks the serving engine: "async" (default; the
     net/aserver.py event-loop reactor — docs/serving.md) or "threaded"
-    (the stdlib thread-per-connection oracle).  ``server_opts`` are
-    passed through to AsyncHTTPServer (reactors=, admission=, ...)."""
+    (the stdlib thread-per-connection oracle).  ``workers > 0`` selects
+    PROCESS mode on the async backend: N shared-nothing worker
+    processes behind SO_REUSEPORT forward decoded frames to this
+    process over AF_UNIX (net/procserver.py; ``[server] workers`` /
+    ``PILOSA_TPU_SERVER_WORKERS``, default 0 = the in-process reactor,
+    byte-identical to pre-process-mode behavior).  ``server_opts`` are
+    passed through to the chosen server (reactors=, admission=, ...)."""
     if _resolve_backend(backend) != "threaded":
+        if workers is None:
+            try:
+                workers = int(os.environ.get("PILOSA_TPU_SERVER_WORKERS", 0))
+            except ValueError:
+                workers = 0
+        if workers and int(workers) > 0:
+            from .procserver import ProcessHTTPServer
+
+            return ProcessHTTPServer(
+                host, port, workers=int(workers), ssl_context=ssl_context,
+                tls_certificate=tls_certificate, tls_key=tls_key,
+                **server_opts,
+            )
         from .aserver import AsyncHTTPServer
 
         return AsyncHTTPServer(
@@ -1464,9 +1521,7 @@ def serve(
             **server_opts,
         )
     handler = Handler(api, allowed_origins=allowed_origins)
-    from .aserver import AsyncHTTPServer
-
-    if isinstance(srv, AsyncHTTPServer):
+    if hasattr(srv, "admission"):  # async reactor OR process mode
         if admission is None and srv.admission is None:
             from .admission import AdmissionController
 
@@ -1483,6 +1538,10 @@ def serve(
         # controller, so weighted-fair shares price what a tenant's
         # queries COST, not how many it sent.
         plans_mod.LEDGER.bind_admission(srv.admission)
+    if hasattr(srv, "not_ready_reasons"):
+        # Process mode: /readyz reflects worker-process health too
+        # (api.readiness folds these reasons in).
+        api.process_server = srv
     srv.RequestHandlerClass.handler = handler
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()

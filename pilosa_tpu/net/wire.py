@@ -51,25 +51,76 @@ def response_to_json(resp) -> dict:
     return out
 
 
-def count_response_bytes(resp, trace_id=None):
-    """Fast-path JSON encoding for all-integer responses (the batched
-    Count serving tier): builds the exact bytes ``json.dumps`` would
-    produce for ``{"results": [...], "traceID": ...}`` without the
-    generic ``result_to_json`` walk — at 10k+ responses/second the
-    per-response dict build + dispatch chain is measurable host work on
-    the collect path.  Returns None when any result is not a plain int
-    (bool is not: it serializes as true/false) or the response carries
-    column attributes — callers fall back to the generic encoder."""
+def fast_result_values(resp):
+    """The response's results as fast-encodable plain values, or None.
+
+    A result qualifies when it is a plain int (the batched Count tier)
+    or a TopN ``(id, count)`` pair list with integer ids — the classic
+    dashboard payload, which previously always took the generic
+    ``result_to_json`` walk.  Keyed TopN (string ids), Rows, ValCount,
+    bools, and attr-carrying responses disqualify (``None``): callers
+    fall back to the generic encoder.  The returned structure is also
+    what the process-mode RESULT_FAST frame carries (net/ipc.py), so
+    the device-owner ships values and the WORKER does the JSON encode.
+    """
     if resp.column_attr_sets is not None:
         return None
     results = resp.results
+    out = []
     for r in results:
-        if type(r) is not int:
+        if type(r) is int:
+            out.append(r)
+        elif type(r) is list:
+            for pair in r:
+                if (
+                    type(pair) is not tuple
+                    or len(pair) != 2
+                    or type(pair[0]) is not int
+                    or type(pair[1]) is not int
+                ):
+                    return None
+            out.append(r)
+        else:
             return None
-    body = '{"results": [' + ", ".join(map(str, results)) + "]"
+    return out
+
+
+def fast_results_bytes(results, trace_id=None) -> bytes:
+    """Exact ``json.dumps`` bytes for a fast-qualifying results list
+    (see ``fast_result_values``): ints render as-is, pair lists as
+    ``[{"id": i, "count": c}, ...]`` — byte-identical to the generic
+    encoder's output, without the per-response dict builds."""
+    parts = []
+    for r in results:
+        if type(r) is int:
+            parts.append(str(r))
+        else:
+            parts.append(
+                "["
+                + ", ".join(
+                    '{"id": %d, "count": %d}' % (i, c) for i, c in r
+                )
+                + "]"
+            )
+    body = '{"results": [' + ", ".join(parts) + "]"
     if trace_id:
         body += f', "traceID": "{trace_id}"'
     return (body + "}").encode()
+
+
+def count_response_bytes(resp, trace_id=None):
+    """Fast-path JSON encoding for int / TopN-pair responses: builds
+    the exact bytes ``json.dumps`` would produce for
+    ``{"results": [...], "traceID": ...}`` without the generic
+    ``result_to_json`` walk — at 10k+ responses/second the per-response
+    dict build + dispatch chain is measurable host work on the collect
+    path.  Returns None when any result doesn't qualify (bool is not an
+    int here: it serializes as true/false) or the response carries
+    column attributes — callers fall back to the generic encoder."""
+    results = fast_result_values(resp)
+    if results is None:
+        return None
+    return fast_results_bytes(results, trace_id)
 
 
 def result_from_json(call_name: str, doc):

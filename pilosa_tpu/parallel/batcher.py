@@ -57,6 +57,24 @@ from ..util import plans as plans_mod
 from ..util import tracing
 from ..util.stats import PipelineStats
 
+# Submission-origin tag (process-per-core serving mode, docs/serving.md
+# "Process mode"): the device-owner's per-worker IPC reader threads each
+# stamp their worker's identity here ONCE, so every item they submit
+# carries it and the dispatch loop can count fused batches whose riders
+# arrived via DIFFERENT worker processes — the cross-process analogue of
+# the reactor's cross-connection coalescing evidence.  Unset (None, the
+# in-process reactor / direct API case) items simply don't contribute.
+_ORIGIN = threading.local()
+
+
+def set_submit_origin(origin: Optional[str]):
+    """Tag every subsequent submit from THIS thread with ``origin``."""
+    _ORIGIN.value = origin
+
+
+def submit_origin() -> Optional[str]:
+    return getattr(_ORIGIN, "value", None)
+
 
 class _Item:
     """One submitted Count: a future resolved by the collect stage (or
@@ -78,6 +96,7 @@ class _Item:
         "plan",
         "memo_note",
         "memo_key",
+        "origin",
         "_callbacks",
     )
 
@@ -101,6 +120,9 @@ class _Item:
         # the collect stage stores the answer under the version tokens
         # the query began with, never newer ones.
         self.memo_key = None
+        # Which serving process submitted this item (None outside
+        # process mode) — the cross-worker fusing evidence.
+        self.origin = submit_origin()
         self._callbacks: List[Callable] = []
 
     def done(self) -> bool:
@@ -501,6 +523,15 @@ class CountBatcher:
                 self.pipeline.incr("fused_batches")
                 self.pipeline.incr("fused_queries", len(items))
                 self._last_fused = time.monotonic()
+                # Process mode: a fused batch whose riders arrived via
+                # DIFFERENT worker processes proves the cross-process
+                # coalescing property (smoke.sh asserts this moves).
+                origins = {it.origin for it in items if it.origin}
+                if len(origins) >= 2:
+                    self.pipeline.incr("cross_worker_fused_batches")
+                    self.pipeline.gauge_max(
+                        "fused_worker_origins_max", len(origins)
+                    )
             self._collect_q.put((dev, items, time.monotonic()))
 
     def _handle_batch_failure(self, index, items: List[_Item], retried, batch_err):

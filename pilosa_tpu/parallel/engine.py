@@ -728,6 +728,14 @@ class MeshEngine:
         self.result_memo = _ResultMemo(
             int(os.environ.get("PILOSA_RESULT_MEMO", DEFAULT_RESULT_MEMO))
         )
+        # Tree-signature cache for _memo_key: (str(c), fields) is a pure
+        # function of the tree, and the executor's parse cache hands the
+        # SAME Call object back for a repeated query text — so the
+        # serialize + field walk (~60 µs, most of a memo-hit's cost)
+        # runs once per distinct tree.  Entries pin their tree (key is
+        # id(); the value holds the object so the id can't be reused).
+        self._memo_sig_cache: Dict[int, tuple] = {}
+        self._memo_sig_lock = threading.Lock()
         # Batched-count CSE: identical (query, shards) entries of one
         # drained batch evaluate ONCE (_dispatch_count_batch); this
         # counts the collapsed duplicates.
@@ -1496,9 +1504,18 @@ class MeshEngine:
         the memo is disabled (callers then just dispatch)."""
         if self.result_memo.maxsize <= 0:
             return None
-        fields = self._collect_fields(c)
-        if fields is None:
-            return None
+        ent = self._memo_sig_cache.get(id(c))
+        if ent is not None and ent[0] is c:
+            qstr, fields = ent[1], ent[2]
+        else:
+            fields = self._collect_fields(c)
+            if fields is None:
+                return None
+            qstr = str(c)
+            with self._memo_sig_lock:
+                if len(self._memo_sig_cache) >= 1024:
+                    self._memo_sig_cache.clear()
+                self._memo_sig_cache[id(c)] = (c, qstr, fields)
         idx_obj = self.holder.index(index)
         if idx_obj is None:
             return None
@@ -1517,7 +1534,7 @@ class MeshEngine:
             # to a new time view): skip the memo for this query rather
             # than surface an iteration error on the read path.
             return None
-        return (index, str(c), tuple(sorted(set(shards))), tuple(toks))
+        return (index, qstr, tuple(sorted(set(shards))), tuple(toks))
 
     def memo_probe(self, index: str, c: Call, shards):
         """(key, value-or-None) for the batcher's submit fast path: a

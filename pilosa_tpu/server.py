@@ -232,12 +232,20 @@ class Server:
         if cfg.server_backend != "threaded":
             opts.update(
                 reactors=cfg.server_reactors,
-                pool_workers=cfg.server_workers,
+                workers=cfg.server_workers,
+                pool_workers=cfg.server_pool_workers,
                 queue_depth=cfg.server_queue_depth,
                 max_body_bytes=cfg.server_max_body_bytes,
                 read_timeout=cfg.server_read_timeout,
                 idle_timeout=cfg.server_idle_timeout,
             )
+            if cfg.server_workers > 0:
+                # Process mode terminates TLS in the workers, which need
+                # the PATHS (an SSLContext can't cross the fork).
+                opts.update(
+                    tls_certificate=cfg.tls_certificate,
+                    tls_key=cfg.tls_key,
+                )
         return opts
 
     def _make_admission(self):

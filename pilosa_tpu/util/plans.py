@@ -518,6 +518,14 @@ class TenantLedger:
             tenant, row, _series = self._slot(tenant or "default")
             row[4] += 1
 
+    def account_queries(self, tenant: str, queries: int = 1):
+        """Query-count-only accounting for the serving memo lane: the
+        tenant served ``queries`` Counts at ~zero device cost — no plan
+        object exists to route through ``account``."""
+        with self._lock:
+            _tenant, row, _series = self._slot(tenant or "default")
+            row[0] += queries
+
     def refresh_series(self):
         """Flush accumulated per-tenant tallies into the registry
         counters (called at /metrics and /debug/vars pull time, like

@@ -459,6 +459,15 @@ METRIC_MESH_SHARDS_PER_DEVICE = "pilosa_mesh_shards_per_device"
 METRIC_MESH_PSUM_DISPATCHES = "pilosa_mesh_psum_dispatches_total"
 METRIC_CLUSTER_REMOTE_CALLS = "pilosa_cluster_remote_calls_total"
 
+# -- process mode (docs/serving.md "Process mode") ---------------------------
+#   pilosa_process_up{proc=}                1 while the process answers the
+#                                           scrape-time stats probe (engine:
+#                                           always 1; a wedged worker shows 0
+#                                           BEFORE the supervisor reaps it)
+#   pilosa_process_rss_bytes{proc=}         resident set size per process
+METRIC_PROCESS_UP = "pilosa_process_up"
+METRIC_PROCESS_RSS = "pilosa_process_rss_bytes"
+
 METRIC_ADMISSION_INFLIGHT = "pilosa_admission_inflight"
 METRIC_ADMISSION_TENANTS = "pilosa_admission_active_tenants"
 METRIC_ADMISSION_ADMITTED = "pilosa_admission_admitted_total"
@@ -585,6 +594,122 @@ for _p in SERVER_REQUEST_PATHS:
         path=_p,
     )
 del _stage, _cache, _phase, _path, _reason, _p
+
+
+def _iter_samples(text: str):
+    """Yield ``(key, value, exemplar_suffix)`` per sample line of a
+    Prometheus/OpenMetrics exposition.  ``key`` is the exact
+    ``name{labels}`` string as rendered (label order is deterministic —
+    every process renders through this module's registry, so identical
+    series produce identical keys); ``exemplar_suffix`` is the
+    OpenMetrics `` # {...} v ts`` tail when present, else ``""``."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        suffix = ""
+        if " # {" in line:
+            head, _, tail = line.rpartition(" # {")
+            line, suffix = head, " # {" + tail
+        key, sep, value = line.rpartition(" ")
+        if not sep:
+            continue
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        yield key, v, suffix
+
+
+def _exposition_meta(text: str) -> Dict[str, List[str]]:
+    """Metric family -> its # HELP/# TYPE lines, from one exposition."""
+    out: Dict[str, List[str]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("#"):
+            continue
+        parts = line.split(None, 3)
+        if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+            out.setdefault(parts[2], []).append(line)
+    return out
+
+
+def merge_expositions(primary: str, others: Dict[str, str]) -> str:
+    """Sum per-process registry expositions into ONE whole-node
+    exposition (the process-mode /metrics surface, docs/serving.md).
+
+    ``primary`` is the device-owner's exposition — classic or
+    OpenMetrics; exemplar suffixes and the trailing ``# EOF`` are
+    preserved.  ``others`` maps a process label to that process's
+    CLASSIC exposition (the worker registries).  Samples sharing an
+    exact ``name{labels}`` key are SUMMED — counters, gauges, and
+    histogram ``_bucket``/``_sum``/``_count`` lines are all additive
+    across processes (every process shares DEFAULT_BUCKETS, so bucket
+    sums stay cumulative-consistent).  Worker-only series are appended
+    with their own HELP/TYPE before any ``# EOF`` — the same
+    merge-don't-duplicate metadata discipline as the /cluster/metrics
+    federation."""
+    add: Dict[str, float] = {}
+    extra_order: List[str] = []
+    extra_meta: Dict[str, List[str]] = {}
+    for text in others.values():
+        for key, v, _suffix in _iter_samples(text):
+            if key in add:
+                add[key] += v
+            else:
+                add[key] = v
+                extra_order.append(key)
+        for fam, meta in _exposition_meta(text).items():
+            extra_meta.setdefault(fam, meta)
+    out: List[str] = []
+    for line in primary.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            out.append(line)
+            continue
+        suffix = ""
+        sample = stripped
+        if " # {" in sample:
+            head, _, tail = sample.rpartition(" # {")
+            sample, suffix = head, " # {" + tail
+        key, sep, value = sample.rpartition(" ")
+        delta = add.pop(key, None) if sep else None
+        if delta is None:
+            out.append(line)
+            continue
+        try:
+            total = float(value) + delta
+        except ValueError:
+            out.append(line)
+            continue
+        out.append(f"{key} {_prom_float(total)}{suffix}")
+    # Worker-only series, grouped by family, metadata emitted once —
+    # and NEVER for a family the primary already declared: Prometheus'
+    # text parser rejects the whole exposition on a second HELP/TYPE
+    # line for the same name (a worker-only LABEL SET of an
+    # engine-known family must ride the primary's metadata).
+    tail_lines: List[str] = []
+    emitted_meta: set = set(_exposition_meta(primary))
+    for key in extra_order:
+        if key not in add:
+            continue  # summed into a primary line above
+        fam = _prom_name(key.split("{", 1)[0])
+        base = fam
+        for strip in ("_bucket", "_sum", "_count"):
+            if base.endswith(strip):
+                base = base[: -len(strip)]
+        for meta_name in (base, fam):
+            if meta_name in extra_meta and meta_name not in emitted_meta:
+                emitted_meta.add(meta_name)
+                tail_lines.extend(extra_meta[meta_name])
+                break
+        tail_lines.append(f"{key} {_prom_float(add[key])}")
+    if tail_lines:
+        if out and out[-1].strip() == "# EOF":
+            out[-1:-1] = tail_lines
+        else:
+            out.extend(tail_lines)
+    return "\n".join(out) + "\n"
 
 
 class StatsClient:

@@ -74,6 +74,21 @@ AUTO_REQUIRE = (
     # "pct" regresses UP and the <2% target holds via ABS_CEILING once a
     # baseline records it (docs/observability.md).
     "profile_overhead_pct",
+    # The TopN device headline: ROADMAP tracks it trailing the other
+    # 1B-col kernels by ~3-4x, but nothing guarded it — a later PR that
+    # dropped (or silently regressed) the line must fail here.  "us"
+    # regresses UP via the existing unit map.
+    "topn_1B_cols_p50",
+    # Process-mode serving curve (bench.py --conn-sweep --workers,
+    # docs/serving.md "Process mode"): w0 is the in-process reactor
+    # oracle, w{1,2,4,8} the worker-process levels.  Required as soon
+    # as a baseline records them so the GIL-wall headline cannot be
+    # silently dropped; "qps" regresses DOWN.
+    "http_count_qps_w0",
+    "http_count_qps_w1",
+    "http_count_qps_w2",
+    "http_count_qps_w4",
+    "http_count_qps_w8",
 )
 
 # Built-in per-metric tolerance (used when no --metric-tolerance names

@@ -9,7 +9,7 @@
 #   sh scripts/smoke.sh tests/     # full non-slow suite, same flags
 set -e
 cd "$(dirname "$0")/.."
-TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_asyncserver.py tests/test_observability.py tests/test_plans.py}"
+TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_asyncserver.py tests/test_procserver.py tests/test_observability.py tests/test_plans.py}"
 env JAX_PLATFORMS=cpu python -m pytest $TARGETS -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
@@ -508,5 +508,130 @@ assert "pilosa_tenant_device_seconds_total" in om, (
 )
 srv2.shutdown()
 
-print("observability smoke OK: /metrics + /debug/traces + health/readiness + federation + admission + plans/tenant-ledger wired (both backends)")
+# Process-mode smoke (docs/serving.md "Process mode"): boot workers=2 —
+# two REAL worker processes behind SO_REUSEPORT forwarding decoded
+# frames over AF_UNIX into THIS process — then assert (a) a fused
+# device batch whose queries arrived via two different worker pids
+# (batcher cross_worker_fused_batches counter), (b) the aggregated
+# pilosa_server_*/pilosa_admission_* series + per-process liveness
+# gauges render at /metrics through a worker, and (c) a deterministic
+# cross-process 429 tenant_fair shed (admission lives in the engine;
+# the request travels worker -> AF_UNIX -> controller).
+from pilosa_tpu.net.procserver import ProcessHTTPServer
+
+# Undo the eviction drill above: the fused Intersect queries below need
+# resident stacks, not a rebuild per dispatch.
+eng.max_resident_bytes = 1 << 40
+srv3, _ = serve(
+    api, port=0, workers=2,
+    admission=AdmissionController(max_inflight=64, fair_start=0.25),
+)
+assert isinstance(srv3, ProcessHTTPServer), type(srv3)
+assert srv3.wait_ready(60), "worker processes never connected"
+port3 = srv3.server_address[1]
+assert len(set(srv3.worker_pids().values())) == 2, srv3.worker_pids()
+
+
+def cross_worker_fused():
+    b = eng._batcher
+    if b is None:
+        return 0
+    return b.pipeline.snapshot()["counters"].get(
+        "cross_worker_fused_batches", 0
+    )
+
+
+# (a) cross-worker coalescing: distinct Intersect trees (same batch
+# signature, but each dodges the O(1) lane and the result memo) from
+# concurrent connections — the kernel spreads them over both workers'
+# listeners and the engine fuses them into shared batches.
+_nonce = iter(range(1, 1 << 20))
+x0 = cross_worker_fused()
+deadline = time.monotonic() + 60
+while cross_worker_fused() == x0:
+    assert time.monotonic() < deadline, (
+        "no fused batch ever spanned two worker processes"
+    )
+    errs3 = []
+
+    def _pclient():
+        import http.client
+
+        try:
+            c = http.client.HTTPConnection("localhost", port3, timeout=30)
+            for _ in range(8):
+                body = (
+                    f"Count(Intersect(Row(f=1), Row(f={next(_nonce)})))"
+                ).encode()
+                c.request("POST", "/index/smoke/query", body=body)
+                r = c.getresponse()
+                assert r.status == 200, r.status
+                r.read()
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errs3.append(e)
+
+    threads = [threading.Thread(target=_pclient) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs3, errs3
+assert cross_worker_fused() > x0
+
+# (b) aggregated node exposition through a worker: per-process
+# liveness/RSS gauges plus the worker-side serving counters summed in.
+text = urllib.request.urlopen(
+    f"http://localhost:{port3}/metrics", timeout=30
+).read().decode()
+proc_required = [
+    'pilosa_process_up{proc="engine"} 1',
+    'pilosa_process_up{proc="worker-0"} 1',
+    'pilosa_process_up{proc="worker-1"} 1',
+    'pilosa_process_rss_bytes{proc="engine"}',
+    "pilosa_admission_admitted_total",
+    "pilosa_admission_shed_total",
+    "pilosa_server_connections_total",
+    "pilosa_server_requests_total",
+]
+missing = [s for s in proc_required if s not in text]
+assert not missing, f"process-mode /metrics missing: {missing}"
+for line in text.splitlines():
+    if line.startswith("pilosa_server_requests_total") and 'path="inline"' in line:
+        assert float(line.rsplit(" ", 1)[1]) >= 32, line  # workers' counters summed
+        break
+else:
+    raise AssertionError("no aggregated inline request counter")
+vars_doc = json.loads(urllib.request.urlopen(
+    f"http://localhost:{port3}/debug/vars", timeout=30
+).read())
+assert vars_doc["server"]["backend"] == "process", vars_doc["server"]
+assert sorted(vars_doc["server"]["connected"]) == [0, 1], vars_doc["server"]
+
+# (c) deterministic cross-process tenant_fair shed: saturate the hog's
+# share directly on the (engine-side, global) controller, then a real
+# HTTP request through a worker must answer 429 without engine work.
+adm3 = srv3.admission
+for _ in range(64):
+    assert adm3.admit("hog2") is None
+disp3 = eng.fused_dispatches
+r = urllib.request.Request(
+    f"http://localhost:{port3}/index/smoke/query",
+    data=b"Count(Row(f=1))", method="POST",
+    headers={"X-Pilosa-Tenant": "hog2"},
+)
+try:
+    urllib.request.urlopen(r, timeout=30)
+    raise AssertionError("hog request was not shed cross-process")
+except urllib.error.HTTPError as e:
+    assert e.code == 429, e.code
+    doc = json.loads(e.read())
+    assert doc.get("shed") == "tenant_fair", doc
+assert eng.fused_dispatches == disp3, "cross-process shed reached the engine"
+for _ in range(64):
+    adm3.release("hog2")
+
+srv3.shutdown()
+
+print("observability smoke OK: /metrics + /debug/traces + health/readiness + federation + admission + plans/tenant-ledger + process mode (workers=2: cross-worker fused batch, aggregated scrape, cross-process 429) wired")
 EOF

@@ -26,8 +26,30 @@ def server(request):
     srv.shutdown()
 
 
-def test_version_and_schema(server):
-    api, client = server
+@pytest.fixture(params=["async", "threaded", "process"])
+def conformance_server(request):
+    """Route-conformance subset over all THREE serving backends: the
+    reactor, the threaded oracle, and process mode (workers=2 — real
+    worker processes behind SO_REUSEPORT forwarding decoded frames over
+    AF_UNIX to this process, docs/serving.md "Process mode").  Process
+    boots spawn two interpreters, so only the conformance subset below
+    pays for it; workers=0 keeps every other test on the in-process
+    reactor, byte-identical to pre-process-mode behavior."""
+    api = API()
+    if request.param == "process":
+        srv, thread = serve(api, port=0, workers=2)
+        assert srv.wait_ready(60), "worker processes never connected"
+    else:
+        srv, thread = serve(api, port=0, backend=request.param)
+    uri = f"http://localhost:{srv.server_address[1]}"
+    client = InternalClient(uri)
+    yield api, client
+    client.close()
+    srv.shutdown()
+
+
+def test_version_and_schema(conformance_server):
+    api, client = conformance_server
     assert client.status()["state"] == "NORMAL"
     client.create_index("i")
     client.create_field("i", "f", {"type": "set"})
@@ -36,8 +58,8 @@ def test_version_and_schema(server):
     assert schema[0]["fields"][0]["name"] == "f"
 
 
-def test_query_roundtrip(server):
-    api, client = server
+def test_query_roundtrip(conformance_server):
+    api, client = conformance_server
     client.create_index("i")
     client.create_field("i", "f")
     out = client.query("i", "Set(1, f=10) Set(2, f=10)")
@@ -59,8 +81,8 @@ def test_query_shards_arg(server):
     assert out["results"] == [1]
 
 
-def test_import_endpoint(server):
-    api, client = server
+def test_import_endpoint(conformance_server):
+    api, client = conformance_server
     client.create_index("i")
     client.create_field("i", "f")
     client.import_bits("i", "f", 0, [7, 7, 8], [1, 2, 3])
@@ -123,8 +145,8 @@ def test_export_csv(server):
     assert lines == ["10,1", "11,2"]
 
 
-def test_error_statuses(server):
-    api, client = server
+def test_error_statuses(conformance_server):
+    api, client = conformance_server
     with pytest.raises(ClientError) as e:
         client.query("missing", "Row(f=1)")
     assert "404" in str(e.value)
@@ -134,13 +156,13 @@ def test_error_statuses(server):
     assert "400" in str(e.value)
 
 
-def test_non_utf8_query_body_returns_400(server):
+def test_non_utf8_query_body_returns_400(conformance_server):
     """A non-UTF-8 raw body is a 400, not a dropped connection
     (ADVICE r2: uncaught UnicodeDecodeError in the handler)."""
     import urllib.error
     import urllib.request
 
-    api, client = server
+    api, client = conformance_server
     client.create_index("i")
     req = urllib.request.Request(
         client.uri + "/index/i/query", data=b"Row(f=\x80\xff)", method="POST"
