@@ -1715,6 +1715,193 @@ STREAM_QUERY_PACE_S = 0.005  # ~200 QPS read load: an unthrottled
 #                              measures GIL spin, not serving behavior
 
 
+def chaos_sweep():
+    """Serving-through-failure bench (docs/durability.md): a REAL
+    3-process gossip cluster at replicas=2 / ack=logged.  Phase A
+    (healthy) measures closed-loop Count QPS through the coordinator
+    under primary-mode vs any-mode replica reads — the read-scaling
+    ratio replicaN>1 buys (``replica_read_qps_gain``; ~1.0 on a single
+    shared-CPU host, the real separation needs multi-host).  Phase B
+    SIGKILLs a replica mid-load and measures the fraction of queries
+    that still answered across the kill + detection + degraded window
+    (``availability_under_failure_pct`` — with hedging this stays near
+    100).  Both are bench_guard AUTO_REQUIREd once baselined, with an
+    absolute 90% availability floor."""
+    import http.client
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.ops import SHARD_WIDTH
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    tmp = tempfile.mkdtemp()
+    # The shared chaos node bootstrap (scripts/chaos_node.py — also the
+    # drill test's and smoke stage's server), so this headline can
+    # never be measured with boot wiring the drill didn't run.
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "chaos_node.py",
+    )
+    ports = [free_port() for _ in range(3)]
+    gports = [free_port() for _ in range(3)]
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.path.dirname(os.path.abspath(__file__)),
+    )
+    procs = [
+        subprocess.Popen(
+            [
+                _sys.executable, script, f"n{i}", str(ports[i]),
+                str(gports[i]), str(gports[0]), os.path.join(tmp, f"n{i}"),
+                "--ack", "logged",
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        for i in range(3)
+    ]
+
+    def post(port, path, body, timeout=30, headers=None):
+        req = urllib.request.Request(
+            f"http://localhost:{port}{path}", data=body, method="POST"
+        )
+        req.add_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    try:
+        for p in procs:
+            assert p.stdout.readline().startswith("READY"), "boot failed"
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://localhost:{ports[0]}/status", timeout=10
+            ) as resp:
+                st = json.loads(resp.read())
+            if len(st["nodes"]) == 3 and st["state"] == "NORMAL":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"cluster never converged to 3-node NORMAL: {st} — "
+                "headlines must not be measured on a malformed cluster"
+            )
+        progress("chaos-sweep: 3-node cluster NORMAL")
+        post(ports[0], "/index/i", b"{}")
+        post(ports[0], "/index/i/field/f", b'{"options": {"type": "set"}}')
+        n_shards = 12
+        cols = [
+            s * SHARD_WIDTH + k * 17 for s in range(n_shards)
+            for k in range(64)
+        ]
+        post(
+            ports[0], "/index/i/field/f/import",
+            json.dumps(
+                {"rowIDs": [1] * len(cols), "columnIDs": cols}
+            ).encode(),
+            timeout=120,
+        )
+        # availableShards propagate over ASYNC gossip piggybacks: poll
+        # until the coordinator routes the whole query.
+        deadline = time.time() + 30
+        oracle = -1
+        while time.time() < deadline:
+            oracle = post(
+                ports[0], "/index/i/query", b"Count(Row(f=1))", timeout=60
+            )["results"][0]
+            if oracle == len(cols):
+                break
+            time.sleep(0.3)
+        assert oracle == len(cols), (oracle, len(cols))
+
+        def qps_for(headers, seconds=3.0):
+            """Closed-loop Counts on one keep-alive connection."""
+            c = http.client.HTTPConnection("localhost", ports[0], timeout=30)
+            n = 0
+            end = time.monotonic() + seconds
+            body = b"Count(Row(f=1))"
+            while time.monotonic() < end:
+                c.request(
+                    "POST", "/index/i/query", body=body,
+                    headers=dict(headers or {}),
+                )
+                r = c.getresponse()
+                r.read()
+                assert r.status == 200, r.status
+                n += 1
+            c.close()
+            return n / seconds
+
+        qps_for({}, 0.5)  # warm parse/memo caches before timing
+        qps_primary = qps_for({})
+        qps_any = qps_for({"X-Pilosa-Replica-Read": "any"})
+        emit_raw(
+            "replica_read_qps_gain", qps_any / qps_primary, "x",
+            qps_any / qps_primary,
+        )
+        progress(
+            f"chaos-sweep: qps primary={qps_primary:.0f} "
+            f"any={qps_any:.0f}"
+        )
+
+        # Phase B: availability through a SIGKILL.  The load runs the
+        # whole window; the kill lands 1s in.
+        ok, err = [0], [0]
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    out = post(
+                        ports[0], "/index/i/query", b"Count(Row(f=1))",
+                        timeout=30,
+                    )
+                    assert out["results"][0] == oracle
+                    ok[0] += 1
+                except Exception:  # noqa: BLE001
+                    err[0] += 1
+                time.sleep(0.02)
+
+        t = threading.Thread(target=load)
+        t.start()
+        time.sleep(1.0)
+        kill_t = time.monotonic()
+        os.kill(procs[1].pid, signal.SIGKILL)
+        procs[1].wait(timeout=10)
+        time.sleep(6.0)  # kill + detection + degraded steady state
+        stop.set()
+        t.join()
+        total = ok[0] + err[0]
+        avail = 100.0 * ok[0] / max(1, total)
+        emit_raw(
+            "availability_under_failure_pct", avail, "pct", avail / 100.0
+        )
+        progress(
+            f"chaos-sweep: {ok[0]}/{total} queries answered through the "
+            f"kill ({avail:.1f}%), window {time.monotonic() - kill_t:.1f}s"
+        )
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except ProcessLookupError:
+                pass
+        for p in procs:
+            p.communicate(timeout=30)
+
+
 def streaming_sweep():
     """Guarded streaming headline (docs/ingest.md): continuous id-pairs
     imports through a LIVE engine while a query load runs on another
@@ -2230,6 +2417,17 @@ if __name__ == "__main__":
         "(docs/ingest.md)",
     )
     ap.add_argument(
+        "--chaos-sweep",
+        action="store_true",
+        help="run the serving-through-failure sweep ONLY: a real "
+        "3-process gossip cluster (replicas=2, ack=logged) measuring "
+        "replica_read_qps_gain (any-mode vs primary-mode Count QPS) "
+        "and availability_under_failure_pct (fraction of queries "
+        "answered while a replica is SIGKILLed mid-load) — both "
+        "bench_guard AUTO_REQUIREd once baselined "
+        "(docs/durability.md)",
+    )
+    ap.add_argument(
         "--conn-sweep",
         action="store_true",
         help="also sweep client connection counts (1/4/16/64, open-loop "
@@ -2308,6 +2506,8 @@ if __name__ == "__main__":
         ingest_sweep()
     elif args.streaming_sweep:
         streaming_sweep()
+    elif args.chaos_sweep:
+        chaos_sweep()
     elif args.density_sweep:
         density_sweep()
     else:

@@ -24,6 +24,7 @@ from .util.stats import (
     METRIC_INGEST_BATCHES,
     METRIC_INGEST_BITS,
     METRIC_INGEST_CHANGED,
+    METRIC_INGEST_DEGRADED_BATCHES,
     METRIC_INGEST_SECONDS,
     METRIC_QUERY,
     REGISTRY,
@@ -62,6 +63,8 @@ class QueryRequest:
         trace_context=None,
         profile: bool = False,
         tenant: str = "default",
+        replica_read: str = "",
+        freshness_ms: Optional[float] = None,
     ):
         self.index = index
         self.query = query
@@ -70,6 +73,11 @@ class QueryRequest:
         self.exclude_row_attrs = exclude_row_attrs
         self.exclude_columns = exclude_columns
         self.remote = remote
+        # Replica-read routing override + freshness bound for this
+        # request (X-Pilosa-Replica-Read / X-Pilosa-Freshness-Ms;
+        # docs/durability.md) — "" / None defer to [cluster] config.
+        self.replica_read = replica_read
+        self.freshness_ms = freshness_ms
         # Incoming tracing.TraceContext (X-Trace-Id/X-Span-Id headers):
         # the handler sets it so a remote fan-out joins the caller's
         # trace instead of rooting a fresh one.
@@ -255,6 +263,8 @@ class API:
             exclude_row_attrs=req.exclude_row_attrs,
             exclude_columns=req.exclude_columns,
             column_attrs=req.column_attrs,
+            replica_read=getattr(req, "replica_read", ""),
+            freshness_ms=getattr(req, "freshness_ms", None),
         )
         start = time.monotonic()
         parent = getattr(req, "trace_context", None)
@@ -326,6 +336,8 @@ class API:
             exclude_row_attrs=req.exclude_row_attrs,
             exclude_columns=req.exclude_columns,
             column_attrs=req.column_attrs,
+            replica_read=getattr(req, "replica_read", ""),
+            freshness_ms=getattr(req, "freshness_ms", None),
         )
         start = time.monotonic()
         parent = getattr(req, "trace_context", None)
@@ -530,6 +542,50 @@ class API:
         if eng is not None:
             eng.ingest_syncer().notify(index_name)
 
+    def _live_owners(self, index: str, shard: int, clear: bool = False):
+        """A shard's owners with DOWN ones skipped — the DEGRADED write
+        policy (docs/durability.md): survivors take the write, the ack
+        is made durable on them, and anti-entropy seeds the dead owner
+        on recovery.  Raises when every owner is DOWN (nothing can make
+        the ack durable).  ``clear`` marks a bit-REMOVING import — those
+        never degrade: anti-entropy's majority-tie-to-set merge would
+        re-SET the removed bits once the dead owner (still holding
+        them) recovers, silently undoing the acked write.  Callers pass
+        clear=True for explicit ?clear=true imports AND for implicitly
+        destructive ones (mutex/bool fields displace the previous row,
+        BSI value imports rewrite bit planes).  Returns
+        (live_owners, skipped_count)."""
+        owners = self.cluster.shard_nodes(index, shard)
+        live = [n for n in owners if n.state != "DOWN"]
+        if not live:
+            raise ApiError(
+                f"import unavailable: every owner of shard {shard} is "
+                f"DOWN ({', '.join(n.id for n in owners)})"
+            )
+        if clear and len(live) < len(owners):
+            raise ApiError(
+                f"clear import unavailable: owner of shard {shard} is "
+                "DOWN and a degraded bit-removing import would be "
+                "reverted by anti-entropy on its recovery"
+            )
+        return live, len(owners) - len(live)
+
+    def _import_destructive(self, f, clear: bool) -> bool:
+        """Does this import REMOVE bits on apply?  Explicit clears do;
+        so do set-imports into mutex/bool fields (last-write-wins
+        displaces the column's previous row)."""
+        from .core.field import FIELD_TYPE_BOOL, FIELD_TYPE_MUTEX
+
+        return clear or f.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL)
+
+    def _note_degraded(self, index: str, skipped: int):
+        if not skipped:
+            return
+        REGISTRY.inc(METRIC_INGEST_DEGRADED_BATCHES)
+        self.journal.append(
+            "ingest.degraded", index=index, skippedOwners=skipped,
+        )
+
     def import_bits(
         self, req: ImportRequest, remote: bool = False, clear: bool = False
     ):
@@ -602,11 +658,16 @@ class API:
             groups.setdefault(c // SHARD_WIDTH, []).append(i)
         local_idxs: list = []
         remote_jobs = []
+        skipped_owners = 0
         for shard, idxs in sorted(groups.items()):
             s_rows = [row_ids[i] for i in idxs]
             s_cols = [col_ids[i] for i in idxs]
             s_ts = [timestamps[i] for i in idxs] if timestamps else []
-            for node in self.cluster.shard_nodes(req.index, shard):
+            live, skipped = self._live_owners(
+                req.index, shard, clear=self._import_destructive(f, clear)
+            )
+            skipped_owners += skipped
+            for node in live:
                 if node.id == self.cluster.node.id:
                     local_idxs.extend(idxs)
                 else:
@@ -636,6 +697,7 @@ class API:
                 )
             )
         fanout.run_fanout(remote_jobs)
+        self._note_degraded(req.index, skipped_owners)
         self._ingest_done("bits", req.index, len(col_ids), t0)
 
     def _import_local(self, idx, f, row_ids, col_ids, timestamps, clear=False):
@@ -696,10 +758,15 @@ class API:
             groups.setdefault(c // SHARD_WIDTH, []).append(i)
         local_idxs: list = []
         remote_jobs = []
+        skipped_owners = 0
         for shard, idxs in sorted(groups.items()):
             cols = [col_ids[i] for i in idxs]
             values = [vals[i] for i in idxs]
-            for node in self.cluster.shard_nodes(req.index, shard):
+            # BSI value imports rewrite bit planes (they CLEAR bits even
+            # on the set path): never degradable.
+            live, skipped = self._live_owners(req.index, shard, clear=True)
+            skipped_owners += skipped
+            for node in live:
                 if node.id == self.cluster.node.id:
                     local_idxs.extend(idxs)
                 else:
@@ -719,6 +786,7 @@ class API:
                 )
             )
         fanout.run_fanout(remote_jobs)
+        self._note_degraded(req.index, skipped_owners)
         self._ingest_done("values", req.index, len(col_ids), t0)
 
     def import_roaring(
@@ -876,6 +944,16 @@ class API:
         eng = self.mesh_engine
         if eng is not None and getattr(eng, "_closed", False):
             reasons.append("engine closed")
+        # Overlapped warm-start (docs/durability.md): while residency is
+        # being re-established from snapshots the node ANSWERS queries
+        # (host path), but reports warming so orchestrators keep it out
+        # of rotation until the working set is resident.
+        ws = self.warm_status()
+        if ws is not None and not ws["done"]:
+            reasons.append(
+                f"warming: residency {ws['fraction']:.0%} "
+                f"({ws['built']}/{ws['total']} stacks)"
+            )
         if self.cluster is not None and self.cluster.state != "NORMAL":
             reasons.append(f"cluster state {self.cluster.state}")
         gossip = self.gossip
@@ -894,6 +972,28 @@ class API:
         if ps is not None:
             reasons.extend(ps.not_ready_reasons())
         return (not reasons), reasons
+
+    def warm_status(self) -> Optional[dict]:
+        """The engine's warm-start progress snapshot (None when no
+        warm-start has been requested this boot): {"done", "fraction",
+        "built", "total", "skipped"} — served in the /readyz body and
+        folded into the readiness verdict."""
+        eng = self.mesh_engine
+        if eng is None:
+            return None
+        ws = getattr(eng, "warm_state", None)
+        if ws is None:
+            return None
+        total = ws.get("total") or 0
+        return {
+            "done": bool(ws.get("done")),
+            "built": int(ws.get("built", 0)),
+            "total": int(ws.get("total", 0)),
+            "skipped": int(ws.get("skipped", 0)),
+            "fraction": (
+                1.0 if not total else min(1.0, ws.get("built", 0) / total)
+            ),
+        }
 
     def version(self) -> str:
         return __version__
@@ -943,6 +1043,18 @@ class API:
                 f.add_remote_available_shards(Bitmap([msg["shard"]]))
         elif typ == "node-status":
             from .roaring import Bitmap
+
+            # A NodeStatus exchange is a heartbeat: record receipt plus
+            # the sender's per-index data-version tokens — the evidence
+            # bounded replica reads run on (docs/durability.md).
+            if self.cluster is not None:
+                sender = msg.get("node", {}).get("id")
+                if sender:
+                    self.cluster.note_heartbeat(
+                        sender,
+                        msg.get("versions") or None,
+                        ae_passes=msg.get("aePasses"),
+                    )
 
             # Anti-entropy schema reconciliation: adopt the sender's
             # tombstones FIRST (so a delete this node missed applies here

@@ -266,6 +266,42 @@ class Cluster:
         self.partition_n = partition_n
         self.path = path
         self.state = STATE_STARTING
+        # Replica-read routing policy ([cluster] replica-read,
+        # docs/durability.md): how the executor's shard mapper picks
+        # among a shard's owners for READ calls.
+        #   primary — the first live owner in replica order (the
+        #             reference's behavior, plus proactive DOWN skip)
+        #   any     — deterministic spread across all live owners
+        #             (read scaling: replicaN>1 serves reads, not just
+        #             failover)
+        #   bounded — spread, but only over replicas whose heartbeat is
+        #             within ``freshness_ms`` (per-request override via
+        #             X-Pilosa-Freshness-Ms); stale replicas are skipped
+        #             and the primary is the fallback.
+        self.replica_read = "primary"
+        self.freshness_ms = 1000.0
+        # node id -> (monotonic receipt time, per-index version tokens):
+        # refreshed by gossip liveness confirmations and NodeStatus
+        # exchanges (which carry holder.data_versions()).  The bounded
+        # replica-read mode reads this; missing entries mean "stale".
+        self._heartbeats: Dict[str, tuple] = {}
+        # Bounded-read quarantine: a node that was marked DOWN may have
+        # missed writes, and mere liveness does not heal them — only a
+        # completed anti-entropy pass does.  node id -> the peer's
+        # aePasses counter at first post-recovery heartbeat (None until
+        # one arrives); released when the counter ADVANCES past that
+        # baseline, i.e. a full pass started after recovery finished.
+        self._read_quarantine: Dict[str, Optional[int]] = {}
+        # Completed error-free anti-entropy passes on THIS node,
+        # bumped by HolderSyncer and advertised in node_status() so
+        # peers can release their quarantine of us.
+        self.ae_passes = 0
+        # node id -> monotonic time of its most recent failure verdict:
+        # heartbeat-driven recovery honors a holddown from this stamp
+        # (see note_heartbeat), so a node whose gossip is alive but
+        # whose SERVING plane keeps failing RPCs stays DOWN between
+        # verdicts instead of flapping back per datagram.
+        self._down_since: Dict[str, float] = {}
         self.nodes: List[Node] = [node]
         self._lock = threading.RLock()
         self.logger = logger
@@ -458,6 +494,8 @@ class Cluster:
             with self._lock:
                 self.nodes = [n for n in self.nodes if n.id != node_id]
                 self.save_topology()
+            self._heartbeats.pop(node_id, None)
+            self._read_quarantine.pop(node_id, None)
             self._emit("leave", node)
             if self.is_coordinator() and self.holder is not None:
                 self.send_sync(self.node_status())
@@ -530,13 +568,133 @@ class Cluster:
         apply_membership()
         self._determine_state()
 
+    # -- replica freshness (docs/durability.md) ----------------------------
+
+    # Seconds after a failure verdict before a gossip heartbeat alone
+    # may refute it (membership-observed restarts bypass this).
+    RECOVERY_HOLDDOWN = 15.0
+
+    def note_heartbeat(
+        self,
+        node_id: str,
+        versions: Optional[dict] = None,
+        ae_passes: Optional[int] = None,
+    ):
+        """Record liveness evidence about a peer: a gossip probe ack /
+        ALIVE update (``versions`` None) or a NodeStatus exchange
+        carrying its per-index data-version tokens and anti-entropy
+        pass counter.  A version-less heartbeat keeps the previous
+        tokens.
+
+        Direct contact also REFUTES a stale failure verdict: one timed-
+        out RPC marks a peer DOWN (executor hedging), and without this
+        a healthy-but-blipped node would stay DOWN — skipped by reads
+        AND writes — until a membership event happened to refresh it.
+        Recovery waits out RECOVERY_HOLDDOWN from the LAST verdict:
+        gossip liveness is not proof the serving plane works (a node
+        with a wedged HTTP acceptor still answers probes), so each
+        fresh RPC failure re-arms the holddown and the node stays
+        skipped between verdicts instead of flapping back per datagram
+        and stalling a query per flap.  A true gossip-observed restart
+        recovers immediately via the membership path (add_node on
+        dead->alive).  The bounded-read quarantine below still holds
+        until anti-entropy actually heals whatever the node missed."""
+        if node_id == self.node.id:
+            return
+        now = time.monotonic()
+        prev = self._heartbeats.get(node_id)
+        if versions is None and prev is not None:
+            versions = prev[1]
+        self._heartbeats[node_id] = (now, versions or {})
+        n = self.node_by_id(node_id)
+        if (
+            n is not None
+            and n.state == "DOWN"
+            and now - self._down_since.get(node_id, 0.0)
+            >= self.RECOVERY_HOLDDOWN
+        ):
+            self.node_recovered(node_id)
+        if node_id in self._read_quarantine and ae_passes is not None:
+            base = self._read_quarantine[node_id]
+            if base is None:
+                self._read_quarantine[node_id] = int(ae_passes)
+            elif int(ae_passes) > base:
+                # A whole pass completed strictly after recovery: every
+                # shard the peer owns has been reconciled against its
+                # replicas — bounded reads may trust it again.
+                del self._read_quarantine[node_id]
+
+    def heartbeat_age_ms(self, node_id: str) -> Optional[float]:
+        """Milliseconds since the last heartbeat from ``node_id``;
+        None when nothing has ever been heard (treated as stale)."""
+        hb = self._heartbeats.get(node_id)
+        if hb is None:
+            return None
+        return (time.monotonic() - hb[0]) * 1000.0
+
+    def peer_versions(self, node_id: str) -> dict:
+        hb = self._heartbeats.get(node_id)
+        return hb[1] if hb is not None else {}
+
+    def replica_fresh(
+        self, node_id: str, index: str, freshness_ms: float
+    ) -> bool:
+        """Is ``node_id`` an acceptable BOUNDED-read target?  Fresh =
+        marked READY and heard from within the bound.  Why liveness is
+        the right staleness proxy here: replicated writes apply to every
+        owner synchronously before ack, so a replica alive throughout
+        the last F ms has every write acked in that window; divergence
+        only accumulates while a replica is dead — and a failure verdict
+        CLEARS its heartbeat entry (node_failed), so a recovering node
+        stays stale until fresh evidence arrives.  Per-index version
+        tokens ride the same heartbeats for observability (/debug/vars
+        clusterHeartbeats) — they are per-node mutation counters, not
+        comparable across nodes, so they don't gate routing.  This node
+        is always fresh (read-your-writes)."""
+        if node_id == self.node.id:
+            return True
+        n = self.node_by_id(node_id)
+        if n is not None and n.state == "DOWN":
+            return False
+        if node_id in self._read_quarantine:
+            # Recovered but not yet healed: liveness resumed, but the
+            # writes it missed while DOWN are only repaired by a full
+            # anti-entropy pass — until then its answers can be staler
+            # than ANY requested bound.
+            return False
+        age = self.heartbeat_age_ms(node_id)
+        return age is not None and age <= freshness_ms
+
+    def heartbeats(self) -> dict:
+        """Introspection snapshot for /debug/vars: per-peer heartbeat
+        age, version tokens, and the bounded-read quarantine flag."""
+        out = {}
+        for nid, (t, vs) in list(self._heartbeats.items()):
+            out[nid] = {
+                "ageMs": round((time.monotonic() - t) * 1000.0, 1),
+                "versions": dict(vs),
+                "quarantined": nid in self._read_quarantine,
+            }
+        for nid in list(self._read_quarantine):
+            out.setdefault(nid, {"quarantined": True})
+        return out
+
     def node_failed(self, node_id: str):
         """Failure detector verdict (gossip NotifyLeave): mark and degrade;
         data is NOT re-placed until an admin removes the node
-        (cluster.go nodeLeave :1733)."""
+        (cluster.go nodeLeave :1733).  The heartbeat entry is cleared so
+        bounded replica reads treat the node as stale until fresh
+        evidence arrives post-recovery."""
         node = self.node_by_id(node_id)
         if node is not None:
             node.state = "DOWN"
+        self._heartbeats.pop(node_id, None)
+        # Bounded reads distrust the node past its recovery, until a
+        # post-recovery anti-entropy pass completes (see note_heartbeat).
+        self._read_quarantine[node_id] = None
+        # Re-arm the heartbeat-recovery holddown: repeated RPC failures
+        # keep the node DOWN even while its gossip stays chatty.
+        self._down_since[node_id] = time.monotonic()
         self._determine_state()
 
     def node_recovered(self, node_id: str):
@@ -895,9 +1053,20 @@ class Cluster:
             "state": self.state,
             "indexes": {},
             "tombstones": [],
+            "versions": {},
+            # Completed error-free anti-entropy passes on this node:
+            # peers release their bounded-read quarantine of us when
+            # this advances past their post-recovery baseline.
+            "aePasses": self.ae_passes,
         }
         if self.holder is None:
             return status
+        # Per-index data-version tokens: the heartbeat payload bounded
+        # replica reads consult (receivers record via note_heartbeat).
+        try:
+            status["versions"] = self.holder.data_versions()
+        except Exception:  # noqa: BLE001 — status must always render
+            pass
         # Deleted-schema tombstones travel with the status so a peer that
         # missed a delete broadcast applies it here instead of this
         # exchange resurrecting the object from the peer's stale schema.
@@ -1013,20 +1182,39 @@ class Cluster:
         return os.path.join(self.path, ".topology")
 
     def save_topology(self):
+        """Atomic: temp + fsync + os.replace — a SIGKILL mid-save must
+        leave the previous intact topology, never a torn JSON a restart
+        refuses to parse (this used to write ``.topology`` in place)."""
         p = self._topology_path()
         if p is None:
             return
         os.makedirs(self.path, exist_ok=True)
-        with open(p, "w") as f:
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"nodes": [n.to_dict() for n in self.nodes]}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
 
     def load_topology(self):
+        """Tolerant load: a corrupt topology (crash predating the atomic
+        writer, disk damage) logs and boots single-node — membership
+        re-forms via gossip/NodeStatus — instead of failing the boot."""
         p = self._topology_path()
         if p is None or not os.path.exists(p):
             return
-        with open(p) as f:
-            doc = json.load(f)
-        nodes = [Node.from_dict(d) for d in doc.get("nodes", [])]
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+            nodes = [Node.from_dict(d) for d in doc.get("nodes", [])]
+        except (json.JSONDecodeError, OSError, KeyError, TypeError,
+                ValueError) as e:
+            if self.logger:
+                self.logger.printf(
+                    "corrupt topology %s (%s): booting single-node; "
+                    "membership will re-form via gossip", p, e,
+                )
+            return
         with self._lock:
             by_id = {n.id: n for n in nodes}
             by_id[self.node.id] = self.node

@@ -94,6 +94,7 @@ class GossipNode:
         on_join: Optional[Callable] = None,
         on_leave: Optional[Callable] = None,
         on_message: Optional[Callable] = None,
+        on_alive: Optional[Callable] = None,
         logger=None,
         journal=None,
         dead_reap_seconds: float = 30.0,
@@ -110,6 +111,13 @@ class GossipNode:
         self.on_join = on_join
         self.on_leave = on_leave
         self.on_message = on_message
+        # Direct-liveness hook: fired with a member id on every direct
+        # contact (datagram/stream received from it, or a successful
+        # probe ack).  The server wires this to cluster.note_heartbeat —
+        # the freshness evidence bounded replica reads run on.  Relayed
+        # third-party updates do NOT fire it: they prove the relayer is
+        # alive, not the subject.
+        self.on_alive = on_alive
         self.logger = logger
         # Structured event journal: every membership state transition,
         # join, and DEAD-member reap lands here (and in the
@@ -347,6 +355,7 @@ class GossipNode:
         if remote is None:
             return False
         self._merge_state(remote)
+        self._note_alive(remote.get("from"))
         return True
 
     def _push_pull_loop(self):
@@ -389,6 +398,7 @@ class GossipNode:
                 except OSError:
                     pass
                 self._merge_state(msg)
+                self._note_alive(msg.get("from"))
             else:
                 # An oversized regular message delivered via stream.
                 self._handle(msg, None)
@@ -418,10 +428,19 @@ class GossipNode:
             m = self.members.get(msg.get("from", ""))
         return m.addr if m is not None else None
 
+    def _note_alive(self, member_id):
+        if member_id and member_id != self.node_id and self.on_alive:
+            try:
+                self.on_alive(member_id)
+            except Exception:  # noqa: BLE001 — liveness hook must not wedge IO
+                pass
+
     def _handle(self, msg: dict, addr):
         for update in msg.get("updates", []):
             self._apply_update(update)
         self._handle_bcasts(msg.get("bcasts"))
+        # Any message FROM a member is direct evidence it is alive now.
+        self._note_alive(msg.get("from"))
         typ = msg.get("type")
         reply_to = self._sender_addr(msg, addr)
         if typ == "ping":
@@ -520,6 +539,7 @@ class GossipNode:
             target = random.choice(candidates)
             if self._probe_once(target):
                 self._mark(target.id, ALIVE)
+                self._note_alive(target.id)
                 continue
             # Indirect probes through k proxies (SWIM ping-req).
             proxies = [m for m in candidates if m.id != target.id]

@@ -42,9 +42,21 @@ class HolderSyncer:
         }
         t0 = time.monotonic()
         self.journal.append("antientropy.start", node=self.cluster.node.id)
+        clean = False
         try:
             self._sync_all()
+            # Only a pass that ran to completion (not cut short by
+            # closing, no raise, no per-fragment errors) reconciled
+            # every shard this node owns against its replicas.
+            clean = not self.closing and not self._pass.get("errors")
         finally:
+            if clean:
+                # Advertise it (NodeStatus "aePasses") so peers release
+                # their bounded-read quarantine of us — an aborted or
+                # erroring pass must NOT, or a recovering node would be
+                # readmitted to bounded reads before its missed writes
+                # are actually healed (docs/durability.md).
+                self.cluster.ae_passes += 1
             self.journal.append(
                 "antientropy.end",
                 node=self.cluster.node.id,

@@ -47,6 +47,12 @@ class Config:
         self.cluster_replicas = 1
         self.cluster_hosts: List[str] = []
         self.cluster_long_query_time = 60.0
+        # Replica-read routing for replicaN>1 (docs/durability.md):
+        # primary | any | bounded.  ``bounded`` serves from any replica
+        # heard from within freshness-ms (per-request override via
+        # X-Pilosa-Freshness-Ms), skipping stale/DEAD ones.
+        self.cluster_replica_read = "primary"
+        self.cluster_freshness_ms = 1000.0
         # gossip (SWIM membership)
         self.gossip_port = 14000
         self.gossip_seeds: List[str] = []
@@ -108,6 +114,20 @@ class Config:
         self.server_max_body_bytes = 256 * 1024 * 1024
         self.server_read_timeout = 120.0
         self.server_idle_timeout = 120.0
+        # storage durability (docs/durability.md): what an ingest ack
+        # promises — received | logged | fsynced.  ``logged`` (default)
+        # flushes the op-log to the OS before ack, so an acked import is
+        # replayable after SIGKILL by construction; ``fsynced`` survives
+        # power loss; ``received`` exposes its loss window as
+        # pilosa_ingest_acked_unsynced_bytes.
+        self.storage_ack = "logged"
+        # Parallel snapshot re-open workers at boot (warm-start); <=1
+        # keeps the serial open.
+        self.storage_open_workers = 4
+        # Re-establish HBM residency from snapshots in the background
+        # after boot, serving from the host path meanwhile (readyz
+        # reports `warming` with a residency fraction until done).
+        self.storage_warm_start = True
         # mesh (TPU-native: devices for the shard mesh; 0 = all)
         self.mesh_devices = 0
         # multi-host JAX runtime (jax.distributed): coordinator address
@@ -156,6 +176,11 @@ class Config:
         self.cluster_hosts = cl.get("hosts", self.cluster_hosts)
         if "long-query-time" in cl:
             self.cluster_long_query_time = _parse_duration(cl["long-query-time"])
+        self.cluster_replica_read = cl.get(
+            "replica-read", self.cluster_replica_read
+        )
+        if "freshness-ms" in cl:
+            self.cluster_freshness_ms = float(cl["freshness-ms"])
         g = doc.get("gossip", {})
         self.gossip_port = int(g.get("port", self.gossip_port))
         self.gossip_seeds = g.get("seeds", self.gossip_seeds)
@@ -225,6 +250,14 @@ class Config:
             self.server_read_timeout = _parse_duration(srv["read-timeout"])
         if "idle-timeout" in srv:
             self.server_idle_timeout = _parse_duration(srv["idle-timeout"])
+        st = doc.get("storage", {})
+        self.storage_ack = st.get("ack", self.storage_ack)
+        self.storage_open_workers = int(
+            st.get("open-workers", self.storage_open_workers)
+        )
+        self.storage_warm_start = st.get(
+            "warm-start", self.storage_warm_start
+        )
         mesh = doc.get("mesh", {})
         self.mesh_devices = mesh.get("devices", self.mesh_devices)
         # ``coordinator`` / ``processes`` / ``process-id`` are the
@@ -269,6 +302,11 @@ class Config:
             ("cluster_coordinator", "CLUSTER_COORDINATOR", bool),
             ("cluster_replicas", "CLUSTER_REPLICAS", int),
             ("cluster_hosts", "CLUSTER_HOSTS", list),
+            ("cluster_replica_read", "CLUSTER_REPLICA_READ", str),
+            ("cluster_freshness_ms", "CLUSTER_FRESHNESS_MS", float),
+            ("storage_ack", "STORAGE_ACK", str),
+            ("storage_open_workers", "STORAGE_OPEN_WORKERS", int),
+            ("storage_warm_start", "STORAGE_WARM_START", bool),
             ("gossip_port", "GOSSIP_PORT", int),
             ("gossip_seeds", "GOSSIP_SEEDS", list),
             ("anti_entropy_interval", "ANTI_ENTROPY_INTERVAL", _parse_duration),
@@ -320,6 +358,8 @@ coordinator = {str(self.cluster_coordinator).lower()}
 replicas = {self.cluster_replicas}
 hosts = [{hosts}]
 long-query-time = "{int(self.cluster_long_query_time)}s"
+replica-read = "{self.cluster_replica_read}"
+freshness-ms = {self.cluster_freshness_ms}
 
 [gossip]
 port = {self.gossip_port}
@@ -362,6 +402,11 @@ tenant-weights = "{self.server_tenant_weights}"
 max-body-bytes = {self.server_max_body_bytes}
 read-timeout = "{int(self.server_read_timeout)}s"
 idle-timeout = "{int(self.server_idle_timeout)}s"
+
+[storage]
+ack = "{self.storage_ack}"
+open-workers = {self.storage_open_workers}
+warm-start = {str(self.storage_warm_start).lower()}
 
 [translation]
 primary-url = "{self.translation_primary_url}"

@@ -27,7 +27,12 @@ from ..util import fanout
 from . import cache as cache_mod
 from . import rowstore
 from . import timequantum
-from .fragment import SHARD_WIDTH, FALSE_ROW_ID, TRUE_ROW_ID  # noqa: F401
+from .fragment import (  # noqa: F401
+    DEFAULT_ACK,
+    FALSE_ROW_ID,
+    SHARD_WIDTH,
+    TRUE_ROW_ID,
+)
 from .row import Row
 from .view import VIEW_STANDARD, View, view_bsi_name
 
@@ -164,10 +169,12 @@ class Field:
         cache_debounce: float = 0.0,
         on_create_shard=None,
         row_attr_store=None,
+        ack: str = DEFAULT_ACK,
     ):
         self.index = index
         self.name = name
         self.path = path
+        self.ack = ack
         self.options = options or FieldOptions()
         self.options.validate()
         # Unique creation id: schema broadcasts carry it so a delete only
@@ -237,14 +244,14 @@ class Field:
                 {"options": self.options.to_dict(), "cid": self.creation_id}, f
             )
 
-    def open(self):
+    def open(self, pool=None):
         if self.path is None:
             return
         self.save_meta()
         views_dir = os.path.join(self.path, "views")
         if os.path.isdir(views_dir):
             for name in os.listdir(views_dir):
-                self.view_if_not_exists(name).open()
+                self.view_if_not_exists(name).open(pool=pool)
         self._load_available_shards()
 
     def close(self):
@@ -322,6 +329,7 @@ class Field:
                 cache_debounce=self.cache_debounce,
                 on_create_shard=self.on_create_shard,
                 row_attr_store=self.row_attr_store,
+                ack=self.ack,
             )
             self.views[name] = v
         return v

@@ -39,7 +39,11 @@ import numpy as np
 from .. import ops
 from ..ops import bitops
 from ..roaring import codec
-from ..util.stats import METRIC_FRAGMENT_OP, REGISTRY
+from ..util.stats import (
+    METRIC_FRAGMENT_OP,
+    METRIC_INGEST_ACKED_UNSYNCED,
+    REGISTRY,
+)
 
 
 def _timed(op: str):
@@ -73,6 +77,66 @@ WORDS64 = bitops.WORDS64
 
 HASH_BLOCK_SIZE = 100  # rows per anti-entropy checksum block
 DEFAULT_MAX_OP_N = 2000
+
+# -- ingest ack/durability policy ([storage] ack, docs/durability.md) -------
+# What "acked" promises a writer before the call returns:
+#   received — applied to host memory and buffered toward the op-log; a
+#              SIGKILL can lose the userspace-buffered tail (the window is
+#              exported as pilosa_ingest_acked_unsynced_bytes).
+#   logged   — op-log bytes are flushed to the OS before ack: an acked
+#              write is replayable after SIGKILL by construction (the
+#              page cache survives process death); power loss can still
+#              lose it.
+#   fsynced  — flush + fsync before ack (and snapshots fsync the temp
+#              file before the rename): survives power loss.
+ACK_RECEIVED = "received"
+ACK_LOGGED = "logged"
+ACK_FSYNCED = "fsynced"
+ACK_LEVELS = (ACK_RECEIVED, ACK_LOGGED, ACK_FSYNCED)
+DEFAULT_ACK = ACK_LOGGED
+
+
+class _UnsyncedBytes:
+    """Process-wide tally of acked op-log bytes not yet handed to the
+    OS — the SIGKILL loss window of ack=received, mirrored into the
+    pilosa_ingest_acked_unsynced_bytes gauge (always 0 at the stricter
+    levels, which flush/fsync before the ack returns).  Each fragment
+    adds as it acks and retires its contribution when a flush or
+    snapshot hands the bytes over."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n: int):
+        if n == 0:
+            return
+        with self._lock:
+            self.total += n
+            if self.total < 0:
+                self.total = 0
+            REGISTRY.set_gauge(METRIC_INGEST_ACKED_UNSYNCED, self.total)
+
+
+UNSYNCED_BYTES = _UnsyncedBytes()
+
+
+def fsync_dir(path: Optional[str]):
+    """fsync the directory containing ``path`` so a rename is durable
+    (the metadata half of atomic temp-file + os.replace)."""
+    if not path:
+        return
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 # Row ids used for bool fields (fragment.go:82-84).
 FALSE_ROW_ID = 0
@@ -136,6 +200,7 @@ class Fragment:
         cache_debounce: float = 0.0,
         row_attr_store=None,
         on_touch=None,
+        ack: str = DEFAULT_ACK,
     ):
         self.index = index
         self.field = field
@@ -145,6 +210,14 @@ class Fragment:
         self.mutex = mutex
         self.max_op_n = max_op_n
         self.row_attr_store = row_attr_store
+        # Ack/durability level ([storage] ack): what a returned write
+        # call has promised the caller (see ACK_* above).
+        if ack not in ACK_LEVELS:
+            raise ValueError(f"unknown ack level: {ack!r}")
+        self.ack = ack
+        # This fragment's contribution to the process-wide
+        # pilosa_ingest_acked_unsynced_bytes gauge.
+        self._unsynced = 0
         # Owning view's version bump (engine stack invalidation).
         self._on_touch = on_touch
 
@@ -287,32 +360,74 @@ class Fragment:
         tmp = self.path + ".snapshotting"
         with open(tmp, "wb") as f:
             f.write(data)
+            if self.ack == ACK_FSYNCED:
+                # The rename must never publish a page-cache-only file at
+                # the strict level: fsync the temp before os.replace and
+                # the directory after, so a post-ack power cut replays
+                # the snapshot, not a hole.
+                f.flush()
+                os.fsync(f.fileno())
         if self._op_file is not None:
             self._op_file.close()
         os.replace(tmp, self.path)
+        if self.ack == ACK_FSYNCED:
+            fsync_dir(self.path)
+        # The rewritten snapshot supersedes the old op-log tail and the
+        # rename handed everything to the OS: the received-level
+        # SIGKILL window is retired.
+        self._clear_unsynced()
         self._op_file = open(self.path, "ab")
         self.op_n = 0
 
     def flush_cache(self):
-        """Persist the TopN cache ids (fragment.go FlushCache :1790)."""
+        """Persist the TopN cache ids (fragment.go FlushCache :1790) —
+        ATOMICALLY: temp file + fsync + os.replace, so a crash mid-flush
+        leaves the previous intact cache file, never a torn one (this
+        used to write ``path + ".cache"`` in place)."""
         if self.path is None:
             return
         pairs = [[int(i), int(n)] for i, n in self.cache.top()]
-        with open(self.path + ".cache", "w") as f:
+        p = self.path + ".cache"
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"pairs": pairs}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
 
     def _load_cache_file(self):
+        """Best-effort cache warm from disk: a corrupt or torn file (a
+        crash predating the atomic writer, or disk damage) is tolerated
+        — the ranked cache rebuilds from row counts as rows are touched,
+        so the right response is log-and-rebuild, never a failed
+        fragment open."""
         p = (self.path or "") + ".cache"
         if self.path is None or not os.path.exists(p):
             return
         try:
             with open(p) as f:
-                doc = json.load(f)
-        except (json.JSONDecodeError, OSError):
+                raw = f.read()
+        except OSError:
+            # Transient read failure (EMFILE under the parallel open,
+            # EIO): NOT corruption — keep the file for the next open.
+            self.cache.invalidate()
             return
-        for row_id, _ in doc.get("pairs", []):
-            self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
-        self.cache.invalidate()
+        try:
+            doc = json.loads(raw)
+            pairs = doc.get("pairs", [])
+            for row_id, _ in pairs:
+                self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
+        except (json.JSONDecodeError, ValueError, TypeError,
+                AttributeError):
+            # Genuinely corrupt content: drop it so the next flush
+            # rewrites a clean one instead of re-parsing garbage every
+            # open.
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        finally:
+            self.cache.invalidate()
 
     @_locked
     def close(self):
@@ -323,8 +438,17 @@ class Fragment:
         self._closed = True
         self.flush_cache()
         if self._op_file is not None:
+            # A clean close drains the ack window: everything acked is
+            # handed to the OS (and at the strict level, the disk).
+            try:
+                self._op_file.flush()
+                if self.ack == ACK_FSYNCED:
+                    os.fsync(self._op_file.fileno())
+            except (OSError, ValueError):
+                pass
             self._op_file.close()
             self._op_file = None
+        self._clear_unsynced()
 
     def _check_open(self):
         """Every mutation path calls this first: a write racing close()
@@ -341,11 +465,37 @@ class Fragment:
     def _append_op(self, typ: int, pos: int):
         self._check_open()
         if self._op_file is not None:
-            self._op_file.write(codec.encode_op(typ, pos))
+            data = codec.encode_op(typ, pos)
+            self._op_file.write(data)
             self.op_n += 1
+            # Durability before ack ([storage] ack): at ``logged`` the
+            # bytes reach the OS (SIGKILL-safe) before the write call
+            # returns; at ``fsynced`` they reach the disk.  Only
+            # ``received`` leaves a window — the userspace-buffered
+            # tail, exported as pilosa_ingest_acked_unsynced_bytes and
+            # retired when a flush/snapshot hands it to the OS.  (At
+            # logged/fsynced the gauge stays 0: the configured promise
+            # is met before the ack returns.)
+            if self.ack == ACK_RECEIVED:
+                self._note_unsynced(len(data))
+            else:
+                self._op_file.flush()
+                if self.ack == ACK_FSYNCED:
+                    os.fsync(self._op_file.fileno())
             if self.op_n > self.max_op_n:
                 self._op_file.flush()
                 self.snapshot()
+
+    def _note_unsynced(self, n: int):
+        self._unsynced += n
+        UNSYNCED_BYTES.add(n)
+
+    def _clear_unsynced(self):
+        """The op-log just became durable for this fragment (flush /
+        fsync / snapshot rewrite): retire its gauge contribution."""
+        if self._unsynced:
+            UNSYNCED_BYTES.add(-self._unsynced)
+            self._unsynced = 0
 
     # -- position math -----------------------------------------------------
 

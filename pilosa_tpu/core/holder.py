@@ -24,10 +24,16 @@ class Holder:
         cache_debounce: float = 0.0,
         on_create_shard=None,
         attr_store_factory=None,
+        ack: Optional[str] = None,
     ):
         self.path = path
         self.indexes: Dict[str, Index] = {}
         self.cache_debounce = cache_debounce
+        # Ingest ack/durability level ([storage] ack, docs/durability.md)
+        # threaded to every fragment this holder creates.
+        from .fragment import DEFAULT_ACK
+
+        self.ack = ack if ack is not None else DEFAULT_ACK
         self._user_on_create_shard = on_create_shard
         self.attr_store_factory = attr_store_factory
         self.opened = False
@@ -80,6 +86,8 @@ class Holder:
         tmp = p + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self.schema_tombstones, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, p)
 
     def _load_tombstones(self):
@@ -94,16 +102,32 @@ class Holder:
         except (OSError, ValueError):
             pass
 
-    def open(self):
+    def open(self, workers: int = 0):
+        """Open every index from disk.  ``workers > 1`` re-opens fragment
+        snapshots in a thread pool (the warm-start boot path,
+        docs/durability.md): snapshot decode is numpy-heavy and releases
+        the GIL, so a holder with many fragments comes up in parallel
+        instead of one file at a time."""
         if self.path is not None:
             os.makedirs(self.path, exist_ok=True)
             self._load_tombstones()
-            for name in sorted(os.listdir(self.path)):
-                p = os.path.join(self.path, name)
-                if os.path.isdir(p) and not name.startswith("."):
-                    idx = self._new_index(name)
-                    idx.open()
-                    self.indexes[name] = idx
+            pool = None
+            if workers and workers > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="holder-open"
+                )
+            try:
+                for name in sorted(os.listdir(self.path)):
+                    p = os.path.join(self.path, name)
+                    if os.path.isdir(p) and not name.startswith("."):
+                        idx = self._new_index(name)
+                        idx.open(pool=pool)
+                        self.indexes[name] = idx
+            finally:
+                if pool is not None:
+                    pool.shutdown(wait=True)
         self.opened = True
 
     def close(self):
@@ -125,6 +149,7 @@ class Holder:
             cache_debounce=self.cache_debounce,
             on_create_shard=self._on_create_shard,
             attr_store_factory=self.attr_store_factory,
+            ack=self.ack,
         )
 
     def _on_create_shard(self, index, field, shard):
@@ -134,6 +159,21 @@ class Holder:
 
     def shard_epoch(self, index: str) -> int:
         return self._shard_epochs.get(index, 0)
+
+    def data_versions(self) -> Dict[str, int]:
+        """Per-index data-version token: the sum of every view's
+        mutation counter plus the shard epoch.  Monotonic under local
+        writes — the cheap heartbeat payload peers use to judge replica
+        freshness for bounded replica reads (carried in NodeStatus
+        exchanges; cluster.note_heartbeat records receipt)."""
+        out: Dict[str, int] = {}
+        for name, idx in list(self.indexes.items()):
+            v = self._shard_epochs.get(name, 0)
+            for f in list(idx.fields.values()):
+                for view in list(f.views.values()):
+                    v += view.version
+            out[name] = v
+        return out
 
     def bump_shard_epoch(self, index: str):
         """Call after adding/removing fragments of an index."""

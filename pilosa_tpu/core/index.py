@@ -31,11 +31,17 @@ class Index:
         cache_debounce: float = 0.0,
         on_create_shard=None,
         attr_store_factory=None,
+        ack: Optional[str] = None,
     ):
         self.name = name
         self.path = path
         self.keys = keys
         self.track_existence = track_existence
+        # Ingest ack/durability level threaded to every fragment
+        # ([storage] ack, docs/durability.md).
+        from .fragment import DEFAULT_ACK
+
+        self.ack = ack if ack is not None else DEFAULT_ACK
         # See Field.creation_id: guards delete-index redelivery.
         self.creation_id = uuid.uuid4().hex
         self.fields: Dict[str, Field] = {}
@@ -79,7 +85,7 @@ class Index:
         if doc.get("cid"):
             self.creation_id = doc["cid"]
 
-    def open(self):
+    def open(self, pool=None):
         if self.path is not None:
             self.load_meta()
             self.save_meta()
@@ -89,7 +95,7 @@ class Index:
                 p = os.path.join(self.path, name)
                 if os.path.isdir(p):
                     f = self._new_field(name)
-                    f.open()
+                    f.open(pool=pool)
                     self.fields[name] = f
         if self.track_existence and EXISTENCE_FIELD_NAME not in self.fields:
             self.create_field_if_not_exists(
@@ -118,6 +124,7 @@ class Index:
             options=options,
             path=field_path,
             cache_debounce=self.cache_debounce,
+            ack=self.ack,
             on_create_shard=self.on_create_shard,
             row_attr_store=self._attr_store_factory(
                 os.path.join(field_path, ".data") if field_path else None

@@ -34,6 +34,7 @@ class View:
         cache_debounce: float = 0.0,
         on_create_shard=None,
         row_attr_store=None,
+        ack: str = fragment_mod.DEFAULT_ACK,
     ):
         self.index = index
         self.field = field
@@ -44,6 +45,9 @@ class View:
         self.mutex = mutex
         self.cache_debounce = cache_debounce
         self.row_attr_store = row_attr_store
+        # Ingest ack/durability level, threaded down to every fragment
+        # ([storage] ack, docs/durability.md).
+        self.ack = ack
         self.fragments: Dict[int, fragment_mod.Fragment] = {}
         # Callback fired when a shard's fragment first appears — the field
         # broadcasts CreateShardMessage here (view.go:226).
@@ -61,21 +65,33 @@ class View:
         # next() on itertools.count is atomic under the GIL.
         self.version = next(self._version_counter)
 
-    def open(self):
-        """Load existing fragments from disk."""
+    def open(self, pool=None):
+        """Load existing fragments from disk.  ``pool`` (a
+        ThreadPoolExecutor) re-opens the snapshots in parallel workers —
+        the warm-start boot path: snapshot decode is numpy-heavy and
+        releases the GIL, so concurrent fragment opens overlap
+        (docs/durability.md "Warm-start")."""
         if self.path is None:
             return
         frag_dir = os.path.join(self.path, "fragments")
         if not os.path.isdir(frag_dir):
             return
+        shards = []
         for name in os.listdir(frag_dir):
-            if name.endswith(".cache") or name.endswith(".snapshotting"):
+            if "." in name:  # .cache / .cache.tmp / .snapshotting leftovers
                 continue
             try:
-                shard = int(name)
+                shards.append(int(name))
             except ValueError:
                 continue
-            self.fragment_if_not_exists(shard)
+        if pool is None:
+            for shard in shards:
+                self.fragment_if_not_exists(shard)
+            return
+        # Distinct shards build distinct Fragment objects; the dict
+        # insert per shard is GIL-atomic and the shard sets are disjoint,
+        # so the only shared work is the (idempotent) epoch bump.
+        list(pool.map(self.fragment_if_not_exists, sorted(shards)))
 
     def _fragment_path(self, shard: int) -> Optional[str]:
         if self.path is None:
@@ -102,6 +118,7 @@ class View:
                 cache_debounce=self.cache_debounce,
                 row_attr_store=self.row_attr_store,
                 on_touch=self._bump_version,
+                ack=self.ack,
             )
             self.fragments[shard] = frag
             if self.on_create_shard is not None:

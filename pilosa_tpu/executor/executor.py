@@ -25,7 +25,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..util.stats import METRIC_QUERY_OP, REGISTRY
+from ..util.stats import METRIC_QUERY_OP, METRIC_REPLICA_READS, REGISTRY
 
 # Per-op histogram handles, cached so the dispatch path never takes the
 # global registry lock (GIL-atomic dict ops; a racing first-call for the
@@ -78,7 +78,14 @@ class FieldNotFoundError(Error):
 class ExecOptions:
     """executor.go execOptions."""
 
-    __slots__ = ("remote", "exclude_row_attrs", "exclude_columns", "column_attrs")
+    __slots__ = (
+        "remote",
+        "exclude_row_attrs",
+        "exclude_columns",
+        "column_attrs",
+        "replica_read",
+        "freshness_ms",
+    )
 
     def __init__(
         self,
@@ -86,11 +93,19 @@ class ExecOptions:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
         column_attrs: bool = False,
+        replica_read: str = "",
+        freshness_ms: Optional[float] = None,
     ):
         self.remote = remote
         self.exclude_row_attrs = exclude_row_attrs
         self.exclude_columns = exclude_columns
         self.column_attrs = column_attrs
+        # Per-request replica-read override (X-Pilosa-Replica-Read):
+        # "" defers to the cluster's configured [cluster] replica-read.
+        self.replica_read = replica_read
+        # Per-request freshness bound for ``bounded`` mode
+        # (X-Pilosa-Freshness-Ms); None defers to [cluster] freshness-ms.
+        self.freshness_ms = freshness_ms
 
     def copy(self) -> "ExecOptions":
         return ExecOptions(
@@ -98,6 +113,8 @@ class ExecOptions:
             self.exclude_row_attrs,
             self.exclude_columns,
             self.column_attrs,
+            self.replica_read,
+            self.freshness_ms,
         )
 
 
@@ -305,6 +322,13 @@ def _merge_group_counts(
 _MAXINT = (1 << 63) - 1
 
 _WRITE_CALLS = {"Set", "Clear", "SetRowAttrs", "SetColumnAttrs", "Store", "ClearRow"}
+
+# Write calls that REMOVE bits (directly, or by overwriting a row):
+# these must never ack in DEGRADED mode — anti-entropy's majority-tie-
+# to-set merge re-SETS the removed bits when the dead owner recovers
+# still holding them, silently undoing the acked write
+# (docs/durability.md "Writes under failure").
+_DESTRUCTIVE_CALLS = {"Clear", "ClearRow", "Store"}
 
 
 def _call_cacheable(c: Call) -> bool:
@@ -934,9 +958,69 @@ class Executor:
             for shard in shards:
                 result = reduce_fn(result, map_fn(shard))
             return result
-        return self._mapper(index, shards, call, opt, map_fn, reduce_fn, set())
+        # Hedge budget shared across the whole fan-out (including
+        # recursion after peer failures): a query may re-route its shards
+        # past at most replica_n extra peers before erroring — so replica
+        # hedging is bounded and can never retry-storm a flapping
+        # cluster.  One failed peer consumes one unit regardless of how
+        # many shards re-route.
+        budget = {"left": max(2, self.cluster.replica_n)}
+        return self._mapper(
+            index, shards, call, opt, map_fn, reduce_fn, set(), budget
+        )
 
-    def _mapper(self, index, shards, call, opt, map_fn, reduce_fn, down_ids):
+    def _read_route(self, index, shard, owners, call, opt):
+        """Pick this shard's execution target among its owners
+        (docs/durability.md "Replica reads").  Local ownership always
+        wins (zero-hop).  Writes pin to strict replica order — their
+        replication fan-out handles owner death explicitly.  For reads,
+        DOWN owners are deprioritized (a dead primary must not eat a
+        round-trip per query before the hedge kicks in) and the
+        configured mode picks among the live ones:
+
+          primary — first live owner in replica order (reference
+                    behavior + proactive DOWN skip)
+          any     — deterministic per-shard rotation across live owners
+                    (replicaN>1 scales reads, not just failover)
+          bounded — the ``any`` rotation filtered by the freshness bound
+                    (cluster.replica_fresh); no fresh replica -> first
+                    live owner."""
+        cluster = self.cluster
+        me = cluster.node.id
+        for n in owners:
+            if n.id == me:
+                return n
+        alive = [n for n in owners if n.state != "DOWN"]
+        if not alive:
+            return owners[0]  # all DOWN: last resort keeps replica order
+        if call is not None and call.name in _WRITE_CALLS:
+            if call.name in _DESTRUCTIVE_CALLS and len(alive) < len(owners):
+                raise Error(
+                    f"{call.name} unavailable: an owner of shard {shard} "
+                    "is DOWN and a degraded bit-removing write would be "
+                    "reverted by anti-entropy on its recovery"
+                )
+            return alive[0]
+        mode = (opt.replica_read or cluster.replica_read) if opt else (
+            cluster.replica_read
+        )
+        if mode == "any" and len(alive) > 1:
+            return alive[shard % len(alive)]
+        if mode == "bounded" and len(alive) > 1:
+            bound = (
+                opt.freshness_ms
+                if opt is not None and opt.freshness_ms is not None
+                else cluster.freshness_ms
+            )
+            k = shard % len(alive)
+            for n in alive[k:] + alive[:k]:
+                if cluster.replica_fresh(n.id, index, bound):
+                    return n
+        return alive[0]
+
+    def _mapper(
+        self, index, shards, call, opt, map_fn, reduce_fn, down_ids, budget
+    ):
         by_node = {}
         for s in shards:
             owners = [
@@ -946,17 +1030,30 @@ class Executor:
             ]
             if not owners:
                 raise Error(f"no available node for shard {s}")
-            target = next(
-                (n for n in owners if n.id == self.cluster.node.id), owners[0]
-            )
-            by_node.setdefault(target.id, (target, []))[1].append(s)
+            target = self._read_route(index, s, owners, call, opt)
+            # [target, shards, every-shard-routed-to-its-primary?] —
+            # the primary verdict is recorded HERE, where the owners
+            # list is already in hand, so the metric label below never
+            # recomputes placement.
+            entry = by_node.setdefault(target.id, [target, [], True])
+            entry[1].append(s)
+            entry[2] = entry[2] and owners[0].id == target.id
 
         result = None
-        for node_id, (node, node_shards) in sorted(by_node.items()):
-            if node_id == self.cluster.node.id:
+        me = self.cluster.node.id
+        for node_id, (node, node_shards, is_primary) in sorted(
+            by_node.items()
+        ):
+            if node_id == me:
                 for shard in node_shards:
                     result = reduce_fn(result, map_fn(shard))
                 continue
+            REGISTRY.inc(
+                METRIC_REPLICA_READS,
+                route="hedge" if down_ids else (
+                    "primary" if is_primary else "replica"
+                ),
+            )
             try:
                 self.remote_fanouts += 1
                 t_rpc = time.monotonic()
@@ -973,9 +1070,34 @@ class Executor:
                     p.note_fanout(
                         node_id, time.monotonic() - t_rpc, len(node_shards)
                     )
-            except Exception:
-                # Retry this node's shards on other replicas.
-                self.cluster.node_failed(node_id)
+            except Exception as e:
+                # Classify before hedging.  An HTTP ERROR RESPONSE
+                # proves the peer's serving plane is up: a 4xx (except
+                # 429) is a deterministic request error every replica
+                # would repeat — re-raise, don't hide it behind a
+                # hedge; a 429/5xx shed hedges to another replica but
+                # must NOT mark the node DOWN (one shed from a loaded
+                # peer would otherwise exile it — degraded writes,
+                # quarantine, holddown — for RECOVERY_HOLDDOWN per
+                # occurrence).  404 also hedges without a verdict: a
+                # schema-lagged peer may not know the index yet while
+                # its replica does.  Only a TRANSPORT failure (no
+                # status: refused/reset/timeout) is a failure verdict.
+                code = getattr(e, "code", None)
+                if (
+                    code is not None
+                    and 400 <= code < 500
+                    and code not in (404, 429)
+                ):
+                    raise
+                if code is None:
+                    self.cluster.node_failed(node_id)
+                budget["left"] -= 1
+                if budget["left"] < 0:
+                    raise Error(
+                        f"replica hedge budget exhausted at node "
+                        f"{node_id}: {e}"
+                    ) from e
                 sub = self._mapper(
                     index,
                     node_shards,
@@ -984,6 +1106,7 @@ class Executor:
                     map_fn,
                     reduce_fn,
                     down_ids | {node_id},
+                    budget,
                 )
                 if sub is not None:
                     result = reduce_fn(result, sub)
@@ -2092,8 +2215,11 @@ class Executor:
             value, ok = c.int_arg(field_name)
             if not ok:
                 raise Error("Set() row argument required")
+            # A BSI Set rewrites value planes — it CLEARS bits, so it
+            # must not ack degraded (see _write_replicated).
             return self._write_replicated(
-                index, c, col_id, opt, lambda: f.set_value(col_id, value)
+                index, c, col_id, opt, lambda: f.set_value(col_id, value),
+                destructive=True,
             )
 
         row_id, ok = c.uint_arg(field_name)
@@ -2108,8 +2234,11 @@ class Executor:
                 raise Error(f"invalid date: {ts}")
         if f.options.type == FIELD_TYPE_BOOL and row_id not in (0, 1):
             raise Error("bool field rows must be 0 or 1")
+        # Mutex/bool sets implicitly CLEAR the column's previous row.
         return self._write_replicated(
-            index, c, col_id, opt, lambda: f.set_bit(row_id, col_id, timestamp)
+            index, c, col_id, opt,
+            lambda: f.set_bit(row_id, col_id, timestamp),
+            destructive=f.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL),
         )
 
     def _execute_clear_bit(self, index, c: Call, opt) -> bool:
@@ -2127,19 +2256,52 @@ class Executor:
         if not ok:
             raise Error("Clear() col argument required")
         return self._write_replicated(
-            index, c, col_id, opt, lambda: f.clear_bit(row_id, col_id)
+            index, c, col_id, opt, lambda: f.clear_bit(row_id, col_id),
+            destructive=True,
         )
 
-    def _write_replicated(self, index, c: Call, col_id: int, opt, local_fn):
+    def _write_replicated(
+        self, index, c: Call, col_id: int, opt, local_fn,
+        destructive: bool = False,
+    ):
         """Apply a single-bit write on every replica of the column's shard:
         locally when this node is an owner, forwarded otherwise
         (executor.go executeSetBitField :1865-1898).  Single-node: just
-        local."""
+        local.
+
+        DEGRADED policy (docs/durability.md): an owner the failure
+        detector has marked DOWN is SKIPPED for purely-ADDITIVE sets —
+        the surviving owners take the write and anti-entropy seeds the
+        dead one on recovery (majority-vote ties resolve to set, so the
+        survivor's bit wins).  DESTRUCTIVE writes never degrade: a
+        Clear — or any write that implicitly clears bits (mutex/bool
+        sets displacing the previous row, BSI sets rewriting value
+        planes) — acked on the lone survivor would be partially
+        REVERTED by that same tie rule when the dead owner recovers
+        still holding the old bits, so those fail loudly instead of
+        acking a write anti-entropy will undo.  Every owner DOWN fails
+        loudly: there is no replica to make the ack durable on.  An
+        owner that is not yet marked DOWN but fails the forward also
+        fails the write loudly — the client never got an ack, so
+        nothing acked can be lost."""
         if self.cluster is None:
             return local_fn()
         shard = col_id // SHARD_WIDTH
+        owners = self.cluster.shard_nodes(index, shard)
+        live = [n for n in owners if n.state != "DOWN"]
+        if not live:
+            raise Error(
+                f"write unavailable: every owner of shard {shard} is DOWN "
+                f"({', '.join(n.id for n in owners)})"
+            )
+        if destructive and len(live) < len(owners):
+            raise Error(
+                f"{c.name} unavailable: owner of shard {shard} is DOWN "
+                "and a degraded bit-removing write would be reverted by "
+                "anti-entropy's majority-tie-to-set merge on recovery"
+            )
         ret = False
-        for node in self.cluster.shard_nodes(index, shard):
+        for node in live:
             if node.id == self.cluster.node.id:
                 if local_fn():
                     ret = True

@@ -18,11 +18,14 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import socket
 import threading
+import time
 from typing import List, Optional
 
 from ..util import tracing
+from ..util.stats import METRIC_CLIENT_RETRIES
 
 
 class ClientError(Exception):
@@ -43,16 +46,39 @@ class InternalClient:
     # callers beyond this still work (a fresh connection is dialed when
     # the pool is empty); only the RETAINED idle set is bounded.
     POOL_SIZE = 8
+    # Connect-phase retry budget + capped exponential backoff with
+    # jitter (docs/durability.md): a recovering node that refuses
+    # connections for a moment gets at most ``RETRIES`` re-dials per
+    # request, spaced 50 ms, ~100 ms, ... capped at BACKOFF_CAP and
+    # jittered ±50% so replica hedging and anti-entropy across many
+    # callers can't synchronize into a retry storm against it.
+    RETRIES = 2
+    BACKOFF = 0.05
+    BACKOFF_CAP = 1.0
 
     def __init__(
-        self, uri: str, timeout: float = 30.0, tls_skip_verify: bool = False
+        self,
+        uri: str,
+        timeout: float = 30.0,
+        tls_skip_verify: bool = False,
+        attempt_timeout: Optional[float] = None,
+        retries: Optional[int] = None,
     ):
         """Scheme-aware: an ``https://`` uri speaks TLS;
         ``tls_skip_verify`` accepts self-signed certs for
         cluster-internal traffic (server/config.go TLSConfig.SkipVerify
-        :31-32, http/client.go GetHTTPClient)."""
+        :31-32, http/client.go GetHTTPClient).
+
+        ``timeout`` bounds the WHOLE request including retries;
+        ``attempt_timeout`` (default: timeout) bounds each socket
+        attempt, so one black-holed dial can't consume the entire
+        request deadline before the retry budget gets a chance."""
         self.uri = uri.rstrip("/")
         self.timeout = timeout
+        self.attempt_timeout = (
+            attempt_timeout if attempt_timeout is not None else timeout
+        )
+        self.retries = retries if retries is not None else self.RETRIES
         self._https = self.uri.startswith("https://")
         # urlsplit, not string surgery: IPv6 literals ("http://[::1]:10101")
         # and path-prefixed gateways ("http://gw:8080/pilosa") must keep
@@ -86,17 +112,18 @@ class InternalClient:
         from ..util.stats import METRIC_CLUSTER_REMOTE_CALLS, REGISTRY
 
         self._requests_counter = REGISTRY.counter(METRIC_CLUSTER_REMOTE_CALLS)
+        self._retries_counter = REGISTRY.counter(METRIC_CLIENT_RETRIES)
 
     # -- connection pool ---------------------------------------------------
 
     def _connect(self) -> http.client.HTTPConnection:
         if self._https:
             return http.client.HTTPSConnection(
-                self._host, self._port, timeout=self.timeout,
+                self._host, self._port, timeout=self.attempt_timeout,
                 context=self._ssl_ctx,
             )
         return http.client.HTTPConnection(
-            self._host, self._port, timeout=self.timeout
+            self._host, self._port, timeout=self.attempt_timeout
         )
 
     def _acquire(self):
@@ -141,8 +168,29 @@ class InternalClient:
         # the wire half of the explicit capture/attach protocol in
         # util.tracing.
         tracing.inject_headers(headers)
-        for attempt in (0, 1):
+        deadline = time.monotonic() + self.timeout
+        budget = self.retries  # connect-phase (+ idempotent-GET) retries
+        stale_retry_used = False  # the free stale-keep-alive retry
+        attempt = 0
+        while True:
             conn, reused = self._acquire()
+            if not reused:
+                # Explicit connect so connect-phase failures — dial
+                # refused/reset/timeout on a recovering node, before any
+                # request bytes left this host — are distinguishable
+                # from request/response failures.  They are always safe
+                # to retry (nothing was sent), within the capped
+                # exponential-backoff budget.
+                try:
+                    conn.connect()
+                except (OSError, socket.error) as e:
+                    conn.close()
+                    if budget > 0 and not self._backoff(attempt, deadline):
+                        budget -= 1
+                        attempt += 1
+                        self._retries_counter.inc()
+                        continue
+                    raise ClientError(f"{method} {path}: {e}") from e
             try:
                 conn.request(
                     method, self._base_path + path, body=body, headers=headers
@@ -162,7 +210,8 @@ class InternalClient:
                 # a failure mid-response may mean the request was
                 # already processed: resending a non-idempotent POST
                 # there would double-apply it, so those surface
-                # immediately.
+                # immediately — except idempotent GETs, which may also
+                # consume the backoff budget.
                 stale = isinstance(
                     e,
                     (
@@ -172,8 +221,15 @@ class InternalClient:
                         ConnectionResetError,
                     ),
                 ) and not isinstance(e, socket.timeout)
-                if reused and attempt == 0 and stale:
+                if reused and stale and not stale_retry_used:
+                    stale_retry_used = True
                     continue
+                if method == "GET" and budget > 0:
+                    if not self._backoff(attempt, deadline):
+                        budget -= 1
+                        attempt += 1
+                        self._retries_counter.inc()
+                        continue
                 raise ClientError(f"{method} {path}: {e}") from e
             if keep:
                 self._release(conn)
@@ -188,6 +244,17 @@ class InternalClient:
             if raw:
                 return data
             return json.loads(data) if data else {}
+
+    def _backoff(self, attempt: int, deadline: float) -> bool:
+        """Sleep the capped, jittered exponential delay for retry
+        ``attempt``.  Returns True when the request deadline is already
+        (or would be) exhausted — the caller must stop retrying."""
+        delay = min(self.BACKOFF * (2 ** attempt), self.BACKOFF_CAP)
+        delay *= 0.5 + random.random()  # ±50% jitter: desynchronize callers
+        if time.monotonic() + delay >= deadline:
+            return True
+        time.sleep(delay)
+        return False
 
     def _get(self, path: str, raw: bool = False):
         return self._do("GET", path, raw=raw)

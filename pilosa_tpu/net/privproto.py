@@ -463,11 +463,23 @@ def _encode_node_status(msg: dict) -> bytes:
     out += _len_field(3, schema_b) + statuses
     for t in msg.get("tombstones", []):
         out += _str_field(100, t)
+    # Extension 102: the sender's cluster-state string (the RESIZING
+    # adoption check, api.cluster_message); 103: per-index data-version
+    # tokens — the heartbeat payload bounded replica reads consult;
+    # 104: the sender's completed anti-entropy pass counter (the
+    # bounded-read quarantine release signal, docs/durability.md).
+    out += _str_field(102, msg.get("state", ""))
+    for iname, v in (msg.get("versions") or {}).items():
+        out += _len_field(
+            103, _str_field(1, iname) + _varint_field(2, int(v))
+        )
+    out += _varint_field(104, int(msg.get("aePasses", 0)))
     return out
 
 
 def _decode_node_status(r: _Reader) -> dict:
-    msg: dict = {"indexes": {}, "tombstones": []}
+    msg: dict = {"indexes": {}, "tombstones": [], "versions": {},
+                 "aePasses": 0}
     shards_by_index: Dict[str, Dict[str, List[int]]] = {}
     while not r.eof():
         f, w = r.tag()
@@ -545,6 +557,23 @@ def _decode_node_status(r: _Reader) -> dict:
                 shards_by_index[iname] = fields
         elif f == 100:
             msg["tombstones"].append(r.str_())
+        elif f == 102:
+            msg["state"] = r.str_()
+        elif f == 103:
+            vr = _Reader(r.bytes_())
+            vname, vval = "", 0
+            while not vr.eof():
+                vf, vw = vr.tag()
+                if vf == 1:
+                    vname = vr.str_()
+                elif vf == 2:
+                    vval = vr.uvarint()
+                else:
+                    vr.skip(vw)
+            if vname:
+                msg["versions"][vname] = vval
+        elif f == 104:
+            msg["aePasses"] = r.uvarint()
         else:
             r.skip(w)
     for iname, fields in shards_by_index.items():

@@ -436,6 +436,35 @@ class Handler:
         protobuf (http/handler.go handlePostQuery); accept JSON
         {"query": ...} as well as a bare PQL string."""
         doc = decode_query_doc(q, b)
+        # Replica-read routing override + freshness bound
+        # (docs/durability.md): X-Pilosa-Replica-Read selects
+        # primary|any|bounded for THIS request; X-Pilosa-Freshness-Ms
+        # bounds how stale a replica may be for bounded reads (and
+        # implies bounded mode when no mode header is present).
+        h = headers or {}
+        replica_read = (
+            h.get("X-Pilosa-Replica-Read") or h.get("x-pilosa-replica-read")
+            or ""
+        ).strip().lower()
+        if replica_read not in ("", "primary", "any", "bounded"):
+            # A typo'd mode must 400, not silently serve primary while
+            # the caller believes their freshness contract is active —
+            # the same fail-fast the config key gets at Server boot.
+            raise ValueError(
+                f"X-Pilosa-Replica-Read: {replica_read!r}: expected "
+                "primary, any, or bounded"
+            )
+        freshness_ms = None
+        raw = h.get("X-Pilosa-Freshness-Ms") or h.get("x-pilosa-freshness-ms")
+        if raw:
+            try:
+                freshness_ms = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"X-Pilosa-Freshness-Ms: {raw!r}: expected a number"
+                ) from None
+            if not replica_read:
+                replica_read = "bounded"
         return QueryRequest(
             index,
             doc["query"],
@@ -444,6 +473,8 @@ class Handler:
             exclude_row_attrs=doc["excludeRowAttrs"],
             exclude_columns=doc["excludeColumns"],
             remote=doc["remote"],
+            replica_read=replica_read,
+            freshness_ms=freshness_ms,
             # Join the caller's trace when the request carries one
             # (X-Trace-Id from a coordinator's shard fan-out, or an
             # external client propagating its own trace).
@@ -693,9 +724,14 @@ class Handler:
         NORMAL, and gossip has converged; 503 with the failing reasons
         otherwise (the load-balancer / orchestrator contract)."""
         ready, reasons = self.api.readiness()
-        payload = json.dumps(
-            {"ready": ready, "reasons": reasons, "state": self.api.state()}
-        ).encode()
+        doc = {"ready": ready, "reasons": reasons, "state": self.api.state()}
+        # Warm-start progress (docs/durability.md): present whenever a
+        # warm-start ran this boot, with the residency fraction — the
+        # orchestrator-visible `warming` -> ready lifecycle.
+        ws = self.api.warm_status()
+        if ws is not None:
+            doc["warming"] = ws
+        payload = json.dumps(doc).encode()
         return (200 if ready else 503), "application/json", payload
 
     def _debug_events(self, q, b, **kw):
@@ -871,6 +907,14 @@ class Handler:
             "enabled": plans_mod.ENABLED,
         }
         out["tenants"] = plans_mod.LEDGER.snapshot()
+        # Replica-read freshness evidence (docs/durability.md): per-peer
+        # heartbeat age + data-version tokens, and this boot's
+        # warm-start progress.
+        if self.api.cluster is not None:
+            out["clusterHeartbeats"] = self.api.cluster.heartbeats()
+        ws = self.api.warm_status()
+        if ws is not None:
+            out["warmStart"] = ws
         # Rank-cache maintenance gauges and tenant cost counters refresh
         # before the registry snapshot so pilosa_cache_entries and
         # pilosa_tenant_* are current here exactly as at /metrics.

@@ -675,6 +675,10 @@ class MeshEngine:
         # Lazy ingest device-sync worker (IngestSyncer): the API's
         # import paths notify it after each applied chunk.
         self._ingest_syncer = None
+        # Warm-start progress ({total, built, skipped, done}), set by
+        # warm_start(); /readyz folds it into the readiness verdict as a
+        # residency fraction (docs/durability.md).
+        self.warm_state = None
         # Count/Sum/Min/Max/fused-TopN/TopN-scorer/GroupBy all replay on
         # peers; without a configured broadcast on a multi-process mesh
         # every fused path falls back to the per-shard host path instead
@@ -919,11 +923,45 @@ class MeshEngine:
             return None
         self._cache_miss("stack")
 
+        _token, frag_sync, row_index, mat, occ = self._assemble_host(
+            index, field, view, canonical
+        )
+        while (
+            self._resident_bytes + self._pending_bytes() + mat.nbytes
+            > self.max_resident_bytes
+            and self._stacks
+        ):
+            self._evict(next(iter(self._stacks)))
+        self.stack_rebuilds += 1
+        self._rebuilds_counter.inc()
+        stack = _FieldStack(
+            put_global(self.mesh, mat, P(None, SHARD_AXIS)),
+            row_index,
+            token,
+            list(canonical),
+            frag_sync=frag_sync,
+            occ=occ,
+        )
+        self._stacks[key] = stack
+        self._resident_bytes += mat.nbytes
+        return stack
+
+    def _assemble_host(self, index, field, view, canonical):
+        """Host half of a stack build: walk the view's fragments and
+        assemble the dense [R, S, WORDS] matrix + occupancy summary.
+        Read-only over fragments, so it is safe to run OFF the engine
+        locks (the warm-start prefetch does): sync points are captured
+        BEFORE reading any row words — a write landing mid-assembly has
+        version > recorded and the next incremental sync re-scatters its
+        row (idempotent full-word set), never a silently-lost update.
+        Returns (token, frag_sync, row_index, mat, occ)."""
+        view_obj = self.holder.view(index, field, view)
+        token = (
+            self.holder.shard_epoch(index),
+            id(view_obj),
+            -1 if view_obj is None else view_obj.version,
+        )
         frags = [self.holder.fragment(index, field, view, s) for s in canonical]
-        # Sync points are captured BEFORE reading any row words: a write
-        # landing mid-build then has version > recorded and the next
-        # incremental sync re-scatters its row (idempotent full-word
-        # set) — never a silently-lost update.
         frag_sync = [
             (None, -1) if f is None else (weakref.ref(f), f._version)
             for f in frags
@@ -969,25 +1007,134 @@ class MeshEngine:
                     occ[row_index[r], si] = bitops.occupancy64(
                         mat[row_index[r], si]
                     )
-        while (
-            self._resident_bytes + self._pending_bytes() + mat.nbytes
-            > self.max_resident_bytes
-            and self._stacks
-        ):
-            self._evict(next(iter(self._stacks)))
-        self.stack_rebuilds += 1
-        self._rebuilds_counter.inc()
-        stack = _FieldStack(
-            put_global(self.mesh, mat, P(None, SHARD_AXIS)),
-            row_index,
-            token,
-            list(canonical),
-            frag_sync=frag_sync,
-            occ=occ,
+        return token, frag_sync, row_index, mat, occ
+
+    # -- warm-start (docs/durability.md) -----------------------------------
+
+    def warm_start(self, indexes=None) -> dict:
+        """Re-establish HBM residency from the just-opened holder while
+        the node is ALREADY SERVING from the host path — the boot half
+        of the IngestSyncer overlap pattern: a prefetch thread assembles
+        the host matrix of stack N+1 while this thread admits (uploads)
+        stack N, so host decode and device transfer overlap instead of
+        alternating.  Progress lands in ``self.warm_state`` ({total,
+        built, skipped, done}), which /readyz reports as a ``warming``
+        residency fraction until done.  Warming never evicts: a stack
+        that would not fit the residency budget is skipped (counted),
+        and queries admit their own working set as usual.  Multi-process
+        meshes skip warming entirely — a single process entering
+        put_global collectives alone would hang the mesh."""
+        keys = []
+        if not self.multiproc:
+            for index in (
+                indexes if indexes is not None else list(self.holder.indexes)
+            ):
+                idx = self.holder.index(index)
+                if idx is None or not self.canonical_shards(index):
+                    continue
+                for fname, f in list(idx.fields.items()):
+                    for vname in list(f.views):
+                        keys.append((index, fname, vname))
+        state = {
+            "total": len(keys), "built": 0, "skipped": 0, "done": False,
+        }
+        self.warm_state = state
+        if not keys:
+            state["done"] = True
+            return state
+
+        import queue as queue_mod
+
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1)
+
+        def prefetch():
+            for key in keys:
+                if self._closed:
+                    break
+                index, field, view = key
+                try:
+                    canonical = self.canonical_shards(index)
+                    q.put((key, canonical,
+                           self._assemble_host(index, field, view, canonical)))
+                except Exception as e:  # noqa: BLE001 — skip, keep warming
+                    self._log(f"warm-start assemble {key}: {e}")
+                    q.put((key, None, None))
+            q.put(None)
+
+        t = threading.Thread(
+            target=prefetch, daemon=True, name="warm-assemble"
         )
-        self._stacks[key] = stack
-        self._resident_bytes += mat.nbytes
-        return stack
+        t.start()
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            key, canonical, assembled = item
+            if self._closed:
+                state["skipped"] += 1
+                continue
+            try:
+                if assembled is not None and self._warm_admit(
+                    key, canonical, assembled
+                ):
+                    state["built"] += 1
+                else:
+                    state["skipped"] += 1
+            except Exception as e:  # noqa: BLE001
+                self._log(f"warm-start admit {key}: {e}")
+                state["skipped"] += 1
+        state["done"] = True
+        self.journal.append(
+            "engine.warm_start",
+            built=state["built"], skipped=state["skipped"],
+            total=state["total"],
+        )
+        return state
+
+    def _warm_admit(self, key, canonical, assembled) -> bool:
+        """Admit one prefetched stack under the engine locks.  The
+        assembly ran unlocked, so the version token is re-checked here:
+        any write (or shard create) since the prefetch falls back to the
+        authoritative locked build — a stale matrix is never served."""
+        index, field, view = key
+        token, frag_sync, row_index, mat, occ = assembled
+        with self._dispatch_lock, self._stacks_lock:
+            if self._closed:
+                return False  # shutdown raced the warm thread
+            if key in self._stacks:
+                return True  # a query admitted it first
+            live_canonical = self.canonical_shards(index)
+            view_obj = self.holder.view(index, field, view)
+            now_token = (
+                self.holder.shard_epoch(index),
+                id(view_obj),
+                -1 if view_obj is None else view_obj.version,
+            )
+            if now_token != token or live_canonical != canonical:
+                return (
+                    self._field_stack_locked(
+                        key, index, field, view, live_canonical
+                    )
+                    is not None
+                )
+            if (
+                self._resident_bytes + self._pending_bytes() + mat.nbytes
+                > self.max_resident_bytes
+            ):
+                return False  # budget: warming never evicts the working set
+            self.stack_rebuilds += 1
+            self._rebuilds_counter.inc()
+            stack = _FieldStack(
+                put_global(self.mesh, mat, P(None, SHARD_AXIS)),
+                row_index,
+                token,
+                list(canonical),
+                frag_sync=frag_sync,
+                occ=occ,
+            )
+            self._stacks[key] = stack
+            self._resident_bytes += mat.nbytes
+            return True
 
     def ingest_syncer(self) -> IngestSyncer:
         """The lazy ingest device-sync worker (docs/ingest.md)."""

@@ -47,11 +47,32 @@ def _advertise_uri(host: str, port: int, scheme: str = "http") -> str:
 class Server:
     def __init__(self, config: Optional[Config] = None):
         self.config = config or Config()
+        # Fail fast on enum-valued keys, naming the offending key: a
+        # typo like `[storage] ack = "fsync"` must die HERE, not as an
+        # opaque ValueError deep inside the first fragment open (or a
+        # 500 to an importing client).
+        from .core.fragment import ACK_LEVELS
+
+        if self.config.storage_ack not in ACK_LEVELS:
+            raise ValueError(
+                f"[storage] ack = {self.config.storage_ack!r}: expected "
+                f"one of {', '.join(ACK_LEVELS)}"
+            )
+        if self.config.cluster_replica_read not in (
+            "primary", "any", "bounded"
+        ):
+            raise ValueError(
+                f"[cluster] replica-read = "
+                f"{self.config.cluster_replica_read!r}: expected "
+                "primary, any, or bounded"
+            )
         self.data_dir = os.path.expanduser(self.config.data_dir)
         self.logger = self._make_logger()
         self.stats = self._make_stats()
         self.tracer = self._make_tracer()
-        self.holder = Holder(os.path.join(self.data_dir))
+        self.holder = Holder(
+            os.path.join(self.data_dir), ack=self.config.storage_ack
+        )
         self.translate_store = TranslateFile(
             os.path.join(self.data_dir, ".keys")
         )
@@ -180,7 +201,10 @@ class Server:
             )
         self.translate_store.open()
         self._setup_cluster(host, port)
-        self.holder.open()
+        # Parallel snapshot re-open (warm-start, docs/durability.md):
+        # fragment decode is numpy-heavy and releases the GIL, so a
+        # restart with a big holder comes up in parallel workers.
+        self.holder.open(workers=self.config.storage_open_workers)
         if self.cluster is not None:
             self.cluster.holder = self.holder
         mesh_engine = self._make_mesh_engine()
@@ -444,6 +468,9 @@ class Server:
             logger=self.logger,
             journal=self.journal,
         )
+        # Replica-read routing policy (docs/durability.md).
+        self.cluster.replica_read = self.config.cluster_replica_read
+        self.cluster.freshness_ms = self.config.cluster_freshness_ms
         if (
             not self.config.cluster_hosts
             and not self.config.gossip_seeds
@@ -537,6 +564,9 @@ class Server:
             on_join=on_join,
             on_leave=on_leave,
             on_message=on_message,
+            # Direct-liveness evidence feeds the freshness registry
+            # bounded replica reads consult (docs/durability.md).
+            on_alive=cluster.note_heartbeat,
             logger=self.logger,
             journal=self.journal,
         ).start()
@@ -590,6 +620,12 @@ class Server:
             c = InternalClient(
                 uri, timeout=timeout,
                 tls_skip_verify=self.config.tls_skip_verify,
+                # Per-attempt socket bound < the whole-request deadline:
+                # a black-holed dial to a mid-restart peer must leave
+                # deadline for the backoff budget (and the mapper's
+                # hedge) to engage, instead of one connect eating the
+                # full timeout (docs/durability.md).
+                attempt_timeout=min(10.0, timeout),
             )
             self._client_cache[key] = c
         return c
@@ -599,6 +635,22 @@ class Server:
         return self._http.server_address[1]
 
     def _start_monitors(self):
+        # Overlapped warm-start (docs/durability.md): re-establish HBM
+        # residency from the just-opened snapshots on a background
+        # thread while this node ALREADY answers from the host path;
+        # /readyz reports `warming` with a residency fraction until the
+        # working set is resident.
+        eng = self.api.mesh_engine if self.api is not None else None
+        if (
+            self.config.storage_warm_start
+            and eng is not None
+            and self.holder.indexes
+        ):
+            t = threading.Thread(
+                target=self._warm_start, daemon=True, name="warm-start"
+            )
+            t.start()
+            self._monitors.append(t)
         # Cache flush ticker (holder.go cacheFlushInterval :78).
         self._spawn(self._monitor_cache_flush, 60.0)
         # Runtime metrics loop (server.go monitorRuntime :726).
@@ -621,6 +673,20 @@ class Server:
         if self.config.translation_primary_url:
             self.translate_store.read_only = True
             self._spawn(self._replicate_translate, 1.0)
+
+    def _warm_start(self):
+        try:
+            ws = self.api.mesh_engine.warm_start()
+            self.logger.printf(
+                "warm-start done: %d/%d stacks resident (%d skipped)",
+                ws["built"], ws["total"], ws["skipped"],
+            )
+        except Exception as e:  # noqa: BLE001 — warming must not kill boot
+            self.logger.printf("warm-start failed: %s", e)
+            eng = self.api.mesh_engine
+            ws = getattr(eng, "warm_state", None)
+            if ws is not None:
+                ws["done"] = True  # never pin readyz on a failed warm
 
     def _replicate_translate(self):
         client = self._make_client(self.config.translation_primary_url)

@@ -395,6 +395,33 @@ METRIC_INGEST_SYNC_COALESCED = "pilosa_ingest_sync_coalesced_total"
 METRIC_INGEST_SYNC_DISPATCHES = "pilosa_ingest_sync_dispatches_total"
 INGEST_PATHS = ("bits", "values", "roaring")
 
+# -- durability & serving-through-failure (docs/durability.md) --------------
+#   pilosa_ingest_acked_unsynced_bytes      gauge: op-log bytes ACKED to a
+#                                           writer but not yet handed to
+#                                           the OS — the SIGKILL loss
+#                                           window at ack=received;
+#                                           always 0 at logged/fsynced
+#                                           (those flush/fsync before
+#                                           the ack returns)
+#   pilosa_replica_reads_total{route=}      reads the mapper routed off the
+#                                           local node: route=primary (the
+#                                           shard's first owner), replica
+#                                           (a non-primary owner chosen by
+#                                           replica-read=any/bounded), or
+#                                           hedge (re-routed after a peer
+#                                           failure mid-query)
+#   pilosa_ingest_degraded_batches_total    import batches acked with one or
+#                                           more DOWN owners skipped (the
+#                                           survivors took the write;
+#                                           anti-entropy seeds the dead
+#                                           owner on recovery)
+#   pilosa_client_retries_total             InternalClient connect-phase
+#                                           retries (capped backoff budget)
+METRIC_INGEST_ACKED_UNSYNCED = "pilosa_ingest_acked_unsynced_bytes"
+METRIC_REPLICA_READS = "pilosa_replica_reads_total"
+METRIC_INGEST_DEGRADED_BATCHES = "pilosa_ingest_degraded_batches_total"
+METRIC_CLIENT_RETRIES = "pilosa_client_retries_total"
+
 # -- per-tenant cost attribution (docs/observability.md) --------------------
 #   pilosa_tenant_queries_total{tenant=}        queries executed
 #   pilosa_tenant_device_seconds_total{tenant=} attributed device-seconds
@@ -552,6 +579,21 @@ REGISTRY.counter(
 REGISTRY.counter(
     METRIC_INGEST_SYNC_DISPATCHES,
     help="Warm-sync passes the ingest sync worker ran",
+)
+REGISTRY.set_gauge(METRIC_INGEST_ACKED_UNSYNCED, 0)
+for _route in ("primary", "replica", "hedge"):
+    REGISTRY.counter(
+        METRIC_REPLICA_READS,
+        help="Reads routed off-node by the shard mapper",
+        route=_route,
+    )
+REGISTRY.counter(
+    METRIC_INGEST_DEGRADED_BATCHES,
+    help="Import batches acked with DOWN owners skipped (anti-entropy heals)",
+)
+REGISTRY.counter(
+    METRIC_CLIENT_RETRIES,
+    help="InternalClient connect-phase retries (capped backoff budget)",
 )
 for _path in ("full", "merge"):
     REGISTRY.histogram(

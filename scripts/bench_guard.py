@@ -89,17 +89,44 @@ AUTO_REQUIRE = (
     "http_count_qps_w2",
     "http_count_qps_w4",
     "http_count_qps_w8",
+    # Serving-through-failure headlines (bench.py --chaos-sweep,
+    # docs/durability.md): query availability while a replica is
+    # SIGKILLed mid-load, and the replica-read throughput ratio
+    # (any-mode vs primary-mode) on the same cluster.  Required once
+    # baselined so a later PR cannot silently drop the chaos lane.
+    "availability_under_failure_pct",
+    "replica_read_qps_gain",
 )
+
+# Direction overrides for metrics whose UNIT would mislead: the unit
+# map treats "pct" as lower-better (overhead percentages), but
+# availability regresses DOWN; the gain ratio is dimensionless ("x")
+# and regresses DOWN too.
+NAME_HIGHER_BETTER = {
+    "availability_under_failure_pct",
+    "replica_read_qps_gain",
+}
 
 # Built-in per-metric tolerance (used when no --metric-tolerance names
 # the metric): profile_overhead_pct's denominator is a wall p50 subject
 # to this container's transport jitter, so the ratio wobbles ~2x run to
 # run while the binding contract is the absolute <2% ceiling below.
-DEFAULT_METRIC_TOL = {"profile_overhead_pct": 1.0}
+DEFAULT_METRIC_TOL = {
+    "profile_overhead_pct": 1.0,
+    # A ratio of two closed-loop QPS measurements on a contended host:
+    # wobbles far more than either numerator; the availability floor
+    # below is the binding chaos contract.
+    "replica_read_qps_gain": 0.5,
+}
 
 # Absolute ceilings enforced regardless of the baseline value: crossing
 # one is a failure even when the relative delta is within tolerance.
 ABS_CEILING = {"profile_overhead_pct": 2.0}
+
+# Absolute floors, the ceiling's dual: availability under failure below
+# this is a failure no matter what the baseline recorded (with replica
+# hedging, reads through a replica kill must stay near-continuous).
+ABS_FLOOR = {"availability_under_failure_pct": 90.0}
 
 
 def parse_jsonl(text: str) -> dict:
@@ -201,17 +228,37 @@ def check(current: dict, baseline: dict, tolerance: float,
         delta = cv / float(bv) - 1.0
         line = f"{name}: {cv:g} vs {bv:g} {unit} ({delta:+.1%}, tol {tol:.0%})"
         ceiling = ABS_CEILING.get(name)
+        floor = ABS_FLOOR.get(name)
+        higher = name in NAME_HIGHER_BETTER or unit in HIGHER_BETTER
+        lower = unit in LOWER_BETTER and name not in NAME_HIGHER_BETTER
         if ceiling is not None and cv > ceiling:
             failures.append(f"{name}: {cv:g} exceeds the absolute "
                             f"ceiling {ceiling:g} {unit}")
-        elif unit in LOWER_BETTER and delta > tol:
+        elif floor is not None and cv < floor:
+            failures.append(f"{name}: {cv:g} below the absolute "
+                            f"floor {floor:g} {unit}")
+        elif lower and delta > tol:
             failures.append(line)
-        elif unit in HIGHER_BETTER and -delta > tol:
+        elif higher and -delta > tol:
             failures.append(line)
         else:
             notes.append("ok " + line)
     for name in sorted(set(current) - set(baseline)):
         notes.append(f"{name}: new metric (no baseline)")
+        # Absolute bounds apply even on a metric's FIRST appearance —
+        # a floor/ceiling is a standing contract, not a baseline diff.
+        cv = current[name].get("value")
+        if not isinstance(cv, (int, float)):
+            continue
+        unit = str(current[name].get("unit", ""))
+        ceiling = ABS_CEILING.get(name)
+        floor = ABS_FLOOR.get(name)
+        if ceiling is not None and cv > ceiling:
+            failures.append(f"{name}: {cv:g} exceeds the absolute "
+                            f"ceiling {ceiling:g} {unit}")
+        elif floor is not None and cv < floor:
+            failures.append(f"{name}: {cv:g} below the absolute "
+                            f"floor {floor:g} {unit}")
     for name in require:
         if name not in current:
             failures.append(f"{name}: required metric missing from the new run")

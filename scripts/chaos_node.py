@@ -1,0 +1,62 @@
+"""One pilosa-tpu node for the chaos drills — THE shared boot script.
+
+tests/test_chaos_drill.py, bench.py --chaos-sweep, and scripts/smoke.sh
+all spawn their cluster members through this file, so the drill, the
+bench headlines, and the smoke stage can never measure with diverged
+boot wiring (the same can't-diverge rule as bench's shared id-pairs
+headline helper).  The node id ``n0`` is the coordinator; every other
+node seeds from SEED_PORT.  Fast failure detection (0.2 s probes,
+suspicion x2) and a short anti-entropy interval make the drills land
+in seconds instead of minutes.
+
+  python scripts/chaos_node.py NODE_ID HTTP_PORT GOSSIP_PORT \
+      SEED_PORT DATA_DIR [--replicas 2] [--ack logged] \
+      [--ae-interval 1.5]
+
+Prints ``READY <node_id>`` on stdout once serving, then sleeps until
+killed — the callers SIGKILL/terminate it by design.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("node_id")
+    ap.add_argument("http_port", type=int)
+    ap.add_argument("gossip_port", type=int)
+    ap.add_argument("seed_port", type=int)
+    ap.add_argument("data_dir")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--ack", default="logged")
+    ap.add_argument("--ae-interval", type=float, default=1.5)
+    args = ap.parse_args()
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    cfg = Config()
+    cfg.data_dir = args.data_dir
+    cfg.bind = f"localhost:{args.http_port}"
+    cfg.cluster_coordinator = args.node_id == "n0"
+    cfg.cluster_replicas = args.replicas
+    cfg.storage_ack = args.ack
+    cfg.anti_entropy_interval = args.ae_interval
+    cfg.gossip_port = args.gossip_port
+    if args.node_id != "n0":
+        cfg.gossip_seeds = [f"127.0.0.1:{args.seed_port}"]
+    cfg.gossip_probe_interval = 0.2
+    cfg.gossip_probe_timeout = 0.2
+    cfg.gossip_suspicion_mult = 2
+    srv = Server(cfg)
+    srv.node_id = args.node_id
+    srv.open()
+    print(f"READY {args.node_id}", flush=True)
+    time.sleep(600)
+
+
+if __name__ == "__main__":
+    main()

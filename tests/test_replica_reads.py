@@ -1,0 +1,388 @@
+"""Replica reads & DEGRADED-state routing (docs/durability.md):
+replicaN>1 as a SERVING feature — reads spread across owners under
+`any`/`bounded` modes, DOWN owners are skipped proactively, failures
+hedge to the next replica within a capped budget, and writes to dead
+owners fail loudly (all owners down) or degrade onto the survivors
+(some owners down).  All differential against the healthy-cluster
+oracle: failure must never change an answer, only its route."""
+
+import threading
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import ImportRequest, QueryRequest
+from pilosa_tpu.executor.executor import Error as ExecError
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.util.stats import METRIC_REPLICA_READS, REGISTRY
+
+from harness import run_cluster
+
+N_SHARDS = 8
+
+
+def _routes():
+    return {
+        r: REGISTRY.counter(METRIC_REPLICA_READS, route=r).get()
+        for r in ("primary", "replica", "hedge")
+    }
+
+
+def _route_delta(before):
+    after = _routes()
+    return {r: after[r] - before[r] for r in before}
+
+
+def _setup(tmp_path, n=3, replica_n=2):
+    h = run_cluster(tmp_path, n, replica_n=replica_n)
+    client = h.client(0)
+    client.create_index("i")
+    client.create_field("i", "f")
+    cols = [s * SHARD_WIDTH + 3 for s in range(N_SHARDS)]
+    h[0].api.import_bits(
+        ImportRequest("i", "f", row_ids=[1] * len(cols), column_ids=cols)
+    )
+    return h, len(cols)
+
+
+def _count(h, i=0, shards=None, **kw):
+    resp = h[i].api.query(
+        QueryRequest("i", "Count(Row(f=1))", shards=shards, **kw)
+    )
+    return resp.results[0]
+
+
+def test_primary_mode_routes_replica_order(tmp_path):
+    h, oracle = _setup(tmp_path)
+    try:
+        before = _routes()
+        assert _count(h) == oracle
+        d = _route_delta(before)
+        # Non-local shards went to their PRIMARY owner only; nothing
+        # hedged, nothing spread.
+        assert d["primary"] > 0
+        assert d["replica"] == 0
+        assert d["hedge"] == 0
+    finally:
+        h.close()
+
+
+def test_any_mode_spreads_reads_across_owners(tmp_path):
+    h, oracle = _setup(tmp_path)
+    try:
+        before = _routes()
+        assert _count(h, replica_read="any") == oracle
+        d = _route_delta(before)
+        # The per-shard rotation hit at least one NON-primary owner —
+        # replicaN>1 is serving reads, not just standing by.
+        assert d["replica"] > 0
+        assert d["hedge"] == 0
+    finally:
+        h.close()
+
+
+def test_bounded_mode_requires_freshness_evidence(tmp_path):
+    h, oracle = _setup(tmp_path)
+    try:
+        # (a) No heartbeats recorded: every non-self replica is stale,
+        # so bounded degrades to primary routing — never to an
+        # unbounded-staleness read.
+        before = _routes()
+        assert _count(h, replica_read="bounded") == oracle
+        assert _route_delta(before)["replica"] == 0
+
+        # (b) Fresh heartbeats admit replicas into the rotation.
+        h[0].cluster.note_heartbeat("node1", {"i": 7})
+        h[0].cluster.note_heartbeat("node2", {"i": 7})
+        before = _routes()
+        assert _count(h, replica_read="bounded", freshness_ms=60000) == oracle
+        assert _route_delta(before)["replica"] > 0
+        hb = h[0].cluster.heartbeats()
+        assert hb["node1"]["versions"] == {"i": 7}
+
+        # (c) A zero bound makes everything stale again.
+        before = _routes()
+        assert _count(h, replica_read="bounded", freshness_ms=0) == oracle
+        assert _route_delta(before)["replica"] == 0
+    finally:
+        h.close()
+
+
+def test_node_status_heartbeat_over_the_wire(tmp_path):
+    """A NodeStatus exchange — the anti-entropy heartbeat — carries the
+    sender's per-index data-version tokens through the privproto wire
+    format into the receiver's freshness registry."""
+    h, _ = _setup(tmp_path)
+    try:
+        assert "node1" not in h[0].cluster.heartbeats()
+        h[1].cluster.send_sync(h[1].cluster.node_status())
+        hb = h[0].cluster.heartbeats()
+        assert "node1" in hb, hb
+        assert hb["node1"]["ageMs"] < 5000
+        # node1 holds fragments of index "i", so its token is > 0 and
+        # survived the protobuf round trip.
+        assert hb["node1"]["versions"].get("i", 0) > 0, hb
+        # ...and it now qualifies as a fresh bounded-read target.
+        assert h[0].cluster.replica_fresh("node1", "i", 60000)
+        assert not h[0].cluster.replica_fresh("node1", "i", 0)
+    finally:
+        h.close()
+
+
+def test_bounded_quarantines_recovered_replica_until_antientropy(tmp_path):
+    """A replica that was DOWN missed writes; liveness alone must not
+    readmit it to bounded reads — only a completed anti-entropy pass
+    that STARTED after recovery does (the aePasses handshake).  Direct
+    contact does, however, refute the DOWN verdict itself, so primary
+    routing and writes come back within one heartbeat."""
+    h, _ = _setup(tmp_path)
+    try:
+        c = h[0].cluster
+        c.note_heartbeat("node1", {"i": 3}, ae_passes=5)
+        assert c.replica_fresh("node1", "i", 60000)
+
+        c.node_failed("node1")
+        assert c.node_by_id("node1").state == "DOWN"
+        assert not c.replica_fresh("node1", "i", 60000)
+
+        # Within the recovery holddown, gossip liveness alone does NOT
+        # refute the verdict (a wedged serving plane keeps its gossip
+        # chatty; each fresh RPC failure re-arms this).
+        c.note_heartbeat("node1")
+        assert c.node_by_id("node1").state == "DOWN"
+        # Once the holddown elapses with no further verdicts, the next
+        # heartbeat refutes it.
+        c._down_since["node1"] -= c.RECOVERY_HOLDDOWN + 1
+        c.note_heartbeat("node1")
+        assert c.node_by_id("node1").state == "READY"
+        # ...but bounded reads still distrust it (quarantined).
+        assert not c.replica_fresh("node1", "i", 60000)
+        assert c.heartbeats()["node1"]["quarantined"] is True
+
+        # First post-recovery status sets the baseline; the SAME pass
+        # count does not release (it may have started pre-recovery).
+        c.note_heartbeat("node1", {"i": 4}, ae_passes=6)
+        assert not c.replica_fresh("node1", "i", 60000)
+        c.note_heartbeat("node1", {"i": 4}, ae_passes=6)
+        assert not c.replica_fresh("node1", "i", 60000)
+        # A pass that completed strictly after recovery releases it.
+        c.note_heartbeat("node1", {"i": 5}, ae_passes=7)
+        assert c.replica_fresh("node1", "i", 60000)
+        assert c.heartbeats()["node1"]["quarantined"] is False
+
+        # The syncer's own pass counter feeds the wire signal.
+        before = c.ae_passes
+        from pilosa_tpu.cluster.syncer import HolderSyncer
+
+        HolderSyncer(h[0].holder, c).sync_holder()
+        assert c.ae_passes == before + 1
+        assert c.node_status()["aePasses"] == c.ae_passes
+    finally:
+        h.close()
+
+
+def test_down_primary_skipped_proactively(tmp_path):
+    """DEGRADED (down < replicaN): reads route to surviving replicas
+    with NO hedge round-trip wasted on the dead primary, and stay
+    bit-exact vs the pre-failure oracle."""
+    h, oracle = _setup(tmp_path)
+    try:
+        assert _count(h) == oracle  # pre-kill oracle
+        h[0].cluster.node_failed("node1")
+        assert h[0].cluster.state == "DEGRADED"
+        before = _routes()
+        assert _count(h) == oracle
+        d = _route_delta(before)
+        assert d["hedge"] == 0, "routed to a known-DOWN owner"
+        # Shards whose primary is node1 served from the surviving
+        # replica.
+        owned_by_1 = [
+            s for s in range(N_SHARDS)
+            if h[0].cluster.shard_nodes("i", s)[0].id == "node1"
+        ]
+        if owned_by_1:
+            assert d["replica"] > 0
+    finally:
+        h.close()
+
+
+def test_unmarked_failure_hedges_within_budget(tmp_path):
+    """A primary that dies WITHOUT a gossip verdict: the first RPC
+    fails, the mapper marks it DOWN and hedges the shards onto the next
+    replica — the query answers bit-exactly, never errors."""
+    h, _oracle = _setup(tmp_path)
+    try:
+        # A shard whose PRIMARY is node1 and which node0 does not own
+        # (owners {node1, node2} — on the 3-slot ring this is the only
+        # remote-primary shape node0 can see, since a node2-primary
+        # shard wraps to include node0 itself): primary-mode routing
+        # from node0 must dial node1.
+        target = None
+        for s in range(256):
+            owners = h[0].cluster.shard_nodes("i", s)
+            if owners[0].id == "node1" and all(
+                n.id != "node0" for n in owners
+            ):
+                target = s
+                break
+        assert target is not None, "no node1-primary shard in 256 probes"
+        col = target * SHARD_WIDTH + 5
+        h[0].api.import_bits(
+            ImportRequest("i", "f", row_ids=[1], column_ids=[col])
+        )
+        expected = _count(h, shards=[target])  # pre-kill oracle
+        assert expected >= 1
+
+        victim = h[1]
+        victim._http.shutdown()
+        victim._http.server_close()
+        before = _routes()
+        assert _count(h, shards=[target]) == expected
+        assert _route_delta(before)["hedge"] > 0
+        assert h[0].cluster.node_by_id("node1").state == "DOWN"
+        # Subsequent queries skip it proactively: no more hedges.
+        before = _routes()
+        assert _count(h, shards=[target]) == expected
+        assert _route_delta(before)["hedge"] == 0
+    finally:
+        h.close()
+
+
+def _shard_owned_by(h, owners):
+    for s in range(64):
+        ids = {n.id for n in h[0].cluster.shard_nodes("i", s)}
+        if ids == owners:
+            return s
+    pytest.skip(f"no shard owned by exactly {owners} in 64 probes")
+
+
+def test_writes_to_dead_owners_fail_loudly(tmp_path):
+    """Every owner DOWN -> the write (single-bit and bulk import alike)
+    fails loudly: nothing can make the ack durable, so nothing is
+    acked.  One owner DOWN -> the survivors take it, the batch acks,
+    and the degraded counter records the skip."""
+    from pilosa_tpu.api import ApiError
+    from pilosa_tpu.util.stats import METRIC_INGEST_DEGRADED_BATCHES
+
+    h, _ = _setup(tmp_path)
+    try:
+        s = _shard_owned_by(h, {"node1", "node2"})
+        col = s * SHARD_WIDTH + 99
+        h[0].cluster.node_failed("node1")
+        h[0].cluster.node_failed("node2")
+        with pytest.raises(ExecError, match="write unavailable"):
+            h[0].api.query(QueryRequest("i", f"Set({col}, f=2)"))
+        with pytest.raises(ApiError, match="import unavailable"):
+            h[0].api.import_bits(
+                ImportRequest("i", "f", row_ids=[2], column_ids=[col])
+            )
+
+        # One survivor: the SET lands there, loudly acked as degraded.
+        h[0].cluster.node_recovered("node2")
+        before = REGISTRY.counter(METRIC_INGEST_DEGRADED_BATCHES).get()
+        h[0].api.import_bits(
+            ImportRequest("i", "f", row_ids=[2], column_ids=[col])
+        )
+        assert (
+            REGISTRY.counter(METRIC_INGEST_DEGRADED_BATCHES).get() - before
+            == 1
+        )
+        frag = h[2].holder.fragment("i", "f", "standard", s)
+        assert frag is not None and frag.bit(2, col)
+
+        # CLEARS never degrade: an acked clear on the lone survivor
+        # would be REVERTED by anti-entropy's majority-tie-to-set merge
+        # when the dead owner (still holding the bit) recovers — so
+        # both the single-bit and bulk clear paths fail loudly instead.
+        with pytest.raises(ExecError, match="Clear unavailable"):
+            h[0].api.query(QueryRequest("i", f"Clear({col}, f=2)"))
+        with pytest.raises(ApiError, match="clear import unavailable"):
+            h[0].api.import_bits(
+                ImportRequest("i", "f", row_ids=[2], column_ids=[col]),
+                clear=True,
+            )
+        # With every owner back, the clear applies normally.
+        h[0].cluster.node_recovered("node1")
+        assert h[0].api.query(
+            QueryRequest("i", f"Clear({col}, f=2)")
+        ).results[0] is True
+    finally:
+        h.close()
+
+
+def test_resize_during_failure_interleaving(tmp_path):
+    """Remove a DOWN node while reads hammer the cluster: every read
+    during the resize returns the oracle count (reads keep serving on
+    the old topology), the resize completes, and the remaining nodes
+    own every shard with full replication."""
+    h, oracle = _setup(tmp_path)
+    try:
+        for i in range(3):
+            h[i].cluster.node_failed("node2")
+        assert _count(h) == oracle
+
+        stop = threading.Event()
+        read_errors, reads = [], []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    reads.append(_count(h))
+                except Exception as e:  # noqa: BLE001
+                    read_errors.append(e)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            removed = h[0].cluster.remove_node("node2")
+            assert removed is not None
+        finally:
+            stop.set()
+            t.join()
+        assert not read_errors, f"reads failed during resize: {read_errors[:3]}"
+        assert reads and all(c == oracle for c in reads)
+        assert h[0].cluster.state == "NORMAL"
+        assert {n.id for n in h[0].cluster.nodes} == {"node0", "node1"}
+        # Full replication on the survivors: every shard now has both.
+        for s in range(N_SHARDS):
+            ids = {n.id for n in h[0].cluster.shard_nodes("i", s)}
+            assert ids == {"node0", "node1"}
+        assert _count(h) == oracle
+    finally:
+        h.close()
+
+
+def test_replica_read_header_end_to_end(tmp_path):
+    """X-Pilosa-Replica-Read / X-Pilosa-Freshness-Ms ride the HTTP
+    surface into the mapper (a freshness header alone implies bounded
+    mode)."""
+    h, oracle = _setup(tmp_path)
+    try:
+        before = _routes()
+        req = urllib.request.Request(
+            f"http://localhost:{h[0].port}/index/i/query",
+            data=b"Count(Row(f=1))",
+            method="POST",
+            headers={"X-Pilosa-Replica-Read": "any"},
+        )
+        import json
+
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+        assert doc["results"] == [oracle]
+        assert _route_delta(before)["replica"] > 0
+
+        # Freshness header implies bounded; no heartbeats -> primary.
+        before = _routes()
+        req = urllib.request.Request(
+            f"http://localhost:{h[0].port}/index/i/query",
+            data=b"Count(Row(f=1))",
+            method="POST",
+            headers={"X-Pilosa-Freshness-Ms": "5000"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+        assert doc["results"] == [oracle]
+        assert _route_delta(before)["replica"] == 0
+    finally:
+        h.close()
