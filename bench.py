@@ -1356,6 +1356,243 @@ print(json.dumps({"n": sum(c.got for c in conns),
          bytes_read=2 * N_SHARDS * ROW_BYTES)
 
 
+# ---- dashboard fusion: whole-program heterogeneous drains (--dashboard-sweep)
+
+# 8 shards keeps the per-widget device program small enough that this
+# container's lane measures the SERVING regime (per-dispatch floor +
+# shared-mask reuse dominate) rather than raw memory bandwidth; at 32
+# shards the same sweep is bandwidth-bound on the ~1.5 shared vCPUs and
+# the fused win compresses to the pure bytes-saved ratio (~1.2x here).
+# The TPU round measures the full shape (docs/fusion.md).
+DASH_SHARDS = 8
+DASH_WIDGETS = (2, 4, 8)
+DASH_REPS = 24
+
+
+def _dash_entries(pql, n, shards):
+    """1 segment filter x ``n`` widgets of mixed ops — the dashboard
+    shape whole-program fusion exists for (docs/fusion.md).  The
+    segment is a 4-row conjunction (country AND cohort AND plan AND
+    active — the audience-filter norm), so every unfused widget
+    re-sweeps 4 rows just to rebuild the mask the fused program
+    materializes once."""
+    seg = "Intersect(Row(seg=0), Row(seg=1), Row(seg=2), Row(seg=3))"
+    segc = lambda: pql.parse(seg).calls[0]  # noqa: E731
+    widgets = [
+        ({"kind": "count",
+          "call": pql.parse(f"Intersect({seg}, Row(w=1))").calls[0]}, shards),
+        ({"kind": "sum", "field": "v", "filter": segc()}, shards),
+        ({"kind": "topnf", "field": "w", "src": segc(), "n": 5,
+          "threshold": 1, "row_ids": None}, shards),
+        ({"kind": "min", "field": "v", "filter": segc()}, shards),
+        ({"kind": "max", "field": "v", "filter": segc()}, shards),
+        ({"kind": "count",
+          "call": pql.parse(f"Intersect({seg}, Row(w=2))").calls[0]}, shards),
+        ({"kind": "topn", "field": "w", "rows": [1, 2, 3, 4],
+          "src": segc()}, shards),
+        ({"kind": "count",
+          "call": pql.parse(f"Difference({seg}, Row(w=3))").calls[0]}, shards),
+    ]
+    return widgets[:n]
+
+
+def _dash_oracle(eng, entries):
+    """The retained sequential per-query path: one blocking dispatch +
+    readback per widget — exactly what the serving tier paid pre-fusion."""
+    out = []
+    for spec, shards in entries:
+        k = spec["kind"]
+        if k == "count":
+            out.append(eng.count("dash", spec["call"], shards))
+        elif k == "sum":
+            out.append(eng.sum("dash", spec["field"], spec.get("filter"), shards))
+        elif k in ("min", "max"):
+            out.append(eng.min_max("dash", spec["field"], spec.get("filter"),
+                                   shards, k == "min"))
+        elif k == "topn":
+            out.append(eng.topn_scores("dash", spec["field"], spec["rows"],
+                                       spec["src"], shards))
+        else:
+            out.append(eng.topn_full("dash", spec["field"], spec["src"],
+                                     shards, spec["n"], spec["threshold"]))
+    return out
+
+
+def dashboard_sweep():
+    """Whole-program fusion sweep (docs/fusion.md): dashboard-shaped
+    drains — 1 segment filter x N in {2, 4, 8} widgets of mixed
+    Count/Sum/Min/Max/TopN — timed as ONE fused device program vs the
+    unfused sequential per-query path on the same data.  Emits
+    ``dashboard_fused_qps`` / ``dashboard_p50_ms`` (N=8 headlines,
+    bench_guard AUTO_REQUIREd once baselined), the per-N curve, the
+    measured speedup (ABS_FLOORed at 1.5x in bench_guard), and
+    ``fused_masks_saved_total``; asserts — via plan records — that the
+    fused N=8 drain evaluated each shared mask exactly once."""
+    progress("importing jax (dashboard sweep)")
+    import threading as _threading
+
+    import jax
+
+    from pilosa_tpu import pql
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.ops import bitops
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+    from pilosa_tpu.parallel import fusion
+    from pilosa_tpu.parallel.batcher import CountBatcher
+    from pilosa_tpu.util import plans as plans_mod
+
+    rng = np.random.default_rng(23)
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("dash")
+    seg_f = idx.create_field("seg")
+    w_f = idx.create_field("w")
+    v_f = idx.create_field("v", FieldOptions(type="int", min=0, max=100))
+    shards = list(range(DASH_SHARDS))
+    seg_view = seg_f.view_if_not_exists("standard")
+    w_view = w_f.view_if_not_exists("standard")
+    for s in shards:
+        sf = seg_view.fragment_if_not_exists(s)
+        for r in range(4):
+            sf.load_row_words(
+                r, __rand(rng, bitops.WORDS64) | __rand(rng, bitops.WORDS64)
+            )
+        wf = w_view.fragment_if_not_exists(s)
+        for r in range(1, 5):
+            wf.load_row_words(r, __rand(rng, bitops.WORDS64))
+    for frag in list(seg_view.fragments.values()) + list(
+        w_view.fragments.values()
+    ):
+        frag.cache.invalidate()
+    cols = rng.choice(DASH_SHARDS << 20, size=30_000, replace=False)
+    v_f.import_values(
+        [int(c) for c in cols], [int(c % 100) for c in range(len(cols))]
+    )
+    progress("dashboard build done")
+
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh)
+    eng.result_memo.maxsize = 0  # every rep must really dispatch
+
+    t_fused_8 = t_seq_8 = None
+    saved0 = eng.fused_masks_referenced - eng.fused_masks_evaluated
+    for n in DASH_WIDGETS:
+        entries = _dash_entries(pql, n, shards)
+        want = _dash_oracle(eng, entries)  # warms every solo executable
+        got = eng.fused_many("dash", entries)  # warms the fused program
+        for k, (g, w) in enumerate(zip(got, want)):
+            if isinstance(w, tuple) and len(w) == 3:
+                assert np.array_equal(g[0], w[0]), f"widget {k} diverged"
+            else:
+                assert g == w, f"widget {k} diverged: {g!r} != {w!r}"
+        e0, r0 = eng.fused_masks_evaluated, eng.fused_masks_referenced
+        t_fused, _ = sync_p50(
+            lambda i: eng.fused_many("dash", entries), reps=DASH_REPS
+        )
+        per_drain_saved = (
+            (eng.fused_masks_referenced - r0) - (eng.fused_masks_evaluated - e0)
+        ) / DASH_REPS
+        t_seq, _ = sync_p50(
+            lambda i: _dash_oracle(eng, entries), reps=max(4, DASH_REPS // 2)
+        )
+        fused_qps = n / t_fused
+        seq_qps = n / t_seq
+        emit_raw(f"dashboard_fused_qps_n{n}", fused_qps, "qps",
+                 fused_qps / seq_qps)
+        emit_raw(f"dashboard_seq_qps_n{n}", seq_qps, "qps", 1.0)
+        emit_raw(f"dashboard_speedup_n{n}", t_seq / t_fused, "x",
+                 t_seq / t_fused)
+        progress(
+            f"N={n}: fused {t_fused * 1e3:.2f}ms/drain ({fused_qps:.0f} "
+            f"widget-qps) vs sequential {t_seq * 1e3:.2f}ms "
+            f"({seq_qps:.0f}), saved {per_drain_saved:.1f} mask evals/drain"
+        )
+        if n == 8:
+            t_fused_8, t_seq_8 = t_fused, t_seq
+
+    # Headlines (N=8): widget answers per second through the fused
+    # program, drain wall p50, and the guarded fused-vs-sequential
+    # speedup (bench_guard ABS_FLOOR 1.5).
+    emit_raw("dashboard_fused_qps", 8 / t_fused_8, "qps",
+             t_seq_8 / t_fused_8)
+    emit_raw("dashboard_p50_ms", t_fused_8 * 1e3, "ms",
+             t_seq_8 / t_fused_8)
+    emit_raw("dashboard_fused_speedup", t_seq_8 / t_fused_8, "x",
+             t_seq_8 / t_fused_8)
+
+    # Acceptance, via plan records: drive the N=8 drain through the
+    # REAL batcher and assert the recorded plan ops show every shared
+    # mask evaluated once (masks_evaluated == distinct subtrees).
+    eng._batcher = CountBatcher(eng)
+    b = eng.batcher()
+    b._last_fused = time.monotonic() + 10_000  # all submissions queue
+    entries = _dash_entries(pql, 8, shards)
+    distinct = set()
+    for spec, _s in entries:
+        distinct |= fusion.item_texts(spec)
+    plans = [plans_mod.QueryPlan("dash", f"widget{k}")
+             for k in range(len(entries))]
+
+    def run(k):
+        spec, s = entries[k]
+        with plans_mod.attach(plans[k]):
+            if spec["kind"] == "count":
+                b.submit("dash", spec["call"], s)
+            elif spec["kind"] == "sum":
+                eng.batched_sum("dash", spec["field"], spec["filter"], s)
+            elif spec["kind"] in ("min", "max"):
+                eng.batched_min_max("dash", spec["field"], spec["filter"], s,
+                                    spec["kind"] == "min")
+            elif spec["kind"] == "topn":
+                eng.batched_topn_scores("dash", spec["field"], spec["rows"],
+                                        spec["src"], s)
+            else:
+                eng.batched_topn_full("dash", spec["field"], spec["src"], s,
+                                      spec["n"], spec["threshold"])
+
+    threads = [_threading.Thread(target=run, args=(k,))
+               for k in range(len(entries))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    fused_ops = [
+        op
+        for p in plans
+        for op in p.ops
+        if op.get("path") == "fused_program"
+    ]
+    assert fused_ops, "no widget recorded a fused_program plan op"
+    full = [op for op in fused_ops if op.get("fused_queries") == len(entries)]
+    if full:
+        assert full[0]["masks_evaluated"] == len(distinct), (
+            full[0], len(distinct)
+        )
+        assert full[0]["masks_referenced"] > full[0]["masks_evaluated"]
+        progress(
+            f"plan record: {full[0]['masks_referenced']} mask refs -> "
+            f"{full[0]['masks_evaluated']} evaluated "
+            f"(== {len(distinct)} distinct)"
+        )
+    else:
+        progress(
+            "plan record: drain split across accumulation windows "
+            f"({sorted(set(op.get('fused_queries') for op in fused_ops))} "
+            "riders) — sharing still recorded per drain"
+        )
+    saved_total = (
+        eng.fused_masks_referenced - eng.fused_masks_evaluated
+    ) - saved0
+    print(json.dumps({
+        "metric": "fused_masks_saved_total",
+        "value": int(saved_total),
+        "unit": "evals",
+        "vs_baseline": 1.0,
+    }), flush=True)
+    eng.close()
+
+
 def __rand(rng, words64):
     return rng.integers(0, 1 << 63, size=words64, dtype=np.uint64) | (
         rng.integers(0, 1 << 63, size=words64, dtype=np.uint64) << np.uint64(1)
@@ -2428,6 +2665,17 @@ if __name__ == "__main__":
         "(docs/durability.md)",
     )
     ap.add_argument(
+        "--dashboard-sweep",
+        action="store_true",
+        help="run the whole-program fusion sweep ONLY: dashboard-shaped "
+        "drains (1 segment filter x N in {2,4,8} widgets of mixed "
+        "Count/Sum/Min/Max/TopN) as ONE fused device program vs the "
+        "sequential per-query path, emitting dashboard_fused_qps / "
+        "dashboard_p50_ms / dashboard_fused_speedup / "
+        "fused_masks_saved_total and asserting via plan records that "
+        "each shared mask evaluated once (docs/fusion.md)",
+    )
+    ap.add_argument(
         "--conn-sweep",
         action="store_true",
         help="also sweep client connection counts (1/4/16/64, open-loop "
@@ -2510,6 +2758,8 @@ if __name__ == "__main__":
         chaos_sweep()
     elif args.density_sweep:
         density_sweep()
+    elif args.dashboard_sweep:
+        dashboard_sweep()
     else:
         main(
             depth_sweep=args.depth_sweep,

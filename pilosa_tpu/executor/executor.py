@@ -1680,9 +1680,13 @@ class Executor:
             return None
         filter_call = c.children[0] if c.children else None
         try:
+            # batched_sum routes through the engine's batch lane: a lone
+            # caller runs the blocking sum program exactly as before;
+            # concurrent callers coalesce into a fused whole-program
+            # dispatch with their drain-mates (docs/fusion.md).
             total, n = self._sflight.do(
                 ("sum", seq, index, str(c), tuple(local)),
-                lambda: self.mesh_engine.sum(
+                lambda: self.mesh_engine.batched_sum(
                     index, field_name, filter_call, local
                 ),
             )
@@ -1743,7 +1747,7 @@ class Executor:
         try:
             val, n = self._sflight.do(
                 ("minmax", seq, is_min, index, str(c), tuple(local)),
-                lambda: self.mesh_engine.min_max(
+                lambda: self.mesh_engine.batched_min_max(
                     index, field_name, filter_call, local, is_min
                 ),
             )
@@ -1816,7 +1820,7 @@ class Executor:
                 )
             out = self._sflight.do(
                 ("topn", seq, index, str(c), tuple(sorted(local))),
-                lambda: self.mesh_engine.topn_full(
+                lambda: self.mesh_engine.batched_topn_full(
                     index,
                     field_name,
                     c.children[0],
@@ -1912,7 +1916,7 @@ class Executor:
             return set(shards), []
         candidates = sorted(cand_set)
         try:
-            scored = self.mesh_engine.topn_scores(
+            scored = self.mesh_engine.batched_topn_scores(
                 index, field_name, candidates, c.children[0], shards
             )
         except (ValueError, PeerlessMeshError):

@@ -77,8 +77,10 @@ def submit_origin() -> Optional[str]:
 
 
 class _Item:
-    """One submitted Count: a future resolved by the collect stage (or
-    inline on the direct path).  ``add_done_callback`` lets the HTTP
+    """One submitted query item — a Count tree (``kind == "count"``) or
+    an aggregate op spec (sum/min/max/topn/topnf riding the same drain,
+    docs/fusion.md) — resolved by the collect stage (or inline on the
+    direct path).  ``add_done_callback`` lets the HTTP
     layer resolve a pending response without parking a thread in
     ``wait``.  The submitter's current span is captured here — the
     explicit trace handoff across the accumulate/dispatch/collect
@@ -88,6 +90,9 @@ class _Item:
         "index",
         "call",
         "shards",
+        "kind",
+        "spec",
+        "plan_extra",
         "event",
         "result",
         "error",
@@ -100,10 +105,18 @@ class _Item:
         "_callbacks",
     )
 
-    def __init__(self, index, call, shards):
+    def __init__(self, index, call, shards, kind="count", spec=None):
         self.index = index
         self.call = call
         self.shards = shards
+        self.kind = kind
+        # Op spec for non-count items ({"kind", "field", "filter", ...});
+        # count items keep spec None and the dispatch stage synthesizes
+        # {"kind": "count", "call"} only when a drain actually fuses.
+        self.spec = spec
+        # Per-item plan-note extras stamped by the fused planner (op
+        # name, mask_shared_with, footprint share).
+        self.plan_extra = None
         self.event = threading.Event()
         self.result: Optional[int] = None
         self.error: Optional[BaseException] = None
@@ -272,6 +285,46 @@ class CountBatcher:
         return self._submit(index, call, shards, allow_direct=False,
                             memo_key=key, memo_note=memo_note)
 
+    def submit_op(self, index: str, kind: str, spec: dict, shards):
+        """One aggregate op (sum/min/max/topn/topnf) through the batch
+        lane: a lone caller runs the blocking single-op program directly
+        (zero added latency — exactly the pre-fusion path); callers
+        arriving while the pipe is busy queue into the drain, where the
+        planner fuses them with their drain-mates into ONE device
+        program (docs/fusion.md).  Returns the op's standard result
+        shape; raises the item's own error on failure."""
+        item = self._submit(index, None, shards, allow_direct=True,
+                            kind=kind, spec=spec)
+        if item is None:
+            return self._direct_op(index, kind, spec, shards)
+        if not item.event.wait(self.WAIT_TIMEOUT):
+            raise RuntimeError("batched op timed out (engine wedged?)")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _direct_op(self, index, kind, spec, shards):
+        t0 = time.monotonic()
+        try:
+            return self.engine.solo_op(index, kind, spec, shards)
+        finally:
+            note = plans_mod.take_dispatch_note()
+            plan = plans_mod.current_plan()
+            if plan is not None:
+                from .fusion import OP_NAMES
+
+                d = dict(note) if note else {}
+                d.setdefault("op", OP_NAMES.get(kind, kind))
+                d.setdefault("path", "direct")
+                plan.note_op(**d)
+                elapsed = time.monotonic() - t0
+                plan.note_stage("execute", elapsed)
+                plan.note_device_seconds(elapsed)
+            with self._lock:
+                self._busy = False
+                if self._queue:
+                    self._cond.notify_all()
+
     def _plan_memo_note(self, probed: bool, key, hit):
         """Plan-record the memo outcome on the SUBMIT thread (the plan
         is ambient here; the dispatch workers only see items).  A hit is
@@ -300,13 +353,13 @@ class CountBatcher:
         return probe(index, call, shards)
 
     def _submit(self, index, call, shards, allow_direct: bool, memo_key=None,
-                memo_note=None):
+                memo_note=None, kind="count", spec=None):
         with self._lock:
             hot = time.monotonic() - self._last_fused < self.HOT_WINDOW
             if allow_direct and not self._busy and not self._queue and not hot:
                 self._busy = True
                 return None  # caller runs the direct path
-            item = _Item(index, call, list(shards))
+            item = _Item(index, call, list(shards), kind=kind, spec=spec)
             item.memo_key = memo_key
             item.memo_note = memo_note
             self._queue.append(item)
@@ -420,15 +473,64 @@ class CountBatcher:
             with self._lock:
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]
-            # One dispatch per (index, structure) group in the drain
-            # (operand lists are per-index; mixed structures would
-            # compile distinct padded programs, so each structure fuses
-            # separately).
-            by_sig = {}
-            for it in batch:
-                by_sig.setdefault(self._signature(it.index, it.call), []).append(it)
-            for (index, _sig), items in by_sig.items():
-                self._dispatch_q.put((index, items, False))
+            for group in self._plan_drain(batch):
+                self._dispatch_q.put(group + (False,))
+
+    def _plan_drain(self, batch):
+        """The whole-program planning stage between accumulate and
+        lowering (docs/fusion.md).  Pure-Count runs keep the proven
+        per-(index, structure) grouping — fixed-tier executables, batch
+        CSE, the sparse scalar detour all intact.  A drain carrying
+        aggregate items plans heterogeneously instead: every aggregate,
+        plus every Count that SHARES a Row subtree with one (the
+        dashboard shape: one segment filter fanned into N widgets),
+        becomes ONE fused group lowered to a single device program that
+        materializes each distinct mask once.  A fused group of one
+        falls back to the op's existing solo program — no 1-item fused
+        executables minted."""
+        groups = []
+        by_index: dict = {}
+        for it in batch:
+            by_index.setdefault(it.index, []).append(it)
+        eng = self.engine
+        fusion_ok = (
+            getattr(eng, "fused_many_async", None) is not None
+            and not getattr(eng, "multiproc", False)
+        )
+        for index, items in by_index.items():
+            aggs = [it for it in items if it.kind != "count"]
+            counts = [it for it in items if it.kind == "count"]
+            if aggs and fusion_ok:
+                from .fusion import item_texts, subtree_texts
+
+                agg_texts = set()
+                for it in aggs:
+                    agg_texts |= item_texts(it.spec)
+                fused_items = list(aggs)
+                rest = []
+                for it in counts:
+                    if agg_texts & subtree_texts(it.call):
+                        fused_items.append(it)
+                    else:
+                        rest.append(it)
+                counts = rest
+                if len(fused_items) == 1:
+                    groups.append(("solo", index, fused_items))
+                else:
+                    groups.append(("fused", index, fused_items))
+            elif aggs:
+                # No fused support on this engine (stub/multi-process):
+                # each aggregate runs its own pipelined solo dispatch.
+                for it in aggs:
+                    groups.append(("solo", index, [it]))
+            by_sig: dict = {}
+            for it in counts:
+                by_sig.setdefault(
+                    self._signature(it.index, it.call), []
+                ).append(it)
+            for _sig, its in by_sig.items():
+                groups.append(("count", index, its))
+        return groups
 
     # -- lower+dispatch stage -----------------------------------------------
 
@@ -437,7 +539,7 @@ class CountBatcher:
             got = self._dispatch_q.get()
             if got is None:
                 return  # stop() sentinel
-            index, items, retried = got
+            gkind, index, items, retried = got
             # Blocks when ``max_inflight`` batches are already in the
             # pipe — the backpressure that lets the accumulate stage
             # self-tune batch size under overload.
@@ -475,14 +577,56 @@ class CountBatcher:
                     plan.note_stage("queue_wait", wait)
             try:
                 t0 = time.monotonic()
-                dev = self.engine.count_many_async(
-                    index,
-                    [it.call for it in items],
-                    [it.shards for it in items],
-                )
+                decoders = None
+                weights = None
+                if gkind == "count":
+                    dev = self.engine.count_many_async(
+                        index,
+                        [it.call for it in items],
+                        [it.shards for it in items],
+                    )
+                elif gkind == "fused":
+                    entries = [
+                        (
+                            it.spec
+                            if it.spec is not None
+                            else {"kind": "count", "call": it.call},
+                            it.shards,
+                        )
+                        for it in items
+                    ]
+                    fd = self.engine.fused_many_async(index, entries)
+                    dev = fd.dev
+                    live_items, decoders, weights = [], [], []
+                    for i, it in enumerate(items):
+                        if fd.errors[i] is not None:
+                            it.error = fd.errors[i]
+                            it._resolve()
+                            continue
+                        it.plan_extra = fd.item_notes[i]
+                        live_items.append(it)
+                        decoders.append(fd.decoders[i])
+                        weights.append(fd.weights[i])
+                    items = live_items
+                else:  # solo: one aggregate on its existing per-op program
+                    it0 = items[0]
+                    dev, dec = self.engine.solo_op_async(
+                        index, it0.kind, it0.spec, it0.shards
+                    )
+                    decoders = [dec]
                 t1 = time.monotonic()
                 note = plans_mod.take_dispatch_note()
-                self._stamp_plans(items, note, t1 - t0)
+                if note is None and gkind == "solo":
+                    # The per-op aggregate dispatches publish no note of
+                    # their own; name the lane so the plan still says
+                    # which path ran.
+                    from .fusion import OP_NAMES
+
+                    note = {
+                        "op": OP_NAMES.get(items[0].kind, items[0].kind),
+                        "path": "solo",
+                    }
+                self._stamp_plans(items, note, t1 - t0, weights)
                 self.pipeline.record(
                     "lower_dispatch", t1 - t0,
                     exemplar=next(
@@ -509,7 +653,19 @@ class CountBatcher:
                     self._live -= 1
                 self.pipeline.add_delta("inflight", -1)
                 self._inflight.release()
-                self._handle_batch_failure(index, items, retried, batch_err)
+                self._handle_batch_failure(gkind, index, items, retried, batch_err)
+                continue
+            if not items or (gkind == "solo" and dev is None):
+                # Every fused item failed at build, or the solo op
+                # answered without device work (missing field/stack):
+                # nothing to collect — resolve and free the slot here.
+                for it in items:
+                    it.result = decoders[0](None)
+                    it._resolve()
+                with self._lock:
+                    self._live -= 1
+                self.pipeline.add_delta("inflight", -1)
+                self._inflight.release()
                 continue
             self.batches += 1
             self.batched_queries += len(items)
@@ -532,9 +688,18 @@ class CountBatcher:
                     self.pipeline.gauge_max(
                         "fused_worker_origins_max", len(origins)
                     )
-            self._collect_q.put((dev, items, time.monotonic()))
+            if gkind == "fused":
+                # Heterogeneous whole-program evidence (docs/fusion.md):
+                # this drain lowered to ONE device program across op
+                # kinds (smoke.sh and bench --dashboard-sweep read it).
+                self.pipeline.incr("fused_program_batches")
+                self.pipeline.incr("fused_program_queries", len(items))
+            self._collect_q.put(
+                (dev, items, time.monotonic(), decoders, weights)
+            )
 
-    def _handle_batch_failure(self, index, items: List[_Item], retried, batch_err):
+    def _handle_batch_failure(self, gkind, index, items: List[_Item],
+                              retried, batch_err):
         """One bad tree (unlowerable argument shape, unknown field) must
         not fail its batchmates — but a serial per-item retry would
         stall the pipeline for minutes on a 512-item group (each retry
@@ -553,20 +718,28 @@ class CountBatcher:
         good = []
         for it in items:
             try:
-                from .engine import _Lowering
+                if it.kind == "count":
+                    from .engine import _Lowering
 
-                lw = _Lowering(
-                    self.engine,
-                    self.engine.canonical_shards(it.index),
-                    slot_vector=True,
-                )
-                self.engine._lower(it.index, it.call, lw)
+                    lw = _Lowering(
+                        self.engine,
+                        self.engine.canonical_shards(it.index),
+                        slot_vector=True,
+                    )
+                    self.engine._lower(it.index, it.call, lw)
+                else:
+                    self.engine.probe_fused_item(it.index, it.spec, it.shards)
                 good.append(it)
             except Exception as e:  # noqa: BLE001
                 it.error = e
                 it._resolve()
         if good and len(good) < len(items):
-            self._dispatch_q.put((index, good, True))
+            if gkind == "fused" and len(good) == 1:
+                # A fused group that shrank to one survivor takes the
+                # op's existing lane — never mint a 1-item fused
+                # executable (_plan_drain's invariant holds on retry).
+                gkind = "count" if good[0].kind == "count" else "solo"
+            self._dispatch_q.put((gkind, index, good, True))
         else:
             # Nothing attributable (a dispatch-level failure): fail the
             # whole group with the batch error.
@@ -576,17 +749,28 @@ class CountBatcher:
                 it._resolve()
 
     @staticmethod
-    def _stamp_plans(items: List[_Item], note, lower_seconds: float):
-        """Fan the engine's dispatch note out to every rider's plan
-        (per-rider byte division via plans.rider_note)."""
+    def _stamp_plans(items: List[_Item], note, lower_seconds: float,
+                     weights=None):
+        """Fan the engine's dispatch note out to every rider's plan.
+        Byte tallies divide by each rider's FOOTPRINT share when the
+        fused planner measured one (``weights``) — a 1-mask Count rider
+        no longer pays for an 8-plane Sum neighbor — and evenly
+        otherwise; the planner's per-item extras (op name,
+        mask_shared_with, path) overlay the shared note."""
         if note is None:
             return
         n = len(items)
+        total_w = sum(weights) if weights else 0.0
         staged = set()
-        for it in items:
+        for i, it in enumerate(items):
             if it.plan is None:
                 continue
-            d = plans_mod.rider_note(note, n)
+            frac = (weights[i] / total_w) if total_w else None
+            d = plans_mod.rider_note(note, n, frac=frac)
+            if it.plan_extra is not None:
+                d.update(it.plan_extra)
+                if frac is not None:
+                    d["fused_cost_frac"] = round(frac, 4)
             if it.memo_note is not None:
                 d["memo"], d["memo_reason"] = it.memo_note
             it.plan.note_op(**d)
@@ -606,9 +790,12 @@ class CountBatcher:
             got = self._collect_q.get()
             if got is None:
                 return  # stop() sentinel
-            dev, items, t_dispatched = got
+            dev, items, t_dispatched, decoders, weights = got
             try:
-                out = np.asarray(jax.device_get(dev))
+                if decoders is None:
+                    out = np.asarray(jax.device_get(dev))
+                else:
+                    out = jax.device_get(dev)
                 t_ready = time.monotonic()
                 self.pipeline.record(
                     "device_readback", t_ready - t_dispatched,
@@ -618,20 +805,26 @@ class CountBatcher:
                     ),
                 )
                 for i, it in enumerate(items):
-                    it.result = int(out[i])
+                    it.result = (
+                        int(out[i]) if decoders is None else decoders[i](out)
+                    )
                     # Populate the result memo under the tokens read at
                     # submit time (engine.memo_probe's ordering note).
-                    if it.memo_key is not None:
+                    if it.memo_key is not None and it.kind == "count":
                         self.engine.memo_store(it.memo_key, it.result)
                 t_done = time.monotonic()
                 self.pipeline.record("decode", t_done - t_ready)
                 # Device-cost attribution: the batch held one device
                 # slot for the readback window; each rider is charged
-                # an even share (the tenant ledger sums these into
+                # its FOOTPRINT share when the fused planner measured
+                # one (masks + reduce rows it actually swept, shared
+                # masks split among sharers), an even share otherwise
+                # (the tenant ledger sums these into
                 # pilosa_tenant_device_seconds_total).
-                dev_share = (t_ready - t_dispatched) / max(1, len(items))
+                window = t_ready - t_dispatched
+                total_w = sum(weights) if weights else 0.0
                 staged = set()
-                for it in items:
+                for i, it in enumerate(items):
                     if it.plan is not None:
                         # Wall stages once per distinct plan (shared batch
                         # window); the device-cost SHARE stays per item —
@@ -642,7 +835,11 @@ class CountBatcher:
                                 "device_readback", t_ready - t_dispatched
                             )
                             it.plan.note_stage("decode", t_done - t_ready)
-                        it.plan.note_device_seconds(dev_share)
+                        it.plan.note_device_seconds(
+                            window * weights[i] / total_w
+                            if total_w
+                            else window / max(1, len(items))
+                        )
                     if it.span is not None:
                         it.span.record(
                             "pipeline.device_readback",

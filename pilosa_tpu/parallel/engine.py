@@ -60,6 +60,10 @@ from ..util.stats import (
     METRIC_ENGINE_COMPILE_SECONDS,
     METRIC_ENGINE_EVICTED_BYTES,
     METRIC_ENGINE_EVICTIONS,
+    METRIC_ENGINE_FUSED_MASKS_EVAL,
+    METRIC_ENGINE_FUSED_MASKS_REF,
+    METRIC_ENGINE_FUSED_PROGRAMS,
+    METRIC_ENGINE_FUSED_QUERIES,
     METRIC_ENGINE_REBUILDS,
     METRIC_ENGINE_RESIDENT_BYTES,
     METRIC_INGEST_SYNC_CHUNKS,
@@ -71,6 +75,7 @@ from ..util.stats import (
     METRIC_INGEST_SYNC_DISPATCHES,
     REGISTRY,
 )
+from . import fusion as fusion_mod
 from . import kernels
 from . import sparse as sparse_mod
 from .mesh import SHARD_AXIS, pad_shards, put_global
@@ -744,6 +749,26 @@ class MeshEngine:
         # drained batch evaluate ONCE (_dispatch_count_batch); this
         # counts the collapsed duplicates.
         self.batch_cse_deduped = 0
+        # Whole-program fusion telemetry (docs/fusion.md): heterogeneous
+        # drains dispatched as ONE program, the queries that rode them,
+        # and distinct-masks-materialized vs masks-referenced — the gap
+        # is the mask evaluations fusion saved.
+        self.fused_programs = 0
+        self.fused_program_queries = 0
+        self.fused_masks_evaluated = 0
+        self.fused_masks_referenced = 0
+        self._fused_counters = (
+            REGISTRY.counter(METRIC_ENGINE_FUSED_PROGRAMS),
+            REGISTRY.counter(METRIC_ENGINE_FUSED_QUERIES),
+            REGISTRY.counter(METRIC_ENGINE_FUSED_MASKS_EVAL),
+            REGISTRY.counter(METRIC_ENGINE_FUSED_MASKS_REF),
+        )
+        # Fused-plan cache: dashboards REPEAT, so a drain's whole plan
+        # (lowering, slot graph, operands, decoders) is keyed on its
+        # canonical entry keys and re-dispatched without re-planning;
+        # validity is gated by the same stack version tokens that gate
+        # field-stack reuse (fusion.FusedPlan.stack_tokens).
+        self._fused_plans: "OrderedDict[tuple, object]" = OrderedDict()
         # Engine-local cache hit/miss tallies plus cached process-metric
         # handles (one resolve per engine, per-series locks only on the
         # hot path — never the registry lock).
@@ -1335,6 +1360,16 @@ class MeshEngine:
                 (weakref.ref(stack.matrix), stack.matrix.nbytes)
             )
             self._evictions_counter.inc()
+            # Cached fused plans pin their operand matrices: drop any
+            # plan referencing the evicted stack so its HBM can actually
+            # free (atomic swap; readers re-validate under the dispatch
+            # lock before any reuse).
+            if self._fused_plans:
+                self._fused_plans = OrderedDict(
+                    (k, p)
+                    for k, p in self._fused_plans.items()
+                    if key not in p.stack_tokens
+                )
             if not self._closing_down:
                 index, field, view = key
                 self.journal.append(
@@ -2099,6 +2134,286 @@ class MeshEngine:
             return None
         return self._batcher.pipeline_snapshot()
 
+    # -- whole-program fusion (docs/fusion.md) ------------------------------
+
+    def fused_many_async(self, index: str, entries):
+        """Plan + dispatch a heterogeneous drain — mixed Count/Sum/Min/
+        Max/TopN items that may SHARE Row subtrees — as ONE device
+        program (fusion.build / kernels.fused_tree).  ``entries`` is a
+        list of (spec, shards) where spec carries {"kind": ...} plus the
+        op's arguments; returns a fusion.FusedDispatch whose decoders
+        turn the fetched host result into each op's standard shape.
+        Single-process only: the fused program has no peer-replay
+        collective, so multi-process meshes keep the per-op paths."""
+        if self.multiproc:
+            raise ValueError(
+                "fused whole-program dispatch requires a single-process mesh"
+            )
+        canonical = self.canonical_shards(index)
+        if not canonical:
+            decoders = []
+            for spec, _ in entries:
+                k = spec["kind"]
+                empty = (
+                    0 if k == "count"
+                    else (0, 0) if k in ("sum", "min", "max")
+                    else None if k == "topn"
+                    else []
+                )
+                decoders.append(fusion_mod._Const(empty))
+            n = len(entries)
+            return fusion_mod.FusedDispatch(
+                ((), ()), decoders, [1.0] * n, [None] * n, [None] * n
+            )
+        entries = list(entries)
+        # Canonical order BEFORE keying/building: concurrent arrivals of
+        # the same dashboard interleave nondeterministically, and an
+        # arrival-order cache key would miss on every permutation —
+        # replanning the drain it just planned.  Entries with equal sort
+        # keys are semantically identical items, so the stable sort
+        # keeps the remap below well-defined.
+        n = len(entries)
+        try:
+            keys = [fusion_mod._entry_sort_key(e) for e in entries]
+            order = sorted(range(n), key=lambda i: keys[i])
+        except Exception:  # noqa: BLE001 — unkeyable spec: build as-is
+            keys, order = None, list(range(n))
+        sorted_entries = [entries[i] for i in order]
+        cache_key = (
+            None if keys is None
+            else (index, tuple(keys[i] for i in order))
+        )
+
+        def locked():
+            plan = self._fused_plan_for(index, sorted_entries, cache_key)
+            fd = fusion_mod.dispatch(self, plan)
+            if order == list(range(n)):
+                return fd
+            # Map the plan's sorted-position results back to arrival
+            # order: arrival item i built at sorted position inv[i].
+            inv = [0] * n
+            for pos, i in enumerate(order):
+                inv[i] = pos
+            return fusion_mod.FusedDispatch(
+                fd.dev,
+                [fd.decoders[inv[i]] for i in range(n)],
+                [fd.weights[inv[i]] for i in range(n)],
+                [fd.item_notes[inv[i]] for i in range(n)],
+                [fd.errors[inv[i]] for i in range(n)],
+            )
+
+        return self._locked_dispatch(locked)
+
+    FUSED_PLAN_CACHE = 256
+
+    def _fused_plan_for(self, index: str, entries, key):
+        """A validated (possibly cached) fusion.FusedPlan for this exact
+        (pre-sorted) drain shape.  Runs under the dispatch lock."""
+        if key is None:
+            return fusion_mod.build(self, index, entries)
+        plan = self._fused_plans.get(key)
+        if plan is not None and self._fused_plan_valid(plan):
+            self._cache_hit("fused_plan")
+            self._fused_plans.move_to_end(key)
+            return plan
+        self._cache_miss("fused_plan")
+        plan = fusion_mod.build(self, index, entries)
+        # Near the residency budget, fetching a later stack can evict an
+        # earlier one of THIS build — the _evict() purge runs before the
+        # plan exists, so inserting it would pin evicted HBM for the
+        # plan's cache lifetime.  Only cache plans whose stacks are all
+        # still resident (absent-stack tokens are fine: nothing pinned).
+        with self._stacks_lock:
+            resident = all(
+                absent or skey in self._stacks
+                for skey, (absent, _tok) in plan.stack_tokens.items()
+            )
+        if plan.cacheable and resident:
+            self._fused_plans[key] = plan
+            while len(self._fused_plans) > self.FUSED_PLAN_CACHE:
+                self._fused_plans.popitem(last=False)
+        return plan
+
+    def _fused_plan_valid(self, plan) -> bool:
+        """True when every reuse gate holds: same canonical shard axis,
+        every referenced stack present/absent as before with the same
+        version token.  field_stack() is consulted (not peeked) so a
+        stale stack syncs FIRST — its token then mismatches and the
+        plan rebuilds over the fresh matrices; the cached operands that
+        referenced donated buffers are discarded without being used."""
+        if self.canonical_shards(plan.index) != plan.canonical:
+            return False
+        for (idx, field, view), (absent, tok) in plan.stack_tokens.items():
+            st = self.field_stack(idx, field, view, plan.canonical)
+            if (st is None) != absent:
+                return False
+            if st is not None and st.versions != tok:
+                return False
+        return True
+
+    def fused_many(self, index: str, entries):
+        """Synchronous fused drain: dispatch + one readback, results in
+        entry order (the differential-test / bench convenience)."""
+        try:
+            fd = self.fused_many_async(index, entries)
+        finally:
+            # The async form leaves the dispatch note for its driver
+            # (the batcher) to claim; HERE the caller is the driver and
+            # records no plan — claim it so a later plan-recorded query
+            # on this thread can't inherit stale fused-program fields.
+            plans_mod.take_dispatch_note()
+        host = jax.device_get(fd.dev)
+        out = []
+        for i, dec in enumerate(fd.decoders):
+            if fd.errors[i] is not None:
+                raise fd.errors[i]
+            out.append(dec(host))
+        return out
+
+    def solo_op_async(self, index: str, kind: str, spec: dict, shards):
+        """One aggregate item dispatched through its EXISTING per-op
+        program (sum_tree/minmax_tree/topn_*): the batcher's pipelined
+        path for a drain that fused down to a single item — reuses the
+        already-compiled executable instead of minting a 1-item fused
+        program.  Returns (device result or None, decoder over its
+        device_get), decoder results matching the sync wrappers
+        exactly (fusion decode helpers are shared)."""
+        if kind == "count":
+            dev = self.count_async(index, spec["call"], shards)
+            return dev, lambda host: int(np.asarray(host))
+        if kind == "sum":
+            res = self.sum_async(index, spec["field"], spec.get("filter"), shards)
+            if res is None:
+                return None, fusion_mod._Const((0, 0))
+            dev, depth, bsig = res
+            return dev, fusion_mod._SumDecode(depth, bsig.min)
+        if kind in ("min", "max"):
+            res = self.min_max_async(
+                index, spec["field"], spec.get("filter"), shards, kind == "min"
+            )
+            if res is None:
+                return None, fusion_mod._Const((0, 0))
+            dev, canonical, _depth, bsig = res
+            return dev, fusion_mod._MinMaxDecode(
+                list(canonical), bsig.min, kind == "min"
+            )
+        if kind == "topn":
+            res = self.topn_scores_async(
+                index, spec["field"], spec["rows"], spec["src"], shards
+            )
+            if res is None:
+                return None, fusion_mod._Const(None)
+            dev, present, pos = res
+            return dev, lambda host: fusion_mod.decode_topn_scores(
+                host, present, pos
+            )
+        if kind == "topnf":
+            res = self.topn_full_async(
+                index, spec["field"], spec["src"], shards,
+                spec.get("n") or 0, spec.get("threshold") or 1,
+                spec.get("row_ids"),
+            )
+            if res is None:
+                return None, fusion_mod._Const(fusion_mod.DECLINED)
+            cands, n_out, out = res
+            if out is None:
+                return None, fusion_mod._Const([])
+            return out, lambda host: fusion_mod.decode_topn_full(
+                host, cands, n_out
+            )
+        raise ValueError(f"unknown solo op kind: {kind!r}")
+
+    def solo_op(self, index: str, kind: str, spec: dict, shards):
+        """Blocking single-op dispatch (the batcher's idle direct path)."""
+        if kind == "count":
+            return self.count(index, spec["call"], shards)
+        if kind == "sum":
+            return self.sum(index, spec["field"], spec.get("filter"), shards)
+        if kind in ("min", "max"):
+            return self.min_max(
+                index, spec["field"], spec.get("filter"), shards, kind == "min"
+            )
+        if kind == "topn":
+            return self.topn_scores(
+                index, spec["field"], spec["rows"], spec["src"], shards
+            )
+        if kind == "topnf":
+            out = self.topn_full(
+                index, spec["field"], spec["src"], shards,
+                spec.get("n") or 0, spec.get("threshold") or 1,
+                spec.get("row_ids"),
+            )
+            return fusion_mod.DECLINED if out is None else out
+        raise ValueError(f"unknown solo op kind: {kind!r}")
+
+    def probe_fused_item(self, index: str, spec: dict, shards):
+        """Host-only lowering probe for batch-failure attribution: lower
+        the item's mask tree(s) without dispatching; raises the item's
+        own error if it has one (parallel to the batcher's per-Count
+        lowering probe)."""
+        kind = spec["kind"]
+        if kind == "count":
+            trees = [spec["call"]]
+        elif kind in ("sum", "min", "max"):
+            trees = [spec["filter"]] if spec.get("filter") is not None else []
+        else:
+            trees = [spec["src"]]
+        lw = _Lowering(self, self.canonical_shards(index), slot_vector=True)
+        for t in trees:
+            self._lower(index, t, lw)
+
+    # -- batch-lane aggregate entry points (executor routing) ---------------
+
+    def batched_sum(self, index: str, field: str, filter_call, shards):
+        """BSI Sum through the cross-request batcher: lone callers run
+        the existing blocking program; concurrent callers drain into a
+        fused whole-program dispatch alongside their drain-mates."""
+        if self.multiproc:
+            return self.sum(index, field, filter_call, shards)
+        return self.batcher().submit_op(
+            index, "sum",
+            {"kind": "sum", "field": field, "filter": filter_call}, shards,
+        )
+
+    def batched_min_max(self, index: str, field: str, filter_call, shards,
+                        is_min: bool):
+        if self.multiproc:
+            return self.min_max(index, field, filter_call, shards, is_min)
+        kind = "min" if is_min else "max"
+        return self.batcher().submit_op(
+            index, kind,
+            {"kind": kind, "field": field, "filter": filter_call}, shards,
+        )
+
+    def batched_topn_scores(self, index: str, field: str, candidate_rows,
+                            src_call, shards):
+        if self.multiproc:
+            return self.topn_scores(index, field, candidate_rows, src_call, shards)
+        return self.batcher().submit_op(
+            index, "topn",
+            {"kind": "topn", "field": field, "rows": list(candidate_rows),
+             "src": src_call},
+            shards,
+        )
+
+    def batched_topn_full(self, index: str, field: str, src_call, shards,
+                          n: int, min_threshold: int, row_ids=None):
+        """Fused full TopN through the batcher; returns sorted pairs, or
+        None when the fused path declines (candidate union too large) —
+        the caller falls back to the two-phase composition."""
+        if self.multiproc:
+            return self.topn_full(
+                index, field, src_call, shards, n, min_threshold, row_ids
+            )
+        out = self.batcher().submit_op(
+            index, "topnf",
+            {"kind": "topnf", "field": field, "src": src_call, "n": int(n),
+             "threshold": int(min_threshold),
+             "row_ids": None if not row_ids else list(row_ids)},
+            shards,
+        )
+        return None if out is fusion_mod.DECLINED else out
+
     def count_many(self, index: str, calls, shards_list) -> List[int]:
         """K Count(tree) queries in ONE fused dispatch + ONE readback
         (kernels.count_batch_tree).  ``shards_list[i]`` is query i's
@@ -2388,10 +2703,9 @@ class MeshEngine:
         if res is None:
             return 0, 0
         dev, depth, bsig = res
-        counts, n = jax.device_get(dev)
-        total = sum(int(counts[i]) << i for i in range(depth))
-        n = int(n)
-        return total + n * bsig.min, n
+        # Host assembly shared with the fused/batched lanes — one
+        # implementation, zero drift (fusion.py decode helpers).
+        return fusion_mod.decode_sum(jax.device_get(dev), depth, bsig.min)
 
     def min_max_async(
         self,
@@ -2465,22 +2779,11 @@ class MeshEngine:
         if res is None:
             return 0, 0
         dev, canonical, depth, bsig = res
-        his, los, counts = jax.device_get(dev)
-        # Reduce like ValCount.smaller/larger (executor.go:2652-2696):
-        # strictly-better value wins; ties keep the first shard's count.
-        # The mask zeroed non-requested shards' filters, so their counts
-        # are 0 and they drop out here.
-        best_val, best_n = 0, 0
-        for si in range(len(canonical)):
-            n = int(counts[si])
-            if n == 0:
-                continue
-            val = (int(his[si]) << 31) | int(los[si])
-            if best_n == 0 or (val < best_val if is_min else val > best_val):
-                best_val, best_n = val, n
-        if best_n == 0:
-            return 0, 0
-        return best_val + bsig.min, best_n
+        # ValCount.smaller/larger reduce (executor.go:2652-2696), shared
+        # with the fused/batched lanes (fusion.py decode helpers).
+        return fusion_mod.decode_min_max(
+            jax.device_get(dev), canonical, bsig.min, is_min
+        )
 
     def topn_scores_async(
         self,
@@ -2731,32 +3034,17 @@ class MeshEngine:
         row_ids=None,
     ):
         """Synchronous fused TopN -> sorted (row_id, count) pairs, one
-        tiny readback (int32[n] ids+counts, or int32[K] totals)."""
-        from ..core import cache as cache_mod
-
+        tiny readback (int32[n] ids+counts, or int32[K] totals).  Host
+        decode shared with the batched solo lane (fusion.py)."""
         res = self.topn_full_async(
             index, field, src_call, shards, n, min_threshold, row_ids
         )
         if res is None:
             return None
         cands, n_out, out = res
-        if out is None:
-            return []
-        if n_out is None:
-            totals = np.asarray(jax.device_get(out))
-            pairs = [
-                (cands[k], int(totals[k]))
-                for k in range(len(cands))
-                if totals[k] > 0
-            ]
-            pairs.sort(key=cache_mod.pair_sort_key)
-            return pairs
-        vals, top_idx = jax.device_get(out)
-        return [
-            (cands[int(i)], int(v))
-            for v, i in zip(vals, top_idx)
-            if v > 0 and int(i) < len(cands)
-        ]
+        return fusion_mod.decode_topn_full(
+            None if out is None else jax.device_get(out), cands, n_out
+        )
 
     def topn_cache_only(
         self, index: str, field: str, shards, n, min_threshold, row_ids=None
@@ -2958,6 +3246,7 @@ class MeshEngine:
                 self._bits.clear()
                 self._canonical.clear()
                 self._topn_cands.clear()
+                self._fused_plans.clear()
                 memo_entries = len(self.result_memo)
                 self.result_memo.clear()
                 self._closed = True
@@ -3028,6 +3317,10 @@ class MeshEngine:
             "sparseDispatches": self.sparse_dispatches,
             "deviceBytesSkipped": self.device_bytes_skipped,
             "batchCseDeduped": self.batch_cse_deduped,
+            "fusedPrograms": self.fused_programs,
+            "fusedProgramQueries": self.fused_program_queries,
+            "fusedMasksEvaluated": self.fused_masks_evaluated,
+            "fusedMasksReferenced": self.fused_masks_referenced,
             "ingestSync": (
                 None
                 if self._ingest_syncer is None

@@ -1,0 +1,783 @@
+"""Whole-program query compilation: plan a heterogeneous drain into ONE
+device program (docs/fusion.md).
+
+Batch-CSE (engine._dispatch_count_batch) dedups *identical* Counts and
+the result memo serves *repeats*; this module handles the remaining —
+and, for dashboard traffic, dominant — shape: Count/Sum/Min/Max/TopN
+queries that *share Row sub-expressions* without being identical.  The
+planner canonicalizes every query's Row subtree by text, hash-conses
+shared subtrees into MASK SLOTS (each evaluated once on device), and
+lowers the whole drain to one ``kernels.fused_tree`` dispatch that fans
+each materialized mask into every consuming reduce.
+
+Compile-key discipline (the fixed-tier scheme, generalized): the fused
+executable is keyed on the multiset of (op-kind, mask-slot) edges —
+mask-slot progs carry row ids as traced slot-vector data, the slot list
+and each op kind's edge list pad to pow2 tiers, and lowering follows
+item order deterministically — so two drains with the same sharing
+topology reuse one executable regardless of which rows they ask about.
+
+The sparse block-occupancy planner keeps working per-mask: a Count
+whose tree shares nothing with its drain-mates is probed against the
+engine's occupancy summaries and, when eligible, peels onto the
+block-gather kernels (its own small dispatch riding the same drain);
+shared masks stay in the fused program where materializing once is the
+win.
+
+Decode helpers here are the single source of truth for turning each
+op's device output back into the engine's public result shapes — the
+fused path, the batcher's solo (pipelined single-op) path, and the
+engine's synchronous wrappers must never drift apart, and
+tests/test_fusion.py pins them differentially against the sequential
+oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from ..util import plans as plans_mod
+from . import kernels
+from .mesh import put_global
+
+# Sentinel a decoder returns when the fused path declines an item the
+# caller must re-route (e.g. a TopN whose candidate union exceeds
+# MAX_TOPN_CANDIDATES falls back to the two-phase composition).
+DECLINED = object()
+
+# Op-kind display names for plan records.
+OP_NAMES = {
+    "count": "Count",
+    "sum": "Sum",
+    "min": "Min",
+    "max": "Max",
+    "topn": "TopN",
+    "topnf": "TopN",
+}
+
+def _pow2(n: int) -> int:
+    return max(1, 1 << (max(1, n) - 1).bit_length())
+
+
+def subtree_texts(call, out=None) -> set:
+    """Canonical text of every subtree of a call tree — the sharing key
+    the planner (and the /debug/plans miner) hash-cons masks by."""
+    if out is None:
+        out = set()
+    if call is None:
+        return out
+    out.add(str(call))
+    for ch in call.children:
+        subtree_texts(ch, out)
+    return out
+
+
+def item_texts(spec: dict) -> set:
+    """The subtree texts of one drain item's mask tree(s)."""
+    kind = spec["kind"]
+    if kind == "count":
+        return subtree_texts(spec["call"])
+    if kind in ("sum", "min", "max"):
+        return subtree_texts(spec.get("filter"))
+    return subtree_texts(spec.get("src"))
+
+
+def _entry_sort_key(entry) -> tuple:
+    """Canonical build order: the planner lowers entries in THIS order
+    (not arrival order), so two drains carrying the same multiset of
+    (op-kind, mask) items produce byte-identical fspecs — and reuse one
+    executable — no matter how their queries interleaved on the wire.
+    The compile-key property test pins this."""
+    spec, shards = entry
+    kind = spec["kind"]
+    if kind == "count":
+        t = str(spec["call"])
+    elif kind in ("sum", "min", "max"):
+        t = f"{spec['field']}|{spec.get('filter')}"
+    elif kind == "topn":
+        t = f"{spec['field']}|{spec['src']}|{list(spec.get('rows') or ())}"
+    else:
+        t = (
+            f"{spec['field']}|{spec['src']}|{spec.get('n')}|"
+            f"{spec.get('threshold')}|{spec.get('row_ids')}"
+        )
+    return (kind, t, tuple(shards))
+
+
+# -- decode helpers (shared by fused, solo, and sync paths) ------------------
+
+
+def decode_sum(host, depth: int, base_min: int):
+    """(counts[D], n) device pair -> (total, count), exactly
+    MeshEngine.sum's host assembly."""
+    counts, n = host
+    counts = np.asarray(counts)
+    total = sum(int(counts[i]) << i for i in range(depth))
+    n = int(n)
+    return total + n * base_min, n
+
+
+def decode_min_max(host, canonical, base_min: int, is_min: bool):
+    """(hi[S], lo[S], counts[S]) -> (value, count), exactly
+    MeshEngine.min_max's ValCount reduce."""
+    his, los, counts = host
+    best_val, best_n = 0, 0
+    for si in range(len(canonical)):
+        n = int(counts[si])
+        if n == 0:
+            continue
+        val = (int(his[si]) << 31) | int(los[si])
+        if best_n == 0 or (val < best_val if is_min else val > best_val):
+            best_val, best_n = val, n
+    if best_n == 0:
+        return 0, 0
+    return best_val + base_min, best_n
+
+
+def decode_topn_scores(host, present, pos: dict):
+    """(scores[K, S], src_counts[S]) -> (scores[S, K], src_counts, pos),
+    exactly MeshEngine.topn_scores' host transform."""
+    dev_scores, dev_counts = host
+    scores = np.array(dev_scores).T
+    scores[:, ~present] = 0
+    return scores, dev_counts, pos
+
+
+def decode_topn_full(host, cands, n_out):
+    """The solo fused-TopN readback (device-trimmed or full totals),
+    exactly MeshEngine.topn_full's host decode."""
+    from ..core import cache as cache_mod
+
+    if host is None:
+        return []
+    if n_out is None:
+        totals = np.asarray(host)
+        pairs = [
+            (cands[k], int(totals[k]))
+            for k in range(len(cands))
+            if totals[k] > 0
+        ]
+        pairs.sort(key=cache_mod.pair_sort_key)
+        return pairs
+    vals, top_idx = host
+    return [
+        (cands[int(i)], int(v))
+        for v, i in zip(vals, top_idx)
+        if v > 0 and int(i) < len(cands)
+    ]
+
+
+def decode_topn_full_scores(host, host_cnt, cands, threshold: int, n_out):
+    """Host-side replica of topn_full_tree's gates + trim over a fused
+    per-shard score matrix: gate = (row_count >= thr) & (score >= thr)
+    per (candidate, shard), totals summed over shards, then the same
+    descending-value lowest-index-tie trim jax.lax.top_k applies.  Bit
+    equality with the device-trim path is pinned by test_fusion.py."""
+    from ..core import cache as cache_mod
+
+    scores, _src_counts = host
+    scores = np.asarray(scores).astype(np.int64)
+    thr = max(int(threshold), 1)
+    gate = (host_cnt.T >= thr) & (scores >= thr)
+    totals = np.where(gate, scores, 0).sum(axis=1)
+    if n_out is None:
+        pairs = [
+            (cands[k], int(totals[k]))
+            for k in range(len(cands))
+            if totals[k] > 0
+        ]
+        pairs.sort(key=cache_mod.pair_sort_key)
+        return pairs
+    order = np.argsort(-totals, kind="stable")[: int(n_out)]
+    return [
+        (cands[int(i)], int(totals[int(i)]))
+        for i in order
+        if totals[int(i)] > 0 and int(i) < len(cands)
+    ]
+
+
+# -- the planner -------------------------------------------------------------
+
+
+class FusedDispatch:
+    """One dispatched fused drain: the device result pytree, a per-item
+    decoder over its fetched host twin, per-item device-cost weights
+    (footprint-proportional — the attribution fix for the even split),
+    per-item plan-note extras, and per-item build errors."""
+
+    __slots__ = ("dev", "decoders", "weights", "item_notes", "errors")
+
+    def __init__(self, dev, decoders, weights, item_notes, errors):
+        self.dev = dev
+        self.decoders = decoders
+        self.weights = weights
+        self.item_notes = item_notes
+        self.errors = errors
+
+
+class FusedPlan:
+    """A compiled drain plan, REUSABLE across dispatches: the static
+    fspec + operand list + decoders, plus the stack version tokens that
+    gate reuse.  Dashboards repeat — the same drain shape arrives every
+    refresh tick — so the engine caches plans keyed on the drain's
+    canonical entry keys and re-dispatches without re-lowering, exactly
+    the field-stack/TopN-candidate invalidation discipline: any write
+    to a referenced view bumps its version token and the plan rebuilds
+    (``MeshEngine._fused_plan_for``)."""
+
+    __slots__ = (
+        "index", "fspec", "specs", "operands", "decoders", "weights",
+        "item_notes", "errors", "sparse", "have_fused", "n_items",
+        "fused_riders", "masks_evaluated", "masks_referenced",
+        "bytes_touched", "stack_tokens", "canonical", "cacheable",
+    )
+
+
+def dispatch(engine, plan: FusedPlan) -> FusedDispatch:
+    """Dispatch a (possibly cached) fused plan: peeled sparse masks on
+    the block-gather kernels, the fused program as one kernels.fused_tree
+    call, dispatch-note + counters.  Must run under the engine's
+    dispatch lock (the caller is MeshEngine.fused_many_async)."""
+    extras = []
+    for splan, mask in plan.sparse:
+        extras.append(engine._dispatch_sparse(splan, mask))
+        # The peeled item's note was captured into its item_notes at
+        # build time; drop the fresh TLS note so it can't pollute the
+        # shared batch note below.
+        plans_mod.take_dispatch_note()
+    if plan.have_fused:
+        engine._note_fused_dispatch()
+        fused_out = kernels.fused_tree(
+            engine.mesh, plan.fspec, plan.specs, *plan.operands
+        )
+    else:
+        fused_out = ()
+    plans_mod.note_dispatch(
+        path="fused_program",
+        fused=True,
+        fused_queries=plan.n_items,
+        masks_evaluated=plan.masks_evaluated,
+        masks_referenced=plan.masks_referenced,
+        masks_tier=len(plan.fspec[0]) if plan.have_fused else 0,
+        bytes_touched=plan.bytes_touched,
+    )
+    # Counters record what actually rode a fused program: a drain whose
+    # items all resolved const/peeled/errored dispatched no program and
+    # must not inflate the queries-per-program ratio.
+    if plan.have_fused:
+        engine.fused_programs += 1
+        engine.fused_program_queries += plan.fused_riders
+        engine.fused_masks_evaluated += plan.masks_evaluated
+        engine.fused_masks_referenced += plan.masks_referenced
+        engine._fused_counters[0].inc()
+        if plan.fused_riders:
+            engine._fused_counters[1].inc(plan.fused_riders)
+        if plan.masks_evaluated:
+            engine._fused_counters[2].inc(plan.masks_evaluated)
+        if plan.masks_referenced:
+            engine._fused_counters[3].inc(plan.masks_referenced)
+    return FusedDispatch(
+        (fused_out, tuple(extras)), plan.decoders, plan.weights,
+        plan.item_notes, plan.errors,
+    )
+
+
+def _slot_rows(prog) -> int:
+    """Shard rows a slot's OWN prog sweeps (mrefs cost nothing here —
+    their slots carry their own cost)."""
+    kind = prog[0]
+    if kind in ("row", "rowm"):
+        return 1
+    if kind == "range":
+        pspec = prog[3]
+        return pspec[2] if pspec[0] == "slice" else len(pspec[1])
+    if kind == "between":
+        pspec = prog[2]
+        return pspec[2] if pspec[0] == "slice" else len(pspec[1])
+    if kind in ("zero", "mref", "ones"):
+        return 0
+    return sum(_slot_rows(p) for p in prog[1:])
+
+
+def _slot_refs(prog, out: set):
+    """Slot indices a prog references directly."""
+    if not isinstance(prog, tuple):
+        return out
+    if prog[0] == "mref":
+        out.add(prog[1])
+        return out
+    for p in prog[1:]:
+        if isinstance(p, tuple):
+            _slot_refs(p, out)
+    return out
+
+
+def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
+    """Plan one heterogeneous drain (no dispatch — ``dispatch()`` runs
+    the plan, possibly many times).  ``entries`` is a list of
+    (spec, shards); must run under the engine's dispatch lock (the
+    caller is MeshEngine.fused_many_async)."""
+    from .engine import _Lowering
+
+    canonical = engine.canonical_shards(index)
+    n_items = len(entries)
+    lw = _Lowering(engine, canonical, slot_vector=True)
+
+    slots: list = []          # lowered progs, dependency order
+    slot_of: Dict[str, int] = {}
+    slot_hits: List[int] = []  # textual references per slot
+    refs_total = [0]
+
+    def lower_shared(call):
+        """Hash-consing lowering: every distinct subtree text becomes
+        one mask slot; repeats resolve to ("mref", j).  Combinators
+        recurse through the cache so INNER shared subtrees (the
+        dashboard's segment filter inside N Intersects) share too."""
+        refs_total[0] += 1
+        key = str(call)
+        j = slot_of.get(key)
+        if j is not None:
+            slot_hits[j] += 1
+            return ("mref", j)
+        name = call.name
+        if name in ("Union", "Intersect", "Difference", "Xor") and call.children:
+            op = {
+                "Union": "or",
+                "Intersect": "and",
+                "Difference": "andnot",
+                "Xor": "xor",
+            }[name]
+            prog = (op,) + tuple(lower_shared(ch) for ch in call.children)
+        elif name == "Not" and call.children:
+            from ..core.index import EXISTENCE_FIELD_NAME
+
+            exist = engine._lower_row(index, EXISTENCE_FIELD_NAME, 0, lw)
+            prog = ("andnot", exist, lower_shared(call.children[0]))
+        else:
+            prog = engine._lower(index, call, lw)
+        j = len(slots)
+        slots.append(prog)
+        slot_of[key] = j
+        slot_hits.append(1)
+        return ("mref", j)
+
+    # Pre-compute each item's subtree texts for the peel decision (a
+    # Count sharing nothing may take the occupancy-guided sparse path).
+    # Sharing is decided from a one-pass occurrence map — a pairwise
+    # set-intersection sweep is O(n^2) and this runs under the engine
+    # dispatch lock.
+    texts = [item_texts(spec) for spec, _ in entries]
+    text_items: Dict[str, int] = {}
+    for ts in texts:
+        for t in ts:
+            text_items[t] = text_items.get(t, 0) + 1
+    # Stacks consumed OUTSIDE the fused lowering (the sparse peels use
+    # their own _Lowering): they must join the plan's version-token
+    # gate too, or a write to a peeled Count's field would not be
+    # detected and a cached plan would re-dispatch stale (or donated)
+    # matrices and stale occupancy block lists.
+    peel_stacks: dict = {}
+
+    count_edges: list = []    # (slot, i_mask)
+    agg_edges: list = []      # static edge tuples, build order
+    agg_arity: list = []
+    edge_of: Dict[tuple, tuple] = {}  # dedup key -> ("count"|"agg", idx)
+    sparse: list = []         # peeled (sparse_plan, mask) pairs
+    # Per item: ("count", edge_idx) | ("agg", edge_idx, decode_fn) |
+    # ("extra", idx) | ("const", value) | ("error", exc)
+    routes: list = [None] * n_items
+    top_slot: List[Optional[int]] = [None] * n_items
+    reduce_rows = [0.0] * n_items
+    item_notes: list = [None] * n_items
+    sparse_notes: list = [None] * n_items
+
+    from ..core.view import VIEW_STANDARD, view_bsi_name
+
+    # Canonical build order (compile-key discipline): slot numbering and
+    # edge order follow the sorted entries, never arrival order.
+    order = sorted(range(n_items), key=lambda k: _entry_sort_key(entries[k]))
+    for i in order:
+        spec, shards = entries[i]
+        kind = spec["kind"]
+        try:
+            if kind == "count":
+                call = spec["call"]
+                shared = any(text_items[t] > 1 for t in texts[i])
+                if not shared and engine.sparse_enabled and not engine.multiproc:
+                    # Per-mask sparse planning survives fusion: an
+                    # unshared low-occupancy Count peels onto the
+                    # block-gather kernels instead of paying the fused
+                    # program's dense sweep.
+                    lw1 = _Lowering(engine, canonical)
+                    prog1 = engine._lower(index, call, lw1)
+                    mask1 = engine._mask_words(shards, canonical)
+                    plan = engine._sparse_plan(prog1, lw1, shards, canonical)
+                    peel_stacks.update(lw1._stacks)
+                    if plan is not None:
+                        # Claim the occupancy-probe note for THIS item
+                        # only — the shared batch note must not charge
+                        # batchmates the skipped bytes.  dispatch() adds
+                        # the sparse-path fields the real dispatch notes.
+                        probe_note = plans_mod.take_dispatch_note() or {}
+                        probe_note.update(
+                            path="sparse", fused=True,
+                            bytes_skipped=int(plan[5]),
+                        )
+                        sparse_notes[i] = probe_note
+                        routes[i] = ("extra", len(sparse))
+                        sparse.append((plan, mask1))
+                        # Peeled items ride the drain's readback window
+                        # but sweep only their surviving blocks; a small
+                        # flat footprint keeps their share honest.
+                        reduce_rows[i] = 0.25
+                        continue
+                    plans_mod.take_dispatch_note()  # drop the occupancy probe
+                ref = lower_shared(call)
+                j = ref[1]
+                top_slot[i] = j
+                i_mask = lw.add_mask(engine._mask_words(shards, canonical))
+                ekey = ("count", j, i_mask)
+                hit = edge_of.get(ekey)
+                if hit is None:
+                    hit = edge_of[ekey] = ("count", len(count_edges))
+                    count_edges.append((j, i_mask))
+                routes[i] = hit
+            elif kind in ("sum", "min", "max"):
+                field = spec["field"]
+                filter_call = spec.get("filter")
+                idx_obj = engine.holder.index(index)
+                f = idx_obj.field(field) if idx_obj is not None else None
+                bsig = f.bsi_group(field) if f is not None else None
+                stack = (
+                    lw.stack_for(index, field, view_bsi_name(field))
+                    if bsig is not None
+                    else None
+                )
+                if bsig is None or stack is None:
+                    routes[i] = ("const", (0, 0))
+                    continue
+                depth = bsig.bit_depth()
+                if filter_call is None:
+                    ms = -1
+                else:
+                    ms = lower_shared(filter_call)[1]
+                    top_slot[i] = ms
+                i_mask = lw.add_mask(engine._mask_words(shards, canonical))
+                i_pm = lw.add_matrix(stack.matrix)
+                pspec = engine._plane_spec(stack, depth)
+                if kind == "sum":
+                    edge = ("sum", ms, i_mask, i_pm, pspec)
+                    dec = _SumDecode(depth, bsig.min)
+                else:
+                    edge = ("minmax", ms, i_mask, i_pm, pspec, kind == "min")
+                    dec = _MinMaxDecode(
+                        list(canonical), bsig.min, kind == "min"
+                    )
+                ekey = edge + (field,)
+                hit = edge_of.get(ekey)
+                if hit is None:
+                    hit = edge_of[ekey] = (
+                        "agg", len(agg_edges), dec
+                    )
+                    agg_edges.append(edge)
+                    agg_arity.append(2 if kind == "sum" else 3)
+                routes[i] = hit
+                reduce_rows[i] = depth + 1
+            elif kind in ("topn", "topnf"):
+                field = spec["field"]
+                src = spec["src"]
+                stack = lw.stack_for(index, field, VIEW_STANDARD)
+                if stack is None:
+                    routes[i] = (
+                        ("const", None) if kind == "topn" else ("const", [])
+                    )
+                    continue
+                if kind == "topn":
+                    rows = list(spec["rows"])
+                    present = np.asarray(
+                        [r in stack.row_index for r in rows], dtype=bool
+                    )
+                    K_pad = _pow2(len(rows)) if rows else 1
+                    idx_np = np.asarray(
+                        [stack.row_index.get(r, 0) for r in rows]
+                        + [0] * (K_pad - len(rows)),
+                        dtype=np.int32,
+                    )
+                    dec = _TopNScoresDecode(
+                        len(rows), present, dict(stack.pos)
+                    )
+                    dedup_rows = tuple(rows)
+                    n_out = thr = None
+                else:
+                    row_ids = spec.get("row_ids")
+                    entry = engine._topn_candidates(
+                        index, field, stack, row_ids
+                    )
+                    if not entry.cands:
+                        routes[i] = ("const", [])
+                        continue
+                    if len(entry.cands) > engine.MAX_TOPN_CANDIDATES:
+                        routes[i] = ("const", DECLINED)
+                        continue
+                    K_pad = entry.host_cnt.shape[1]
+                    idx_np = np.asarray(
+                        [stack.row_index.get(r, 0) for r in entry.cands]
+                        + [0] * (K_pad - len(entry.cands)),
+                        dtype=np.int32,
+                    )
+                    n = int(spec.get("n") or 0)
+                    n_out = min(n, K_pad) if n and not row_ids else None
+                    thr = max(int(spec.get("threshold") or 1), 1)
+                    dec = _TopNFullDecode(
+                        entry.host_cnt, list(entry.cands), thr, n_out
+                    )
+                    dedup_rows = tuple(entry.cands)
+                ms = lower_shared(src)[1]
+                top_slot[i] = ms
+                i_mask = lw.add_mask(engine._mask_words(shards, canonical))
+                i_cm = lw.add_matrix(stack.matrix)
+                ekey = (kind, ms, i_mask, i_cm, field, dedup_rows, n_out, thr)
+                hit = edge_of.get(ekey)
+                if hit is None:
+                    i_ix = lw.add_replicated(
+                        put_global(engine.mesh, idx_np, P())
+                    )
+                    edge = ("topn", ms, i_mask, i_cm, i_ix)
+                    hit = edge_of[ekey] = ("agg", len(agg_edges), dec)
+                    agg_edges.append(edge)
+                    agg_arity.append(2)
+                routes[i] = hit
+                reduce_rows[i] = K_pad
+            else:
+                raise ValueError(f"unknown fused item kind: {kind!r}")
+        except Exception as e:  # noqa: BLE001 — one bad item must not
+            routes[i] = ("error", e)  # fail its drain-mates
+    lw.finish()
+
+    # -- sharing accounting + footprint weights -----------------------------
+    reach_cache: Dict[int, frozenset] = {}
+
+    def reachable(j: int) -> frozenset:
+        got = reach_cache.get(j)
+        if got is None:
+            acc = {j}
+            for r in _slot_refs(slots[j], set()):
+                acc |= reachable(r)
+            got = reach_cache[j] = frozenset(acc)
+        return got
+
+    sharers: Dict[int, int] = {}
+    item_reach: List[frozenset] = []
+    for i in range(n_items):
+        r = reachable(top_slot[i]) if top_slot[i] is not None else frozenset()
+        item_reach.append(r)
+        for j in r:
+            sharers[j] = sharers.get(j, 0) + 1
+    weights = []
+    for i in range(n_items):
+        w = reduce_rows[i]
+        for j in item_reach[i]:
+            w += _slot_rows(slots[j]) / sharers[j]
+        weights.append(max(w, 0.25))
+
+    masks_evaluated = len(slots)
+    masks_referenced = refs_total[0]
+    for i in range(n_items):
+        if routes[i] is None or routes[i][0] == "error":
+            continue
+        shared_with = (
+            sharers.get(top_slot[i], 1) - 1 if top_slot[i] is not None else 0
+        )
+        note = {
+            "op": OP_NAMES[entries[i][0]["kind"]],
+            "path": "fused_program",
+            "mask_shared_with": shared_with,
+        }
+        if sparse_notes[i] is not None:
+            note.update(sparse_notes[i])
+            note["op"] = "Count"
+            note["path"] = "sparse"
+        item_notes[i] = note
+
+    # -- tier padding (compile-key discipline) ------------------------------
+    M = len(slots)
+    if slots:
+        slots = slots + [slots[0]] * (_pow2(M) - M)
+    n_count = len(count_edges)
+    if count_edges:
+        count_edges = count_edges + [count_edges[0]] * (
+            _pow2(n_count) - n_count
+        )
+    padded_aggs = list(agg_edges)
+    for k in ("sum", "minmax", "topn"):
+        kind_edges = [e for e in agg_edges if e[0] == k]
+        if kind_edges:
+            padded_aggs.extend(
+                [kind_edges[0]] * (_pow2(len(kind_edges)) - len(kind_edges))
+            )
+
+    # -- plan assembly ------------------------------------------------------
+    # Output positions: counts vector first (when present), then each
+    # REAL aggregate edge's components in build order (padding appended
+    # after, so real positions are stable).
+    base = 1 if count_edges else 0
+    agg_pos = []
+    off = base
+    for a in agg_arity:
+        agg_pos.append(off)
+        off += a
+
+    decoders: list = [None] * n_items
+    errors: list = [None] * n_items
+    for i in range(n_items):
+        r = routes[i]
+        if r is None:
+            errors[i] = RuntimeError("fused planner produced no route")
+            continue
+        tag = r[0]
+        if tag == "error":
+            errors[i] = r[1]
+        elif tag == "const":
+            decoders[i] = _Const(r[1])
+        elif tag == "extra":
+            decoders[i] = _Extra(r[1])
+        elif tag == "count":
+            decoders[i] = _Count(r[1])
+        else:  # ("agg", edge_idx, decode_fn)
+            decoders[i] = _Agg(agg_pos[r[1]], agg_arity[r[1]], r[2])
+
+    plan = FusedPlan()
+    plan.index = index
+    plan.have_fused = bool(count_edges or agg_edges)
+    plan.fspec = (tuple(slots), tuple(count_edges), tuple(padded_aggs))
+    plan.specs = tuple(lw.specs)
+    plan.operands = list(lw.operands)
+    plan.decoders = decoders
+    plan.weights = weights
+    plan.item_notes = item_notes
+    plan.errors = errors
+    plan.sparse = sparse
+    plan.n_items = n_items
+    plan.fused_riders = sum(
+        1 for r in routes if r is not None and r[0] in ("count", "agg")
+    )
+    plan.masks_evaluated = masks_evaluated
+    plan.masks_referenced = masks_referenced
+    plan.bytes_touched = sum(
+        int(getattr(op, "nbytes", 0)) for op in lw.operands
+    )
+    # Reuse gates: the canonical shard axis and every referenced
+    # stack's version token (the field-stack invalidation discipline —
+    # any write to a referenced view re-keys its stack and fails the
+    # probe, so a cached plan can never serve stale operands).
+    plan.canonical = list(canonical)
+    plan.stack_tokens = {
+        key: (st is None, None if st is None else st.versions)
+        for key, st in {**peel_stacks, **lw._stacks}.items()
+    }
+    plan.cacheable = not any(errors)
+    return plan
+
+
+# Decoder objects (closures would capture loop vars; these are explicit
+# and picklable-ish for debugging).
+
+
+class _Const:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __call__(self, host):
+        return self.v
+
+
+class _Extra:
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __call__(self, host):
+        return int(np.asarray(host[1][self.i]))
+
+
+class _Count:
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __call__(self, host):
+        return int(np.asarray(host[0][0])[self.i])
+
+
+class _Agg:
+    __slots__ = ("pos", "arity", "dec")
+
+    def __init__(self, pos, arity, dec):
+        self.pos = pos
+        self.arity = arity
+        self.dec = dec
+
+    def __call__(self, host):
+        parts = host[0][self.pos : self.pos + self.arity]
+        return self.dec(parts)
+
+
+class _SumDecode:
+    __slots__ = ("depth", "base_min")
+
+    def __init__(self, depth, base_min):
+        self.depth = depth
+        self.base_min = base_min
+
+    def __call__(self, parts):
+        return decode_sum(parts, self.depth, self.base_min)
+
+
+class _MinMaxDecode:
+    __slots__ = ("canonical", "base_min", "is_min")
+
+    def __init__(self, canonical, base_min, is_min):
+        self.canonical = canonical
+        self.base_min = base_min
+        self.is_min = is_min
+
+    def __call__(self, parts):
+        return decode_min_max(parts, self.canonical, self.base_min, self.is_min)
+
+
+class _TopNScoresDecode:
+    __slots__ = ("k", "present", "pos")
+
+    def __init__(self, k, present, pos):
+        self.k = k
+        self.present = present
+        self.pos = pos
+
+    def __call__(self, parts):
+        scores, counts = parts
+        # Trim the pow2 candidate padding before the standard transform.
+        scores = np.asarray(scores)[: max(self.k, 0)]
+        return decode_topn_scores((scores, counts), self.present, self.pos)
+
+
+class _TopNFullDecode:
+    __slots__ = ("host_cnt", "cands", "thr", "n_out")
+
+    def __init__(self, host_cnt, cands, thr, n_out):
+        self.host_cnt = host_cnt
+        self.cands = cands
+        self.thr = thr
+        self.n_out = n_out
+
+    def __call__(self, parts):
+        return decode_topn_full_scores(
+            parts, self.host_cnt, self.cands, self.thr, self.n_out
+        )

@@ -61,9 +61,16 @@ def gather_planes(mat, pspec):
     return jnp.stack(planes, axis=0)
 
 
-def apply_prog(prog, operands):
-    """Evaluate a lowered bitmap tree over the local shard block."""
+def apply_prog(prog, operands, slots=None):
+    """Evaluate a lowered bitmap tree over the local shard block.
+
+    ``slots`` is the fused whole-program mask-slot table (fused_tree):
+    a ``("mref", j)`` leaf reads the already-evaluated value of mask
+    slot j, which is how a Row subtree shared by several queries of one
+    fused program is materialized exactly once."""
     kind = prog[0]
+    if kind == "mref":
+        return slots[prog[1]]
     if kind == "zero":
         return operands[prog[1]][0]
     if kind == "row":
@@ -102,7 +109,7 @@ def apply_prog(prog, operands):
         planes = gather_planes(operands[i_mat], pspec)
         lo, hi = operands[i_lo], operands[i_hi]
         return jax.vmap(lambda p: bsi_ops.range_between(p, lo, hi), in_axes=1)(planes)
-    subs = [apply_prog(p, operands) for p in prog[1:]]
+    subs = [apply_prog(p, operands, slots) for p in prog[1:]]
     out = subs[0]
     for s in subs[1:]:
         if kind == "or":
@@ -439,6 +446,112 @@ def minmax_tree(mesh, prog, specs, pspec, is_min, mask, plane_mat, *operands):
         in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS)) + specs,
         out_specs=(P(), P(), P()),
     )(mask, plane_mat, *operands)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def fused_tree(mesh, fspec, specs, *operands):
+    """Whole-program heterogeneous drain: N queries of mixed op kinds in
+    ONE dispatch, with every distinct Row subtree materialized exactly
+    once (docs/fusion.md).  The device-side generalization of the
+    reference's per-shard map + mapReduce tree (executor.go:2183): where
+    count_batch_tree fuses K Counts of one structure, this fuses an
+    entire dashboard — Count/Sum/Min/Max/TopN reduces that SHARE filter
+    masks — into one program.
+
+    ``fspec`` is the static plan (engine/fusion.py build):
+
+      (mask_slots, count_edges, agg_edges)
+
+    * ``mask_slots``: tuple of lowered progs in dependency order; slot j
+      may reference earlier slots via ``("mref", i)`` leaves (the
+      hash-cons seam — apply_prog reads the slot table).  Each slot is
+      evaluated ONCE into ``uint32[S, W]`` no matter how many queries
+      (or other slots) reference it; XLA dead-codes padded duplicates.
+    * ``count_edges``: tuple of ``(slot, i_mask)`` — per-edge masked
+      popcount, stacked and reduced in ONE psum (int32[n_counts]).
+    * ``agg_edges``: tuple of per-edge static descriptors consuming a
+      slot (or the bare shard mask when slot < 0, the ("ones",) filter):
+        ("sum",    slot, i_mask, i_planes, pspec)       -> counts[D], n
+        ("minmax", slot, i_mask, i_planes, pspec, min)  -> hi[S], lo[S], n[S]
+        ("topn",   slot, i_mask, i_cands, i_idxs)       -> scores[K,S], src[S]
+      Each edge body is the corresponding single-op kernel's body
+      verbatim (sum_tree / minmax_tree / topn_tree) with the evaluated
+      slot as its filter row — bit-exactness vs the solo programs is by
+      construction, and tests/test_fusion.py pins it differentially.
+
+    Outputs are a flat tuple, replicated: the count vector first (when
+    any count edges exist), then each aggregate edge's components in
+    edge order.  The compile key is (mesh, fspec, specs) — mask slots
+    and per-kind edge lists are padded to pow2 tiers by the planner and
+    row ids ride the traced slot vector, so a drain of the same
+    (op-kind, mask-slot) multiset reuses one executable regardless of
+    which rows it asks about."""
+    mask_slots, count_edges, agg_edges = fspec
+    n_dev = mesh.shape[SHARD_AXIS]
+
+    def body(*ops):
+        slot_vals = []
+        for sp in mask_slots:
+            slot_vals.append(apply_prog(sp, ops, slot_vals))
+
+        def masked(slot, i_mask):
+            if slot < 0:
+                return ops[i_mask]  # ("ones",): the bare shard mask
+            return jnp.bitwise_and(slot_vals[slot], ops[i_mask])
+
+        outs = []
+        if count_edges:
+            cs = [
+                jnp.sum(_pc(masked(slot, i_mask)))
+                for slot, i_mask in count_edges
+            ]
+            outs.append(jax.lax.psum(jnp.stack(cs), SHARD_AXIS))
+        for e in agg_edges:
+            kind = e[0]
+            if kind == "sum":
+                _, slot, i_mask, i_pm, pspec = e
+                f = masked(slot, i_mask)
+                p = gather_planes(ops[i_pm], pspec)
+                consider = jnp.bitwise_and(p[-1], f)
+                depth = p.shape[0] - 1
+                ops_list = [_pc(p[i] & consider) for i in range(depth)]
+                ops_list.append(_pc(consider))
+                sums = _sum_many(ops_list, (0, 1))
+                counts = (
+                    jnp.stack(sums[:depth])
+                    if depth
+                    else jnp.zeros(0, jnp.int32)
+                )
+                outs.append(jax.lax.psum(counts, SHARD_AXIS))
+                outs.append(jax.lax.psum(sums[depth], SHARD_AXIS))
+            elif kind == "minmax":
+                _, slot, i_mask, i_pm, pspec, is_min = e
+                f = masked(slot, i_mask)
+                p = gather_planes(ops[i_pm], pspec)
+                fb = jnp.broadcast_to(f, p.shape[1:])
+                hi, lo, counts = bsi_ops.minmax_valcount_nd(p, fb, is_min)
+                outs.append(replicate_shards(hi, n_dev, axis=0))
+                outs.append(replicate_shards(lo, n_dev, axis=0))
+                outs.append(replicate_shards(counts, n_dev, axis=0))
+            elif kind == "topn":
+                _, slot, i_mask, i_cm, i_ix = e
+                src = masked(slot, i_mask)
+                cands = jnp.take(ops[i_cm], ops[i_ix], axis=0)
+                srcb = jnp.broadcast_to(src, cands.shape[1:])
+                scores = score_rows(cands, srcb)
+                counts = jnp.sum(_pc(srcb), axis=-1)
+                outs.append(replicate_shards(scores, n_dev, axis=1))
+                outs.append(replicate_shards(counts, n_dev, axis=0))
+            else:
+                raise ValueError(f"bad fused edge {kind}")
+        return tuple(outs)
+
+    n_out = (1 if count_edges else 0)
+    for e in agg_edges:
+        n_out += {"sum": 2, "minmax": 3, "topn": 2}[e[0]]
+    return shard_map(
+        body, mesh=mesh, in_specs=specs, out_specs=(P(),) * n_out
+    )(*operands)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))

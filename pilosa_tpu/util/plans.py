@@ -122,18 +122,23 @@ def take_dispatch_note() -> Optional[dict]:
     return d
 
 
-def rider_note(note: dict, riders: int) -> dict:
+def rider_note(note: dict, riders: int, frac: Optional[float] = None) -> dict:
     """A dispatch note copied for ONE of ``riders`` co-dispatched
-    queries: batch-level byte tallies are divided evenly — K queries
-    shared one sweep, so each is charged its K'th — while decision
-    fields (path, CSE, tier, occupancy) are copied whole.  The single
-    point of change for per-rider-divided note fields (the batcher's
-    fused batch and the executor's consecutive-Count batch both fan
-    notes out through here)."""
+    queries: batch-level byte tallies are divided — by the rider's
+    measured footprint fraction ``frac`` when the fused planner supplied
+    one (a 1-mask Count rider must not be charged for an 8-plane Sum
+    neighbor's sweep), evenly otherwise — while decision fields (path,
+    CSE, tier, occupancy) are copied whole.  The single point of change
+    for per-rider-divided note fields (the batcher's fused batch and
+    the executor's consecutive-Count batch both fan notes out through
+    here)."""
     d = dict(note)
     for k in ("bytes_touched", "bytes_skipped"):
         if k in d:
-            d[k] = int(d[k]) // max(1, riders)
+            if frac is not None:
+                d[k] = int(int(d[k]) * frac)
+            else:
+                d[k] = int(d[k]) // max(1, riders)
     return d
 
 
@@ -299,6 +304,20 @@ def analyze(plan: QueryPlan, slow: bool = False) -> List[str]:
                 f"batch CSE: {op['cse_deduped']} duplicate(s) collapsed "
                 f"into {op.get('cse_unique', '?')} slot(s)"
             )
+        if path == "fused_program":
+            shared = int(op.get("mask_shared_with", 0) or 0)
+            if shared:
+                notes.append(
+                    f"fused program: mask shared with {shared} other "
+                    f"quer{'y' if shared == 1 else 'ies'}"
+                )
+            me = int(op.get("masks_evaluated", 0) or 0)
+            mr = int(op.get("masks_referenced", 0) or 0)
+            if mr > me > 0:
+                notes.append(
+                    f"fusion: {mr} mask references evaluated as {me} "
+                    f"distinct masks ({mr - me} evaluation(s) saved)"
+                )
     if plan.fanouts:
         n_remote = sum(k for _, _, k in plan.fanouts)
         n_local = 0

@@ -354,6 +354,24 @@ METRIC_FRAGMENT_OP = "pilosa_fragment_op_seconds"
 METRIC_ENGINE_CACHE_HITS = "pilosa_engine_cache_hits_total"
 METRIC_ENGINE_CACHE_MISSES = "pilosa_engine_cache_misses_total"
 METRIC_DEVICE_BYTES_SKIPPED = "pilosa_device_bytes_skipped_total"
+# -- whole-program fusion (docs/fusion.md) ----------------------------------
+#   pilosa_engine_fused_program_programs_total   fused heterogeneous drains
+#                                                dispatched as ONE program
+#   pilosa_engine_fused_program_queries_total    queries that rode them
+#   pilosa_engine_fused_program_masks_evaluated_total  distinct Row subtrees
+#                                                materialized (mask slots)
+#   pilosa_engine_fused_program_masks_referenced_total subtree references the
+#                                                drain asked for; the gap to
+#                                                masks_evaluated is the
+#                                                evaluations fusion saved
+METRIC_ENGINE_FUSED_PROGRAMS = "pilosa_engine_fused_program_programs_total"
+METRIC_ENGINE_FUSED_QUERIES = "pilosa_engine_fused_program_queries_total"
+METRIC_ENGINE_FUSED_MASKS_EVAL = (
+    "pilosa_engine_fused_program_masks_evaluated_total"
+)
+METRIC_ENGINE_FUSED_MASKS_REF = (
+    "pilosa_engine_fused_program_masks_referenced_total"
+)
 # -- cluster & device observability (docs/observability.md) -----------------
 #   pilosa_engine_resident_bytes            gauge: HBM held by resident stacks
 #   pilosa_engine_evicted_bytes             gauge: evicted-but-still-live
@@ -509,7 +527,7 @@ SERVER_REQUEST_PATHS = ("inline", "pool", "shed")
 # resolves one handle pair per name at construction).
 ENGINE_CACHES = (
     "stack", "mask", "zeros", "scalar", "canonical", "result_memo",
-    "batch_cse",
+    "batch_cse", "fused_plan",
 )
 
 # Pre-register the always-on surface so /metrics exposes every required
@@ -534,6 +552,22 @@ for _cache in ENGINE_CACHES:
 REGISTRY.counter(
     METRIC_DEVICE_BYTES_SKIPPED,
     help="Device HBM bytes skipped by occupancy-guided sparse dispatches",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_FUSED_PROGRAMS,
+    help="Heterogeneous drains compiled+dispatched as one fused program",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_FUSED_QUERIES,
+    help="Queries that rode a fused whole-program dispatch",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_FUSED_MASKS_EVAL,
+    help="Distinct Row-subtree masks materialized inside fused programs",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_FUSED_MASKS_REF,
+    help="Row-subtree mask references fused programs were asked for",
 )
 REGISTRY.set_gauge(METRIC_ENGINE_RESIDENT_BYTES, 0)
 REGISTRY.set_gauge(METRIC_ENGINE_EVICTED_BYTES, 0)

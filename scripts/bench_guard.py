@@ -96,6 +96,15 @@ AUTO_REQUIRE = (
     # baselined so a later PR cannot silently drop the chaos lane.
     "availability_under_failure_pct",
     "replica_read_qps_gain",
+    # Whole-program fusion headlines (bench.py --dashboard-sweep,
+    # docs/fusion.md): widget answers/second through the fused N=8
+    # mixed drain, its drain-wall p50, and the fused-vs-sequential
+    # speedup (ABS_FLOORed below — the ISSUE's >=1.5x acceptance is a
+    # standing contract, not a baseline diff).  Required once baselined
+    # so the dashboard lane cannot be silently dropped.
+    "dashboard_fused_qps",
+    "dashboard_p50_ms",
+    "dashboard_fused_speedup",
 )
 
 # Direction overrides for metrics whose UNIT would mislead: the unit
@@ -105,6 +114,7 @@ AUTO_REQUIRE = (
 NAME_HIGHER_BETTER = {
     "availability_under_failure_pct",
     "replica_read_qps_gain",
+    "dashboard_fused_speedup",
 }
 
 # Built-in per-metric tolerance (used when no --metric-tolerance names
@@ -117,6 +127,9 @@ DEFAULT_METRIC_TOL = {
     # wobbles far more than either numerator; the availability floor
     # below is the binding chaos contract.
     "replica_read_qps_gain": 0.5,
+    # Same shape: fused/sequential wall ratio on shared vCPUs; the 1.5x
+    # ABS_FLOOR below is the binding fusion contract.
+    "dashboard_fused_speedup": 0.5,
 }
 
 # Absolute ceilings enforced regardless of the baseline value: crossing
@@ -125,8 +138,13 @@ ABS_CEILING = {"profile_overhead_pct": 2.0}
 
 # Absolute floors, the ceiling's dual: availability under failure below
 # this is a failure no matter what the baseline recorded (with replica
-# hedging, reads through a replica kill must stay near-continuous).
-ABS_FLOOR = {"availability_under_failure_pct": 90.0}
+# hedging, reads through a replica kill must stay near-continuous), and
+# the fused N=8 dashboard drain must beat the sequential per-query path
+# by >=1.5x (the whole-program fusion acceptance, docs/fusion.md).
+ABS_FLOOR = {
+    "availability_under_failure_pct": 90.0,
+    "dashboard_fused_speedup": 1.5,
+}
 
 
 def parse_jsonl(text: str) -> dict:

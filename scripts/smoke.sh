@@ -9,7 +9,7 @@
 #   sh scripts/smoke.sh tests/     # full non-slow suite, same flags
 set -e
 cd "$(dirname "$0")/.."
-TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_http.py tests/test_asyncserver.py tests/test_procserver.py tests/test_observability.py tests/test_plans.py}"
+TARGETS="${*:-tests/test_pipeline.py tests/test_batch.py tests/test_fusion.py tests/test_http.py tests/test_asyncserver.py tests/test_procserver.py tests/test_observability.py tests/test_plans.py}"
 env JAX_PLATFORMS=cpu python -m pytest $TARGETS -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
@@ -82,6 +82,11 @@ required = [
     "pilosa_replica_reads_total",
     "pilosa_ingest_degraded_batches_total",
     "pilosa_client_retries_total",
+    # Whole-program fusion (docs/fusion.md).
+    "pilosa_engine_fused_program_programs_total",
+    "pilosa_engine_fused_program_queries_total",
+    "pilosa_engine_fused_program_masks_evaluated_total",
+    "pilosa_engine_fused_program_masks_referenced_total",
 ]
 missing = [s for s in required if s not in text]
 assert not missing, f"/metrics is missing required series: {missing}"
@@ -480,6 +485,84 @@ text = urllib.request.urlopen(
 assert "trace_id=" not in text and "# EOF" not in text, (
     "classic Prometheus exposition leaked OpenMetrics syntax"
 )
+
+# Whole-program fusion smoke (docs/fusion.md): a mixed Count/Sum drain
+# through the real batcher fuses into ONE device program — the
+# pilosa_engine_fused_program_* counters move and the recorded plan ops
+# show maskReuse (shared-mask references > distinct masks evaluated).
+eng.max_resident_bytes = 8 << 30  # undo the eviction drill's squeeze
+from pilosa_tpu import pql as _pql
+from pilosa_tpu.core.field import FieldOptions as _FO
+from pilosa_tpu.util import plans as _plans
+
+_vf = idx.create_field("vv", _FO(type="int", min=0, max=50))
+_vf.import_values([0, 5, 9], [3, 4, 5])
+_shards = sorted(idx.available_shards())
+_b = eng.batcher()
+_seg = _pql.parse("Row(f=1)").calls[0]
+fused_op = None
+for _attempt in range(8):
+    # A fresh row id per attempt: a repeat would memo-hit at submit and
+    # never enter the drain (the memo lane working as designed).
+    _mix_count = _pql.parse(
+        f"Intersect(Row(f=1), Row(f={80 + _attempt}))"
+    ).calls[0]
+    _b._last_fused = time.monotonic() + 10_000  # every submit queues
+    _plan_objs = [
+        _plans.QueryPlan("smoke", "mix-count"),
+        _plans.QueryPlan("smoke", "mix-sum"),
+    ]
+    _res = {}
+
+    def _run_mix_count():
+        with _plans.attach(_plan_objs[0]):
+            _res["count"] = _b.submit("smoke", _mix_count, _shards)
+
+    def _run_mix_sum():
+        with _plans.attach(_plan_objs[1]):
+            _res["sum"] = eng.batched_sum("smoke", "vv", _seg, _shards)
+
+    _ts = [
+        threading.Thread(target=_run_mix_count),
+        threading.Thread(target=_run_mix_sum),
+    ]
+    for _t in _ts:
+        _t.start()
+    for _t in _ts:
+        _t.join(60)
+    assert _res["sum"] == (12, 3), _res
+    assert _res["count"] == 0, _res
+    fused_op = next(
+        (
+            op
+            for p in _plan_objs
+            for op in p.ops
+            if op.get("path") == "fused_program"
+            and op.get("masks_referenced", 0) > op.get("masks_evaluated", 0)
+        ),
+        None,
+    )
+    if fused_op is not None:
+        break  # the two submissions landed in one drain
+assert fused_op is not None, (
+    "mixed drain never fused with mask reuse", [p.ops for p in _plan_objs]
+)
+assert fused_op["masks_evaluated"] >= 3, fused_op
+text = urllib.request.urlopen(
+    f"http://localhost:{port}/metrics", timeout=30
+).read().decode()
+fusion_counts = {}
+for line in text.splitlines():
+    if line.startswith("pilosa_engine_fused_program_"):
+        name, _, value = line.rpartition(" ")
+        fusion_counts[name] = float(value)
+assert fusion_counts.get("pilosa_engine_fused_program_programs_total", 0) >= 1, fusion_counts
+assert fusion_counts.get("pilosa_engine_fused_program_queries_total", 0) >= 2, fusion_counts
+assert fusion_counts.get(
+    "pilosa_engine_fused_program_masks_referenced_total", 0
+) > fusion_counts.get(
+    "pilosa_engine_fused_program_masks_evaluated_total", 0
+), ("fused drain recorded no mask reuse", fusion_counts)
 
 srv.shutdown()
 
