@@ -1952,18 +1952,26 @@ STREAM_QUERY_PACE_S = 0.005  # ~200 QPS read load: an unthrottled
 #                              measures GIL spin, not serving behavior
 
 
-def chaos_sweep():
+def chaos_sweep(fault="kill"):
     """Serving-through-failure bench (docs/durability.md): a REAL
     3-process gossip cluster at replicas=2 / ack=logged.  Phase A
     (healthy) measures closed-loop Count QPS through the coordinator
     under primary-mode vs any-mode replica reads — the read-scaling
     ratio replicaN>1 buys (``replica_read_qps_gain``; ~1.0 on a single
     shared-CPU host, the real separation needs multi-host).  Phase B
-    SIGKILLs a replica mid-load and measures the fraction of queries
-    that still answered across the kill + detection + degraded window
-    (``availability_under_failure_pct`` — with hedging this stays near
-    100).  Both are bench_guard AUTO_REQUIREd once baselined, with an
-    absolute 90% availability floor."""
+    fails a replica mid-load — SIGKILL (``fault="kill"``, the default)
+    or a deterministic network partition injected through POST
+    /debug/faults (``--fault partition``) — and measures the fraction
+    of queries that still answered across the failure + detection +
+    degraded window (``availability_under_failure_pct``), then the
+    fraction of DESTRUCTIVE writes (Clears on shards the dead node
+    owns) that ack through the degraded steady state
+    (``destructive_write_availability_pct`` — 0 before hinted handoff,
+    100 with it).  Partition mode additionally HEALS the cut and emits
+    ``partition_heal_seconds`` (heal -> cluster NORMAL + hint queues
+    drained + the partitioned node bit-exact, zero reverted clears).
+    All guarded headlines are bench_guard AUTO_REQUIREd once baselined,
+    with absolute 90% floors on both availability percentages."""
     import http.client
     import os
     import signal
@@ -2001,6 +2009,11 @@ def chaos_sweep():
                 _sys.executable, script, f"n{i}", str(ports[i]),
                 str(gports[i]), str(gports[0]), os.path.join(tmp, f"n{i}"),
                 "--ack", "logged",
+                # Partition mode heals and measures recovery: the
+                # production 15 s holddown would dominate the heal
+                # headline, so the drills run the documented fast
+                # setting (docs/durability.md discusses the tradeoff).
+                "--recovery-holddown-ms", "500",
             ],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True,
@@ -2093,8 +2106,28 @@ def chaos_sweep():
             f"any={qps_any:.0f}"
         )
 
-        # Phase B: availability through a SIGKILL.  The load runs the
-        # whole window; the kill lands 1s in.
+        def get(port, path, timeout=10):
+            with urllib.request.urlopen(
+                f"http://localhost:{port}{path}", timeout=timeout
+            ) as resp:
+                return json.loads(resp.read())
+
+        def shard_owners(s):
+            return {
+                n["id"]
+                for n in get(
+                    ports[0], f"/internal/fragment/nodes?index=i&shard={s}"
+                )
+            }
+
+        # Pre-fault owner map: which shards the victim (n1) owns, and
+        # one still-set column per such shard for the destructive-write
+        # probe below.
+        n1_shards = [s for s in range(n_shards) if "n1" in shard_owners(s)]
+        assert n1_shards, "placement gave n1 no shards?"
+
+        # Phase B: availability through the failure.  The load runs the
+        # whole window; the fault lands 1s in.
         ok, err = [0], [0]
         stop = threading.Event()
 
@@ -2115,9 +2148,29 @@ def chaos_sweep():
         t.start()
         time.sleep(1.0)
         kill_t = time.monotonic()
-        os.kill(procs[1].pid, signal.SIGKILL)
-        procs[1].wait(timeout=10)
-        time.sleep(6.0)  # kill + detection + degraded steady state
+        if fault == "partition":
+            # Deterministic cut via the fault plane: ONE rule body
+            # POSTed to every node — each enforces only its own side
+            # (net/faults.py), exactly like a real network partition.
+            partition = json.dumps({
+                "seed": 1,
+                "rules": [{
+                    "action": "partition",
+                    "a": [
+                        f"127.0.0.1:{ports[1]}", f"127.0.0.1:{gports[1]}",
+                    ],
+                    "b": [
+                        f"127.0.0.1:{ports[0]}", f"127.0.0.1:{gports[0]}",
+                        f"127.0.0.1:{ports[2]}", f"127.0.0.1:{gports[2]}",
+                    ],
+                }],
+            }).encode()
+            for p in ports:
+                post(p, "/debug/faults", partition)
+        else:
+            os.kill(procs[1].pid, signal.SIGKILL)
+            procs[1].wait(timeout=10)
+        time.sleep(6.0)  # fault + detection + degraded steady state
         stop.set()
         t.join()
         total = ok[0] + err[0]
@@ -2127,8 +2180,102 @@ def chaos_sweep():
         )
         progress(
             f"chaos-sweep: {ok[0]}/{total} queries answered through the "
-            f"kill ({avail:.1f}%), window {time.monotonic() - kill_t:.1f}s"
+            f"{fault} ({avail:.1f}%), window "
+            f"{time.monotonic() - kill_t:.1f}s"
         )
+
+        # Destructive-write availability through the DEGRADED steady
+        # state: Clears on shards the dead node owns.  Before hinted
+        # handoff every one failed loudly (0%); with the hint queue
+        # each acks and its miss is durably queued for replay (100%).
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if get(ports[0], "/status")["state"] != "NORMAL":
+                break
+            time.sleep(0.2)
+        cleared = []
+        d_ok = 0
+        for s in n1_shards:
+            col = s * SHARD_WIDTH  # k=0 column, set during seeding
+            try:
+                out = post(
+                    ports[0], "/index/i/query",
+                    f"Clear({col}, f=1)".encode(), timeout=30,
+                )
+                assert out["results"][0] is True
+                d_ok += 1
+                cleared.append(col)
+            except Exception:  # noqa: BLE001 — counted against availability
+                pass
+        d_avail = 100.0 * d_ok / max(1, len(n1_shards))
+        emit_raw(
+            "destructive_write_availability_pct", d_avail, "pct",
+            d_avail / 100.0,
+        )
+        progress(
+            f"chaos-sweep: {d_ok}/{len(n1_shards)} destructive writes "
+            f"acked under single-owner failure ({d_avail:.1f}%)"
+        )
+
+        if fault == "partition":
+            # Heal and measure recovery: POST empty rule tables, then
+            # wait for cluster NORMAL + every hint queue drained + the
+            # partitioned node bit-exact (cleared bits ABSENT — the
+            # zero-reverted-clears acceptance — and every surviving
+            # bit present on its owned shards).
+            heal_t = time.monotonic()
+            for p in ports:
+                post(p, "/debug/faults", json.dumps({"rules": []}).encode())
+            expect = oracle - len(cleared)
+            # The partitioned node's LOCAL truth for its owned shards:
+            # 64 seeded bits per shard minus the one clear that acked
+            # per shard — reachable only via hint replay.
+            expect_n1 = 64 * len(n1_shards) - len(cleared)
+            deadline = time.time() + 90
+            healed = False
+            while time.time() < deadline:
+                try:
+                    st = get(ports[0], "/status")
+                    hints = get(ports[0], "/debug/vars").get("hints", {})
+                    n1_local = post(
+                        ports[1], "/index/i/query",
+                        json.dumps({
+                            "query": "Count(Row(f=1))", "remote": True,
+                            "shards": n1_shards,
+                        }).encode(), timeout=30,
+                    )["results"][0]
+                    if (
+                        st["state"] == "NORMAL"
+                        and not hints.get("pending")
+                        and n1_local == expect_n1
+                        and post(
+                            ports[0], "/index/i/query",
+                            b"Count(Row(f=1))", timeout=30,
+                        )["results"][0] == expect
+                    ):
+                        healed = True
+                        break
+                except Exception:  # noqa: BLE001 — still healing
+                    pass
+                time.sleep(0.3)
+            assert healed, "partition never healed to convergence"
+            heal_s = time.monotonic() - heal_t
+            emit_raw("partition_heal_seconds", heal_s, "s", heal_s)
+            # Zero reverted clears: stability across two further
+            # anti-entropy intervals — the majority-tie merge must NOT
+            # resurrect any cleared bit from the recovered node.
+            time.sleep(3.5)
+            after = post(
+                ports[0], "/index/i/query", b"Count(Row(f=1))", timeout=30
+            )["results"][0]
+            assert after == expect, (
+                f"anti-entropy reverted clears: count {after} != {expect}"
+            )
+            progress(
+                f"chaos-sweep: partition healed in {heal_s:.1f}s, "
+                f"{len(cleared)} clears stable through anti-entropy "
+                "(zero reverts)"
+            )
     finally:
         for p in procs:
             try:
@@ -2658,11 +2805,23 @@ if __name__ == "__main__":
         action="store_true",
         help="run the serving-through-failure sweep ONLY: a real "
         "3-process gossip cluster (replicas=2, ack=logged) measuring "
-        "replica_read_qps_gain (any-mode vs primary-mode Count QPS) "
-        "and availability_under_failure_pct (fraction of queries "
-        "answered while a replica is SIGKILLed mid-load) — both "
-        "bench_guard AUTO_REQUIREd once baselined "
-        "(docs/durability.md)",
+        "replica_read_qps_gain (any-mode vs primary-mode Count QPS), "
+        "availability_under_failure_pct (fraction of queries answered "
+        "while a replica fails mid-load), and "
+        "destructive_write_availability_pct (Clears acked under "
+        "single-owner failure via hinted handoff) — all bench_guard "
+        "AUTO_REQUIREd once baselined (docs/durability.md)",
+    )
+    ap.add_argument(
+        "--fault",
+        choices=("kill", "partition"),
+        default="kill",
+        help="failure mode for --chaos-sweep: 'kill' SIGKILLs the "
+        "replica (the PR 11 drill); 'partition' injects a "
+        "deterministic network partition through POST /debug/faults "
+        "(net/faults.py), then HEALS it and additionally emits "
+        "partition_heal_seconds (heal -> NORMAL + hint queues drained "
+        "+ bit-exact convergence, zero reverted clears)",
     )
     ap.add_argument(
         "--dashboard-sweep",
@@ -2755,7 +2914,7 @@ if __name__ == "__main__":
     elif args.streaming_sweep:
         streaming_sweep()
     elif args.chaos_sweep:
-        chaos_sweep()
+        chaos_sweep(fault=args.fault)
     elif args.density_sweep:
         density_sweep()
     elif args.dashboard_sweep:

@@ -542,33 +542,77 @@ class API:
         if eng is not None:
             eng.ingest_syncer().notify(index_name)
 
-    def _live_owners(self, index: str, shard: int, clear: bool = False):
+    def _live_owners(
+        self, index: str, shard: int, clear: bool = False, hint_op=None,
+        rollback=None,
+    ):
         """A shard's owners with DOWN ones skipped — the DEGRADED write
         policy (docs/durability.md): survivors take the write, the ack
-        is made durable on them, and anti-entropy seeds the dead owner
-        on recovery.  Raises when every owner is DOWN (nothing can make
-        the ack durable).  ``clear`` marks a bit-REMOVING import — those
-        never degrade: anti-entropy's majority-tie-to-set merge would
-        re-SET the removed bits once the dead owner (still holding
-        them) recovers, silently undoing the acked write.  Callers pass
-        clear=True for explicit ?clear=true imports AND for implicitly
-        destructive ones (mutex/bool fields displace the previous row,
-        BSI value imports rewrite bit planes).  Returns
-        (live_owners, skipped_count)."""
+        is made durable on them, and each DOWN owner's miss is durably
+        QUEUED as a hint record (hinted handoff) for replay on
+        recovery.  ``hint_op`` builds the replayable op payload lazily
+        (once per shard, only when an owner is actually DOWN).  Raises
+        when every owner is DOWN (nothing can make the ack durable).
+        ``clear`` marks a bit-REMOVING import — anti-entropy's
+        majority-tie-to-set merge would re-SET the removed bits once
+        the dead owner (still holding them) recovers, silently undoing
+        the acked write — so those ack ONLY when every miss was
+        absorbed by the hint queue, and fail loudly on overflow/expiry
+        (the PR 11 fallback).  Callers pass clear=True for explicit
+        ?clear=true imports AND for implicitly destructive ones
+        (mutex/bool fields displace the previous row, BSI value imports
+        rewrite bit planes).  Returns
+        (live_owners, skipped_count, hinted_count)."""
         owners = self.cluster.shard_nodes(index, shard)
         live = [n for n in owners if n.state != "DOWN"]
+        down = [n for n in owners if n.state == "DOWN"]
         if not live:
             raise ApiError(
                 f"import unavailable: every owner of shard {shard} is "
                 f"DOWN ({', '.join(n.id for n in owners)})"
             )
-        if clear and len(live) < len(owners):
+        hinted = 0
+        # (node id, seq) enqueues awaiting rollback.  ``rollback`` is
+        # CALLER-owned and spans the whole import: the gate failing on
+        # shard B must also unwind shard A's hints — the grouping loop
+        # runs before any apply, so the entire batch fails un-acked and
+        # every absorbed miss is a phantom.
+        fresh = rollback if rollback is not None else []
+        hints = getattr(self.cluster, "hints", None)
+        if down and hints is not None and hint_op is not None:
+            op = hint_op()
+            for n in down:
+                seq = hints.enqueue(n.id, index, shard, op)
+                if seq:
+                    hinted += 1
+                    fresh.append((n.id, seq))
+        if clear and hinted < len(down):
+            # All-or-nothing for destructive imports: the batch is about
+            # to FAIL (no ack), so any miss already absorbed — THIS
+            # shard's or an earlier one's — must not survive to replay
+            # an import that never happened.
+            for nid, seq in fresh:
+                hints.discard(nid, [seq])
+            del fresh[:]
             raise ApiError(
                 f"clear import unavailable: owner of shard {shard} is "
-                "DOWN and a degraded bit-removing import would be "
-                "reverted by anti-entropy on its recovery"
+                "DOWN, the hint queue could not absorb the miss, and a "
+                "degraded bit-removing import would be reverted by "
+                "anti-entropy on its recovery"
             )
-        return live, len(owners) - len(live)
+        return live, len(down) - hinted, hinted
+
+    def _discard_hint_rollback(self, fresh):
+        """Unwind a failed import batch's queued hints — every shard's,
+        whatever raised (a later shard's all-owners-DOWN error, a
+        fan-out failure): the client got no ack, so no absorbed miss
+        may survive to replay."""
+        hints = getattr(self.cluster, "hints", None)
+        if hints is None:
+            return
+        for nid, seq in fresh:
+            hints.discard(nid, [seq])
+        del fresh[:]
 
     def _import_destructive(self, f, clear: bool) -> bool:
         """Does this import REMOVE bits on apply?  Explicit clears do;
@@ -578,7 +622,17 @@ class API:
 
         return clear or f.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL)
 
-    def _note_degraded(self, index: str, skipped: int):
+    def _note_degraded(self, index: str, skipped: int, hinted: int = 0):
+        """Record how a degraded import fan-out handled its DOWN
+        owners: ``hinted`` misses are queued for replay (the new
+        normal), ``skipped`` ones fell back to the PR 11 anti-entropy
+        seeding (hint queue absent or full).  Only a true skip counts
+        the degraded-batches series — a hinted batch is not degraded,
+        its replay is deterministic."""
+        if hinted:
+            self.journal.append(
+                "ingest.hinted", index=index, hintedOwners=hinted,
+            )
         if not skipped:
             return
         REGISTRY.inc(METRIC_INGEST_DEGRADED_BATCHES)
@@ -659,45 +713,64 @@ class API:
         local_idxs: list = []
         remote_jobs = []
         skipped_owners = 0
-        for shard, idxs in sorted(groups.items()):
-            s_rows = [row_ids[i] for i in idxs]
-            s_cols = [col_ids[i] for i in idxs]
-            s_ts = [timestamps[i] for i in idxs] if timestamps else []
-            live, skipped = self._live_owners(
-                req.index, shard, clear=self._import_destructive(f, clear)
-            )
-            skipped_owners += skipped
-            for node in live:
-                if node.id == self.cluster.node.id:
-                    local_idxs.extend(idxs)
-                else:
-                    remote_jobs.append(
-                        lambda n=node, s=shard, r=s_rows, c=s_cols, t=s_ts: (
-                            self.cluster.client(n).import_bits(
-                                req.index,
-                                req.field,
-                                s,
-                                r,
-                                c,
-                                timestamps=t or None,
-                                remote=True,
-                                clear=clear,
+        hinted_owners = 0
+        hint_rollback: list = []  # spans every shard of this batch
+        try:
+            for shard, idxs in sorted(groups.items()):
+                s_rows = [row_ids[i] for i in idxs]
+                s_cols = [col_ids[i] for i in idxs]
+                s_ts = [timestamps[i] for i in idxs] if timestamps else []
+                live, skipped, hinted = self._live_owners(
+                    req.index, shard,
+                    clear=self._import_destructive(f, clear),
+                    hint_op=lambda r=s_rows, c=s_cols, t=s_ts: {
+                        "kind": "import_bits", "field": req.field,
+                        "rows": r, "cols": c, "ts": t or None,
+                        "clear": clear,
+                    },
+                    rollback=hint_rollback,
+                )
+                skipped_owners += skipped
+                hinted_owners += hinted
+                for node in live:
+                    if node.id == self.cluster.node.id:
+                        local_idxs.extend(idxs)
+                    else:
+                        remote_jobs.append(
+                            lambda n=node, s=shard, r=s_rows, c=s_cols,
+                            t=s_ts: (
+                                self.cluster.client(n).import_bits(
+                                    req.index,
+                                    req.field,
+                                    s,
+                                    r,
+                                    c,
+                                    timestamps=t or None,
+                                    remote=True,
+                                    clear=clear,
+                                )
                             )
                         )
+            if local_idxs:
+                remote_jobs.append(
+                    lambda: self._import_local(
+                        idx,
+                        f,
+                        [row_ids[i] for i in local_idxs],
+                        [col_ids[i] for i in local_idxs],
+                        [timestamps[i] for i in local_idxs]
+                        if timestamps else [],
+                        clear,
                     )
-        if local_idxs:
-            remote_jobs.append(
-                lambda: self._import_local(
-                    idx,
-                    f,
-                    [row_ids[i] for i in local_idxs],
-                    [col_ids[i] for i in local_idxs],
-                    [timestamps[i] for i in local_idxs] if timestamps else [],
-                    clear,
                 )
-            )
-        fanout.run_fanout(remote_jobs)
-        self._note_degraded(req.index, skipped_owners)
+            fanout.run_fanout(remote_jobs)
+        except Exception:
+            # The batch is failing un-acked, WHEREVER it raised — a
+            # later shard's all-owners-DOWN error, a fan-out failure:
+            # unwind every hint it queued (phantoms otherwise).
+            self._discard_hint_rollback(hint_rollback)
+            raise
+        self._note_degraded(req.index, skipped_owners, hinted_owners)
         self._ingest_done("bits", req.index, len(col_ids), t0)
 
     def _import_local(self, idx, f, row_ids, col_ids, timestamps, clear=False):
@@ -759,34 +832,50 @@ class API:
         local_idxs: list = []
         remote_jobs = []
         skipped_owners = 0
-        for shard, idxs in sorted(groups.items()):
-            cols = [col_ids[i] for i in idxs]
-            values = [vals[i] for i in idxs]
-            # BSI value imports rewrite bit planes (they CLEAR bits even
-            # on the set path): never degradable.
-            live, skipped = self._live_owners(req.index, shard, clear=True)
-            skipped_owners += skipped
-            for node in live:
-                if node.id == self.cluster.node.id:
-                    local_idxs.extend(idxs)
-                else:
-                    remote_jobs.append(
-                        lambda n=node, s=shard, c=cols, v=values: (
-                            self.cluster.client(n).import_values(
-                                req.index, req.field, s, c, v,
-                                remote=True, clear=clear,
+        hinted_owners = 0
+        hint_rollback: list = []  # spans every shard of this batch
+        try:
+            for shard, idxs in sorted(groups.items()):
+                cols = [col_ids[i] for i in idxs]
+                values = [vals[i] for i in idxs]
+                # BSI value imports rewrite bit planes (they CLEAR bits
+                # even on the set path): ackable under a DOWN owner
+                # only via the hint queue.
+                live, skipped, hinted = self._live_owners(
+                    req.index, shard, clear=True,
+                    hint_op=lambda c=cols, v=values: {
+                        "kind": "import_values", "field": req.field,
+                        "cols": c, "values": v, "clear": clear,
+                    },
+                    rollback=hint_rollback,
+                )
+                skipped_owners += skipped
+                hinted_owners += hinted
+                for node in live:
+                    if node.id == self.cluster.node.id:
+                        local_idxs.extend(idxs)
+                    else:
+                        remote_jobs.append(
+                            lambda n=node, s=shard, c=cols, v=values: (
+                                self.cluster.client(n).import_values(
+                                    req.index, req.field, s, c, v,
+                                    remote=True, clear=clear,
+                                )
                             )
                         )
+            if local_idxs:
+                remote_jobs.append(
+                    lambda: apply_local(
+                        [col_ids[i] for i in local_idxs],
+                        [vals[i] for i in local_idxs],
                     )
-        if local_idxs:
-            remote_jobs.append(
-                lambda: apply_local(
-                    [col_ids[i] for i in local_idxs],
-                    [vals[i] for i in local_idxs],
                 )
-            )
-        fanout.run_fanout(remote_jobs)
-        self._note_degraded(req.index, skipped_owners)
+            fanout.run_fanout(remote_jobs)
+        except Exception:
+            # Same unwind as import_bits: no ack, no surviving hints.
+            self._discard_hint_rollback(hint_rollback)
+            raise
+        self._note_degraded(req.index, skipped_owners, hinted_owners)
         self._ingest_done("values", req.index, len(col_ids), t0)
 
     def import_roaring(
@@ -1054,6 +1143,12 @@ class API:
                         sender,
                         msg.get("versions") or None,
                         ae_passes=msg.get("aePasses"),
+                        # Peer-advertised pending-hint counts (hinted
+                        # handoff): quarantine release + the syncer's
+                        # defer-own-pass check consume these.  A status
+                        # WITHOUT the field (pre-hint peer) leaves the
+                        # previous advertisement untouched.
+                        pending_hints=msg.get("pendingHints"),
                     )
 
             # Anti-entropy schema reconciliation: adopt the sender's

@@ -302,6 +302,26 @@ class Cluster:
         # whose SERVING plane keeps failing RPCs stays DOWN between
         # verdicts instead of flapping back per datagram.
         self._down_since: Dict[str, float] = {}
+        # Heartbeat-recovery holddown, seconds ([cluster]
+        # recovery-holddown-ms, docs/durability.md): instance-level so
+        # the Server can wire the configured value; the class constant
+        # stays the documented default.
+        self.recovery_holddown = self.RECOVERY_HOLDDOWN
+        # Hinted handoff (docs/durability.md): the HintManager attached
+        # by the Server (None = PR 11 skip-or-fail-loud policy only —
+        # the harness default, so failure-policy tests keep their exact
+        # pre-hint semantics unless they opt in).
+        self.hints = None
+        # Peer-advertised pending-hint counts: advertiser node id ->
+        # (monotonic receipt stamp, {target node id: records}), learned
+        # from NodeStatus exchanges ("pendingHints").  Quarantine
+        # release consults this — a recovered node stays quarantined
+        # while ANY peer still holds un-replayed hints for it, not just
+        # while WE do.  Entries expire at PEER_HINTS_TTL (see
+        # hints_pending_for): an advertiser that died PERMANENTLY
+        # (never admin-removed) must not wedge its target's quarantine
+        # and anti-entropy forever on a stale advertisement.
+        self._peer_hints: Dict[str, tuple] = {}
         self.nodes: List[Node] = [node]
         self._lock = threading.RLock()
         self.logger = logger
@@ -496,6 +516,11 @@ class Cluster:
                 self.save_topology()
             self._heartbeats.pop(node_id, None)
             self._read_quarantine.pop(node_id, None)
+            self._peer_hints.pop(node_id, None)
+            if self.hints is not None:
+                # An admin-removed node never replays: its queued hints
+                # are dropped (reason=node_removed), counted + journaled.
+                self.hints.drop_node(node_id)
             self._emit("leave", node)
             if self.is_coordinator() and self.holder is not None:
                 self.send_sync(self.node_status())
@@ -579,6 +604,7 @@ class Cluster:
         node_id: str,
         versions: Optional[dict] = None,
         ae_passes: Optional[int] = None,
+        pending_hints: Optional[dict] = None,
     ):
         """Record liveness evidence about a peer: a gossip probe ack /
         ALIVE update (``versions`` None) or a NodeStatus exchange
@@ -606,12 +632,19 @@ class Cluster:
         if versions is None and prev is not None:
             versions = prev[1]
         self._heartbeats[node_id] = (now, versions or {})
+        if pending_hints is not None:
+            # The advertiser's full pending-hint map replaces its
+            # previous advertisement (an empty map clears it — that is
+            # the "my hints for X drained" signal quarantine waits on).
+            self._peer_hints[node_id] = (now, {
+                str(t): int(n) for t, n in pending_hints.items() if int(n)
+            })
         n = self.node_by_id(node_id)
         if (
             n is not None
             and n.state == "DOWN"
             and now - self._down_since.get(node_id, 0.0)
-            >= self.RECOVERY_HOLDDOWN
+            >= self.recovery_holddown
         ):
             self.node_recovered(node_id)
         if node_id in self._read_quarantine and ae_passes is not None:
@@ -621,8 +654,46 @@ class Cluster:
             elif int(ae_passes) > base:
                 # A whole pass completed strictly after recovery: every
                 # shard the peer owns has been reconciled against its
-                # replicas — bounded reads may trust it again.
-                del self._read_quarantine[node_id]
+                # replicas — bounded reads may trust it again... UNLESS
+                # pending hints for it are still queued anywhere
+                # (locally or peer-advertised): the replay must land
+                # BEFORE readmission, or a bounded read could serve a
+                # bit whose queued clear hasn't reached the node yet
+                # (replay-before-quarantine ordering,
+                # docs/durability.md "Hinted handoff").
+                if self.hints_pending_for(node_id) == 0:
+                    del self._read_quarantine[node_id]
+                    self.journal.append(
+                        "cluster.quarantine.release", node=node_id,
+                        aePasses=int(ae_passes),
+                    )
+
+    # How long a peer's pending-hint advertisement stays trusted
+    # without a refresh.  Advertisements re-send with every NodeStatus
+    # (each anti-entropy interval at minimum, default 600 s), so a live
+    # advertiser refreshes well inside the TTL — only a PERMANENTLY
+    # dead one (crashed, never admin-removed) goes stale, and its
+    # target must not be quarantined/AE-deferred forever on its ghost.
+    PEER_HINTS_TTL = 30 * 60.0
+
+    def hints_pending_for(self, node_id: str) -> int:
+        """Known un-replayed hints targeting ``node_id``, summed over
+        this node's own queue and every peer's latest (unexpired)
+        advertisement — the replay-before-readmission gate for
+        bounded-read quarantine AND the syncer's defer-own-AE-pass
+        check."""
+        total = 0
+        if self.hints is not None:
+            total += self.hints.pending(node_id)
+        now = time.monotonic()
+        for advertiser, (stamp, targets) in list(self._peer_hints.items()):
+            if advertiser == self.node.id:
+                continue
+            if now - stamp > self.PEER_HINTS_TTL:
+                del self._peer_hints[advertiser]
+                continue
+            total += int(targets.get(node_id, 0))
+        return total
 
     def heartbeat_age_ms(self, node_id: str) -> Optional[float]:
         """Milliseconds since the last heartbeat from ``node_id``;
@@ -677,6 +748,11 @@ class Cluster:
             }
         for nid in list(self._read_quarantine):
             out.setdefault(nid, {"quarantined": True})
+        for nid, entry in out.items():
+            if entry.get("quarantined"):
+                # WHY the node is still quarantined: un-replayed hints
+                # block readmission even after anti-entropy advances.
+                entry["hintsPending"] = self.hints_pending_for(nid)
         return out
 
     def node_failed(self, node_id: str):
@@ -1058,6 +1134,14 @@ class Cluster:
             # peers release their bounded-read quarantine of us when
             # this advances past their post-recovery baseline.
             "aePasses": self.ae_passes,
+            # Pending-hint advertisement (docs/durability.md "Hinted
+            # handoff"): {target node id: un-replayed records} — peers
+            # hold the target's quarantine while any advertiser is
+            # nonzero, and the target itself DEFERS its anti-entropy
+            # passes (syncer) until every advertisement for it clears.
+            "pendingHints": (
+                self.hints.pending_map() if self.hints is not None else {}
+            ),
         }
         if self.holder is None:
             return status

@@ -296,6 +296,8 @@ class GossipNode:
         return json.dumps(msg).encode()
 
     def _send(self, addr, msg: dict):
+        if self._fault_dropped(addr):
+            return
         data = self._encode(msg)
         if len(data) > self.mtu:
             # Oversized for a datagram: stream it (memberlist's TCP
@@ -341,8 +343,26 @@ class GossipNode:
             self._apply_update(update)
         self._handle_bcasts(state.get("bcasts"))
 
+    @staticmethod
+    def _fault_dropped(addr) -> bool:
+        """Deterministic fault plane (net/faults.py): gossip honors
+        drop/partition rules on OUTGOING traffic, so a scripted
+        partition silences this node's probes/acks/push-pulls toward
+        the other side exactly like a real network cut — the failure
+        detector then reaches its SUSPECT/DEAD verdicts organically."""
+        from ..net.faults import PLANE
+
+        if not PLANE.active:
+            return False
+        rule = PLANE.intercept(
+            f"{addr[0]}:{addr[1]}", "gossip", transport="gossip"
+        )
+        return rule is not None and rule.action in ("drop", "partition")
+
     def _push_pull(self, addr) -> bool:
         """Full bidirectional state exchange over one TCP stream."""
+        if self._fault_dropped(addr):
+            return False
         try:
             with socket.create_connection(
                 addr, timeout=self.probe_timeout * 8

@@ -40,6 +40,46 @@ class HolderSyncer:
             "fragments": 0, "blocksSynced": 0,
             "bitsSet": 0, "bitsCleared": 0, "errors": 0,
         }
+        # Replay-before-AE ordering (docs/durability.md "Hinted
+        # handoff").  Three gates:
+        #
+        # 0. SYNCHRONOUS pre-pass hint check: fetch every live peer's
+        #    current pendingHints (GET /status) before merging.
+        #    Gossiped advertisements alone lose a race this pass must
+        #    never lose — a node whose partition was shorter than its
+        #    own failure detection never convicts its peers, so its
+        #    first post-heal pass would push its stale bits back onto
+        #    survivors that just acked clears (reverting them) before
+        #    any broadcast advertisement could land.  An unreachable
+        #    peer defers the whole pass: merging while a link is in an
+        #    unknown state is exactly the revert window.
+        # 1. Peers still advertise un-replayed hints targeting THIS
+        #    node: this pass must NOT run — merging majority-tie-to-set
+        #    against replicas while we still hold bits a queued clear
+        #    will remove would resurrect them on the healthy side.
+        #    Defer (journaled, NOT counted as a clean pass, so
+        #    ae_passes stays put and the bounded-read quarantine holds).
+        # 2. WE hold hints for some peer: drain what we can first, and
+        #    _replicas below excludes any peer whose queue didn't fully
+        #    drain — our clears must land via replay before that
+        #    replica's blocks are merged.
+        if not self._refresh_peer_hints():
+            self.journal.append(
+                "antientropy.deferred", node=self.cluster.node.id,
+                reason="peer-unreachable",
+            )
+            return
+        if self.cluster.hints_pending_for(self.cluster.node.id) > 0:
+            self.journal.append(
+                "antientropy.deferred", node=self.cluster.node.id,
+                reason="pending-hints",
+                pendingHintsForMe=self.cluster.hints_pending_for(
+                    self.cluster.node.id
+                ),
+            )
+            return
+        if self.cluster.hints is not None:
+            self.cluster.hints.replay_pending()
         t0 = time.monotonic()
         self.journal.append("antientropy.start", node=self.cluster.node.id)
         clean = False
@@ -63,6 +103,63 @@ class HolderSyncer:
                 seconds=round(time.monotonic() - t0, 6),
                 **self._pass,
             )
+
+    # How long a freshly-convicted DOWN member defers passes (the
+    # detection-skew guard in _refresh_peer_hints).  Generously above
+    # any gossip suspicion timeout (default 4 s) and bounded so a
+    # permanent death cannot suspend anti-entropy indefinitely.
+    DARK_MEMBER_DEFER = 30.0
+
+    def _refresh_peer_hints(self) -> bool:
+        """Synchronously refresh every live peer's pending-hint
+        advertisement (GET /status) before a pass.  Returns False —
+        defer — when any live peer cannot be reached or answers
+        without the hint fields (mid-upgrade peer: its hint state is
+        unknowable, same uncertainty as unreachable... except a
+        pre-hint peer never will, so absent fields on a REACHABLE peer
+        count as an empty advertisement to avoid wedging mixed
+        clusters)."""
+        cluster = self.cluster
+        for node in list(cluster.nodes):
+            if node.id == cluster.node.id:
+                continue
+            if node.state == "DOWN":
+                # A DOWN-marked member's hint queue is unknowable — and
+                # it is exactly the node most likely to HOLD hints (the
+                # coordinator that kept acking while THIS node was the
+                # partitioned side sees us as DOWN and vice versa; an
+                # asymmetric detection can leave either view).  With
+                # hinted handoff enabled, merging while any member's
+                # hint state is dark IS the resurrect window — defer,
+                # but BOUNDED: the race only lives in the detection-
+                # skew window around a partition (one side convicted,
+                # the other not yet — once both convict, each side
+                # defers on its own view).  A member CONTINUOUSLY down
+                # past the bound is the PR 11 long-outage regime, where
+                # survivors must keep repairing each other — an
+                # unbounded defer would suspend cluster-wide repair
+                # (and wedge unrelated quarantine releases) for the
+                # whole outage.  Without a manager (pre-hint cluster)
+                # the PR 11 behavior stands throughout.
+                down_for = time.monotonic() - cluster._down_since.get(
+                    node.id, 0.0
+                )
+                if (
+                    cluster.hints is not None
+                    and down_for < self.DARK_MEMBER_DEFER
+                ):
+                    return False
+                continue
+            try:
+                st = cluster.client(node).status()
+            except Exception:  # noqa: BLE001 — unreachable = uncertain
+                return False
+            cluster.note_heartbeat(
+                node.id,
+                pending_hints=st.get("pendingHints") or {},
+                ae_passes=st.get("aePasses"),
+            )
+        return True
 
     def _sync_all(self):
         for index_name, idx in list(self.holder.indexes.items()):
@@ -102,7 +199,16 @@ class HolderSyncer:
         return [
             n
             for n in self.cluster.shard_nodes(index, shard)
-            if n.id != self.cluster.node.id and n.state != "DOWN"
+            if n.id != self.cluster.node.id
+            and n.state != "DOWN"
+            # A replica ANY node still holds un-replayed hints for
+            # (ours locally, or peer-advertised via NodeStatus
+            # pendingHints) is missing writes the majority-tie merge
+            # would undo — a queued clear's bit is still SET there, and
+            # merging it from a THIRD replica resurrects the bit just
+            # as surely as merging it ourselves.  Replay must land
+            # first.
+            and self.cluster.hints_pending_for(n.id) == 0
         ]
 
     def sync_fragment(self, index: str, field: str, view: str, shard: int):

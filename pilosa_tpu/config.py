@@ -53,6 +53,17 @@ class Config:
         # X-Pilosa-Freshness-Ms), skipping stale/DEAD ones.
         self.cluster_replica_read = "primary"
         self.cluster_freshness_ms = 1000.0
+        # Hinted handoff (docs/durability.md): bounds on the durable
+        # per-DOWN-owner replay queues.  On overflow/expiry a write
+        # falls back to the pre-hint policy (additive sets skip,
+        # destructive writes fail loudly).  hint-max-bytes 0 disables
+        # hinting entirely.
+        self.cluster_hint_max_bytes = 16 * 1024 * 1024
+        self.cluster_hint_max_age = 3600.0
+        # Heartbeat-recovery holddown: seconds after a failure verdict
+        # before gossip liveness alone may refute it (was a hardcoded
+        # 15s; docs/durability.md discusses the tradeoff).
+        self.cluster_recovery_holddown_ms = 15000.0
         # gossip (SWIM membership)
         self.gossip_port = 14000
         self.gossip_seeds: List[str] = []
@@ -146,6 +157,12 @@ class Config:
         # broadcast within this bound so fused queries degrade to the
         # host path instead of hanging the dispatcher.
         self.mesh_dispatch_timeout = 30.0
+        # Deterministic network-fault plane ([faults], net/faults.py):
+        # rule spec strings installed at boot (tests/chaos tooling; the
+        # runtime channel is POST /debug/faults) + the seed every
+        # probabilistic rule draws from.
+        self.faults_seed = 0
+        self.faults_rules: List[str] = []
 
     # -- loading -----------------------------------------------------------
 
@@ -181,6 +198,14 @@ class Config:
         )
         if "freshness-ms" in cl:
             self.cluster_freshness_ms = float(cl["freshness-ms"])
+        if "hint-max-bytes" in cl:
+            self.cluster_hint_max_bytes = int(cl["hint-max-bytes"])
+        if "hint-max-age" in cl:
+            self.cluster_hint_max_age = _parse_duration(cl["hint-max-age"])
+        if "recovery-holddown-ms" in cl:
+            self.cluster_recovery_holddown_ms = float(
+                cl["recovery-holddown-ms"]
+            )
         g = doc.get("gossip", {})
         self.gossip_port = int(g.get("port", self.gossip_port))
         self.gossip_seeds = g.get("seeds", self.gossip_seeds)
@@ -278,6 +303,9 @@ class Config:
             self.mesh_dispatch_timeout = _parse_duration(
                 mesh["dispatch-timeout"]
             )
+        flt = doc.get("faults", {})
+        self.faults_seed = int(flt.get("seed", self.faults_seed))
+        self.faults_rules = flt.get("rules", self.faults_rules)
 
     def load_env(self, environ=None):
         env = environ if environ is not None else os.environ
@@ -304,6 +332,19 @@ class Config:
             ("cluster_hosts", "CLUSTER_HOSTS", list),
             ("cluster_replica_read", "CLUSTER_REPLICA_READ", str),
             ("cluster_freshness_ms", "CLUSTER_FRESHNESS_MS", float),
+            ("cluster_hint_max_bytes", "CLUSTER_HINT_MAX_BYTES", int),
+            ("cluster_hint_max_age", "CLUSTER_HINT_MAX_AGE", _parse_duration),
+            (
+                "cluster_recovery_holddown_ms",
+                "CLUSTER_RECOVERY_HOLDDOWN_MS",
+                float,
+            ),
+            # Semicolon-separated rule specs (commas are the env list
+            # separator elsewhere; fault specs never contain ';').
+            ("faults_rules", "FAULTS", lambda v: [
+                s.strip() for s in v.split(";") if s.strip()
+            ]),
+            ("faults_seed", "FAULTS_SEED", int),
             ("storage_ack", "STORAGE_ACK", str),
             ("storage_open_workers", "STORAGE_OPEN_WORKERS", int),
             ("storage_warm_start", "STORAGE_WARM_START", bool),
@@ -360,6 +401,9 @@ hosts = [{hosts}]
 long-query-time = "{int(self.cluster_long_query_time)}s"
 replica-read = "{self.cluster_replica_read}"
 freshness-ms = {self.cluster_freshness_ms}
+hint-max-bytes = {self.cluster_hint_max_bytes}
+hint-max-age = "{int(self.cluster_hint_max_age)}s"
+recovery-holddown-ms = {self.cluster_recovery_holddown_ms}
 
 [gossip]
 port = {self.gossip_port}

@@ -969,7 +969,7 @@ class Executor:
             index, shards, call, opt, map_fn, reduce_fn, set(), budget
         )
 
-    def _read_route(self, index, shard, owners, call, opt):
+    def _read_route(self, index, shard, owners, call, opt, hinted=None):
         """Pick this shard's execution target among its owners
         (docs/durability.md "Replica reads").  Local ownership always
         wins (zero-hop).  Writes pin to strict replica order — their
@@ -987,20 +987,72 @@ class Executor:
                     live owner."""
         cluster = self.cluster
         me = cluster.node.id
-        for n in owners:
-            if n.id == me:
-                return n
+        local = next((n for n in owners if n.id == me), None)
+        is_write = call is not None and call.name in _WRITE_CALLS
+        if local is not None and not is_write:
+            return local  # reads: local ownership always wins (zero-hop)
         alive = [n for n in owners if n.state != "DOWN"]
         if not alive:
-            return owners[0]  # all DOWN: last resort keeps replica order
-        if call is not None and call.name in _WRITE_CALLS:
-            if call.name in _DESTRUCTIVE_CALLS and len(alive) < len(owners):
+            if is_write:
+                # No replica can make the ack durable: the same loud
+                # failure as _write_replicated — a write must never
+                # take the last-resort READ path below (it would count
+                # as a read, bypass the destructive gate, and be
+                # forwarded to a node the detector says is dead).
+                # Unwind earlier shards' hints like every sibling
+                # raise: the write fails un-acked.
+                self._discard_hinted(hinted)
                 raise Error(
-                    f"{call.name} unavailable: an owner of shard {shard} "
-                    "is DOWN and a degraded bit-removing write would be "
-                    "reverted by anti-entropy on its recovery"
+                    f"write unavailable: every owner of shard {shard} "
+                    f"is DOWN ({', '.join(n.id for n in owners)})"
                 )
-            return alive[0]
+            # All owners DOWN: the last resort keeps replica order —
+            # counted, journaled, and stamped onto the plan so the
+            # /debug/plans analyzer can say WHY this read went to a
+            # node the failure detector distrusts.
+            REGISTRY.inc(METRIC_REPLICA_READS, route="last_resort")
+            cluster.journal.append(
+                "replica.last_resort", index=index, shard=shard,
+                owners=[n.id for n in owners],
+            )
+            p = plans_mod.current_plan()
+            if p is not None:
+                p.note_op(
+                    op=call.name if call is not None else "read",
+                    last_resort=True, shard=shard,
+                )
+            return owners[0]
+        if is_write:
+            # The DOWN-owner check runs even when this node owns the
+            # shard locally: a write applied here while a CO-owner is
+            # DOWN still needs that co-owner's miss queued (or, for
+            # destructive calls without a queue, the loud failure) —
+            # the pre-hint local-win fast path silently skipped it.
+            if call.name in _DESTRUCTIVE_CALLS and len(alive) < len(owners):
+                # Hinted handoff (docs/durability.md): the miss queues
+                # durably for replay on recovery instead of failing the
+                # write — the recovered owner receives the clear BEFORE
+                # anti-entropy can merge against it.  Only when the
+                # queue cannot absorb it (no manager / overflow /
+                # expiry) does this fall back to PR 11's loud failure.
+                down = [n for n in owners if n.state == "DOWN"]
+                h = self._hint_down_writes(
+                    index, shard, down, call, shards=[shard],
+                    dedup=hinted, all_or_nothing=True,
+                )
+                if h < len(down):
+                    # The whole call fails un-acked: earlier shards'
+                    # hints (routing runs before ANY shard maps, so
+                    # nothing has applied) are phantoms — unwind them.
+                    self._discard_hinted(hinted)
+                    raise Error(
+                        f"{call.name} unavailable: an owner of shard "
+                        f"{shard} is DOWN, the hint queue could not "
+                        "absorb the miss, and a degraded bit-removing "
+                        "write would be reverted by anti-entropy on "
+                        "its recovery"
+                    )
+            return local if local is not None else alive[0]
         mode = (opt.replica_read or cluster.replica_read) if opt else (
             cluster.replica_read
         )
@@ -1029,8 +1081,20 @@ class Executor:
                 if n.id not in down_ids
             ]
             if not owners:
+                if call is not None and call.name in _WRITE_CALLS:
+                    # Same unwind as the sibling raise paths: the
+                    # write fails un-acked, so hints queued by earlier
+                    # routing/transport handling must not replay.
+                    self._discard_hinted(budget.get("hinted"))
                 raise Error(f"no available node for shard {s}")
-            target = self._read_route(index, s, owners, call, opt)
+            # The hinted-dedup set rides the shared budget dict: a
+            # hedge recursion re-routes shards through _read_route
+            # again, and a (node, shard) miss already queued must not
+            # be double-queued as a second hint.
+            target = self._read_route(
+                index, s, owners, call, opt,
+                hinted=budget.setdefault("hinted", {}),
+            )
             # [target, shards, every-shard-routed-to-its-primary?] —
             # the primary verdict is recorded HERE, where the owners
             # list is already in hand, so the metric label below never
@@ -1092,8 +1156,52 @@ class Executor:
                     raise
                 if code is None:
                     self.cluster.node_failed(node_id)
+                    if call is not None and call.name in _WRITE_CALLS:
+                        # A write whose forward died in transport: the
+                        # peer may have missed it entirely, and the
+                        # recursion below re-routes these shards to
+                        # another replica — so the miss must be queued
+                        # as a hint NOW (replayed idempotently on
+                        # recovery) or a destructive call would leave
+                        # the failed owner holding bits anti-entropy
+                        # will resurrect.  Unabsorbable destructive
+                        # misses fail loudly: the client never got an
+                        # ack, so nothing acked can be lost.
+                        failed = self.cluster.node_by_id(node_id)
+                        dedup = budget.setdefault("hinted", {})
+                        h = 0
+                        if failed is not None:
+                            for s in node_shards:
+                                h += self._hint_down_writes(
+                                    index, s, [failed], call,
+                                    shards=[s], dedup=dedup,
+                                    all_or_nothing=(
+                                        call.name in _DESTRUCTIVE_CALLS
+                                    ),
+                                )
+                        if (
+                            call.name in _DESTRUCTIVE_CALLS
+                            and h < len(node_shards)
+                        ):
+                            # Failing the whole call: unwind every hint
+                            # it queued (this group's AND earlier
+                            # routing's) — the client gets an error,
+                            # so none of them may replay.
+                            self._discard_hinted(dedup)
+                            raise Error(
+                                f"{call.name} unavailable: the forward "
+                                f"to {node_id} failed in transport and "
+                                "the hint queue could not absorb the "
+                                "miss — a partial bit-removing write "
+                                "would be reverted by anti-entropy on "
+                                "its recovery"
+                            ) from e
                 budget["left"] -= 1
                 if budget["left"] < 0:
+                    if call is not None and call.name in _WRITE_CALLS:
+                        # Same unwind as the destructive gate: the
+                        # write is failing un-acked.
+                        self._discard_hinted(budget.get("hinted"))
                     raise Error(
                         f"replica hedge budget exhausted at node "
                         f"{node_id}: {e}"
@@ -2264,6 +2372,77 @@ class Executor:
             destructive=True,
         )
 
+    def _hint_down_writes(
+        self, index, shard, down, call, shards=None, dedup=None,
+        all_or_nothing=False,
+    ):
+        """Durably queue the missed write for each DOWN owner (hinted
+        handoff, docs/durability.md): the hint record carries the
+        serialized call, replayed with remote=True against the
+        recovered owner by the HintManager's worker.  Returns how many
+        of ``down`` were absorbed — the caller applies the PR 11
+        fallback policy to the rest.  ``dedup`` ({(node, shard): seq}
+        scoped to one logical write) keeps a hedge-recursion re-route
+        from double-queuing the same miss.  ``all_or_nothing`` (the
+        destructive-gate contract) ROLLS BACK this call's fresh
+        enqueues and returns 0 when any of ``down`` could not be
+        absorbed: the caller is about to fail the write without an
+        ack, and a surviving partial hint would replay an op that
+        never happened onto one replica."""
+        hints = getattr(self.cluster, "hints", None)
+        if hints is None:
+            return 0
+        op = {"kind": "query", "query": str(call)}
+        if shards is not None:
+            op["shards"] = [int(s) for s in shards]
+        n = 0
+        fresh = []  # (node_id, dedup key, seq) queued by THIS call
+        for node in down:
+            key = (node.id, shard)
+            if dedup is not None and key in dedup:
+                n += 1  # already queued by an earlier route of this write
+                continue
+            seq = hints.enqueue(node.id, index, shard, op)
+            if seq:
+                n += 1
+                fresh.append((node.id, key, seq))
+                if dedup is not None:
+                    dedup[key] = seq
+        if all_or_nothing and n < len(down):
+            for node_id, key, seq in fresh:
+                hints.discard(node_id, [seq])
+                if dedup is not None:
+                    dedup.pop(key, None)
+            return 0
+        if n:
+            self._note_hinted(index, call.name, shard, n)
+        return n
+
+    def _discard_hinted(self, dedup):
+        """Unwind EVERY hint a failing logical write queued — across
+        all its shards and targets (the per-call all_or_nothing rolls
+        back only the current shard's batch; the write erroring at a
+        LATER shard must not leave earlier shards' hints to replay an
+        op the client never got an ack for)."""
+        hints = getattr(self.cluster, "hints", None)
+        if hints is None or not dedup:
+            return
+        for (node_id, _shard), seq in list(dedup.items()):
+            hints.discard(node_id, [seq])
+        dedup.clear()
+
+    def _note_hinted(self, index, op_name, shard, n):
+        """One hinted write: journal + plan stamp (the analyzer's
+        "owner DOWN: queued as hint" annotation feeds off the op
+        note; the pilosa_hints_* series are counted by the manager)."""
+        self.cluster.journal.append(
+            "write.hinted", index=index, op=op_name, shard=int(shard),
+            owners=int(n),
+        )
+        p = plans_mod.current_plan()
+        if p is not None:
+            p.note_op(op=op_name, hinted=int(n), shard=int(shard))
+
     def _write_replicated(
         self, index, c: Call, col_id: int, opt, local_fn,
         destructive: bool = False,
@@ -2274,34 +2453,52 @@ class Executor:
         local.
 
         DEGRADED policy (docs/durability.md): an owner the failure
-        detector has marked DOWN is SKIPPED for purely-ADDITIVE sets —
-        the surviving owners take the write and anti-entropy seeds the
-        dead one on recovery (majority-vote ties resolve to set, so the
-        survivor's bit wins).  DESTRUCTIVE writes never degrade: a
-        Clear — or any write that implicitly clears bits (mutex/bool
-        sets displacing the previous row, BSI sets rewriting value
-        planes) — acked on the lone survivor would be partially
-        REVERTED by that same tie rule when the dead owner recovers
-        still holding the old bits, so those fail loudly instead of
-        acking a write anti-entropy will undo.  Every owner DOWN fails
-        loudly: there is no replica to make the ack durable on.  An
-        owner that is not yet marked DOWN but fails the forward also
-        fails the write loudly — the client never got an ack, so
-        nothing acked can be lost."""
+        detector has marked DOWN has the miss durably QUEUED as a hint
+        record for replay on recovery (hinted handoff) — the surviving
+        owners take the write now and the recovered owner receives it
+        before anti-entropy can merge against it.  When the hint queue
+        cannot absorb the miss (no manager / overflow / expiry) the
+        policy falls back verbatim to PR 11: purely-ADDITIVE sets skip
+        the dead owner (anti-entropy seeds it on recovery — majority
+        ties resolve to set, so the survivor's bit wins) while
+        DESTRUCTIVE writes fail loudly — a Clear, or any write that
+        implicitly clears bits (mutex/bool sets displacing the previous
+        row, BSI sets rewriting value planes), acked on the lone
+        survivor would be partially REVERTED by that same tie rule when
+        the dead owner recovers still holding the old bits.  Every
+        owner DOWN fails loudly: there is no replica to make the ack
+        durable on.  An owner that is not yet marked DOWN but fails the
+        forward also fails the write loudly — the client never got an
+        ack, so nothing acked can be lost."""
         if self.cluster is None:
             return local_fn()
         shard = col_id // SHARD_WIDTH
         owners = self.cluster.shard_nodes(index, shard)
+        if opt.remote:
+            # Directed delivery (replication forward or hint replay):
+            # the sender already ran the degraded-write policy — apply
+            # locally when this node is an owner, no re-gating (a
+            # replay must land even while some OTHER owner is DOWN).
+            if any(n.id == self.cluster.node.id for n in owners):
+                return bool(local_fn())
+            return False
         live = [n for n in owners if n.state != "DOWN"]
+        down = [n for n in owners if n.state == "DOWN"]
         if not live:
             raise Error(
                 f"write unavailable: every owner of shard {shard} is DOWN "
                 f"({', '.join(n.id for n in owners)})"
             )
-        if destructive and len(live) < len(owners):
+        hinted = 0
+        if down:
+            hinted = self._hint_down_writes(
+                index, shard, down, c, all_or_nothing=destructive,
+            )
+        if destructive and hinted < len(down):
             raise Error(
-                f"{c.name} unavailable: owner of shard {shard} is DOWN "
-                "and a degraded bit-removing write would be reverted by "
+                f"{c.name} unavailable: owner of shard {shard} is DOWN, "
+                "the hint queue could not absorb the miss, and a "
+                "degraded bit-removing write would be reverted by "
                 "anti-entropy's majority-tie-to-set merge on recovery"
             )
         ret = False
@@ -2309,8 +2506,6 @@ class Executor:
             if node.id == self.cluster.node.id:
                 if local_fn():
                     ret = True
-                continue
-            if opt.remote:
                 continue
             doc = self.cluster.client(node).query(index, str(c), remote=True)
             if doc["results"][0]:

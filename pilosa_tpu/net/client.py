@@ -162,6 +162,24 @@ class InternalClient:
     ):
         self.requests += 1
         self._requests_counter.inc()
+        # Deterministic fault plane (net/faults.py): an injected drop or
+        # partition surfaces as a transport-style ClientError (code None
+        # — the executor's failure-verdict shape), an injected error as
+        # the configured status, BEFORE any bytes leave this host.  The
+        # inactive-plane cost is one attribute read.
+        from .faults import PLANE
+
+        if PLANE.active:
+            rule = PLANE.intercept(f"{self._host}:{self._port}", path)
+            if rule is not None:
+                if rule.action == "error":
+                    raise ClientError(
+                        f"{method} {path}: {rule.status}: injected fault",
+                        code=rule.status, body="injected fault",
+                    )
+                raise ClientError(
+                    f"{method} {path}: injected fault: {rule.action}"
+                )
         headers = {"Content-Type": content_type} if body is not None else {}
         # Propagate the ambient trace context (trace id + this hop's
         # span id) so a remote shard fan-out joins the caller's trace —

@@ -474,6 +474,18 @@ def _encode_node_status(msg: dict) -> bytes:
             103, _str_field(1, iname) + _varint_field(2, int(v))
         )
     out += _varint_field(104, int(msg.get("aePasses", 0)))
+    # 105: pending-hint advertisement entries ({target node id: count},
+    # hinted handoff); 106: presence marker so a receiver can tell "no
+    # pending hints" (empty map — clears the previous advertisement)
+    # from "sender predates hinted handoff" (field absent — leave the
+    # previous advertisement untouched).
+    ph = msg.get("pendingHints")
+    if ph is not None:
+        out += _varint_field(106, 1)
+        for target, count in ph.items():
+            out += _len_field(
+                105, _str_field(1, str(target)) + _varint_field(2, int(count))
+            )
     return out
 
 
@@ -574,6 +586,24 @@ def _decode_node_status(r: _Reader) -> dict:
                 msg["versions"][vname] = vval
         elif f == 104:
             msg["aePasses"] = r.uvarint()
+        elif f == 105:
+            hr = _Reader(r.bytes_())
+            hname, hval = "", 0
+            while not hr.eof():
+                hf, hw = hr.tag()
+                if hf == 1:
+                    hname = hr.str_()
+                elif hf == 2:
+                    hval = hr.uvarint()
+                else:
+                    hr.skip(hw)
+            if hname:
+                if msg.get("pendingHints") is None:
+                    msg["pendingHints"] = {}
+                msg["pendingHints"][hname] = hval
+        elif f == 106:
+            if r.uvarint() and msg.get("pendingHints") is None:
+                msg["pendingHints"] = {}
         else:
             r.skip(w)
     for iname, fields in shards_by_index.items():

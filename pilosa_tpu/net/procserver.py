@@ -664,9 +664,16 @@ class ProcessHTTPServer:
                 status, ctype, payload = (
                     200, "application/json", json.dumps(result).encode()
                 )
-            conn.send_response(rid, status, ctype, payload)
         finally:
+            # Release BEFORE the send, matching the DeferredResponse
+            # branch above and the async backend's finish(): once a
+            # client holds its response, its admission slot must
+            # already be free — releasing after the send let a client
+            # act on the response milliseconds before the slot freed,
+            # and anything keying on in-flight state (tenant fair
+            # shares, the smoke's saturate-then-shed stage) raced it.
             release_once()
+        conn.send_response(rid, status, ctype, payload)
 
     # -- scrape-time aggregation --------------------------------------------
 

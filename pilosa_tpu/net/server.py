@@ -214,6 +214,8 @@ class Handler:
         r("GET", "/debug/traces", self._debug_traces)
         r("GET", "/debug/events", self._debug_events)
         r("GET", "/debug/plans", self._debug_plans)
+        r("GET", "/debug/faults", self._debug_faults_get)
+        r("POST", "/debug/faults", self._debug_faults_post)
         r("GET", "/debug/pprof", self._debug_pprof)
         r("GET", "/debug/pprof/goroutine", self._debug_pprof)
         r("GET", "/debug/pprof/profile", self._debug_pprof_profile)
@@ -396,11 +398,22 @@ class Handler:
         return {"name": "pilosa-tpu", "version": self.api.version()}
 
     def _status(self, q, b, **kw):
-        return {
+        doc = {
             "state": self.api.state(),
             "nodes": self.api.hosts(),
             "localID": self.api.node()["id"],
         }
+        # Pending-hint advertisement (hinted handoff): the syncer's
+        # SYNCHRONOUS pre-pass check fetches this from every live peer
+        # — gossiped advertisements alone lose the race against a
+        # stale node whose first post-heal pass would push reverted
+        # bits before any broadcast lands (docs/durability.md).
+        cluster = self.api.cluster
+        hints = getattr(cluster, "hints", None) if cluster else None
+        if hints is not None:
+            doc["pendingHints"] = hints.pending_map()
+            doc["aePasses"] = cluster.ae_passes
+        return doc
 
     def _get_index(self, q, b, *, index, **kw):
         idx = self.api.index(index)
@@ -865,6 +878,36 @@ class Handler:
             return {"recent": [], "slow": []}
         return tracer.traces()
 
+    def _debug_faults_get(self, q, b, **kw):
+        """GET /debug/faults: the node's fault-plane rule table with
+        per-rule matched/injected tallies — a chaos script asserts its
+        partition actually fired from here."""
+        from .faults import PLANE
+
+        return PLANE.snapshot()
+
+    def _debug_faults_post(self, q, b, **kw):
+        """POST /debug/faults: REPLACE the rule table at runtime (the
+        chaos lanes' injection channel).  Body: {"seed": N, "rules":
+        [spec, ...]} — specs as dicts or "action k=v" strings; an empty
+        rules list heals everything.  Reseeds on every install, so
+        re-POSTing one schedule replays the same verdict sequence
+        (deterministic by construction)."""
+        from .faults import PLANE
+
+        doc = json.loads(b) if b else {}
+        try:
+            PLANE.configure(doc.get("rules", []), doc.get("seed"))
+        except ValueError as e:
+            raise ApiError(str(e)) from None
+        journal = getattr(self.api, "journal", None)
+        if journal is not None:
+            journal.append(
+                "faults.configure", rules=len(doc.get("rules", [])),
+                seed=PLANE.seed, via="http",
+            )
+        return PLANE.snapshot()
+
     def _debug_vars(self, q, b, **kw):
         stats = getattr(self.api.executor, "stats", None)
         out = (
@@ -912,6 +955,18 @@ class Handler:
         # warm-start progress.
         if self.api.cluster is not None:
             out["clusterHeartbeats"] = self.api.cluster.heartbeats()
+            # Hinted handoff: pending replay queues + lifetime tallies,
+            # the JSON twin of the pilosa_hints_* series.
+            hints = getattr(self.api.cluster, "hints", None)
+            if hints is not None:
+                out["hints"] = hints.stats()
+        # Fault plane: surfaced whenever rules are installed so an
+        # operator debugging "why is this cluster weird" sees the
+        # scripted chaos instead of chasing a phantom network issue.
+        from .faults import PLANE
+
+        if PLANE.active:
+            out["faults"] = PLANE.snapshot()
         ws = self.api.warm_status()
         if ws is not None:
             out["warmStart"] = ws

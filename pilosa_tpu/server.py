@@ -66,6 +66,38 @@ class Server:
                 f"{self.config.cluster_replica_read!r}: expected "
                 "primary, any, or bounded"
             )
+        try:
+            holddown = float(self.config.cluster_recovery_holddown_ms)
+        except (TypeError, ValueError):
+            holddown = -1.0
+        if holddown < 0:
+            raise ValueError(
+                f"[cluster] recovery-holddown-ms = "
+                f"{self.config.cluster_recovery_holddown_ms!r}: expected "
+                "a non-negative number of milliseconds"
+            )
+        if int(self.config.cluster_hint_max_bytes) < 0:
+            raise ValueError(
+                f"[cluster] hint-max-bytes = "
+                f"{self.config.cluster_hint_max_bytes!r}: expected >= 0 "
+                "(0 disables hinted handoff)"
+            )
+        if float(self.config.cluster_hint_max_age) <= 0:
+            raise ValueError(
+                f"[cluster] hint-max-age = "
+                f"{self.config.cluster_hint_max_age!r}: expected a "
+                "positive duration"
+            )
+        # Fault-plane rules fail fast at construction too: a typo'd
+        # chaos schedule must die HERE naming the spec, not at the
+        # first intercepted request mid-drill.
+        from .net import faults as faults_mod
+
+        for spec in self.config.faults_rules:
+            try:
+                faults_mod.parse_rule(spec)
+            except ValueError as e:
+                raise ValueError(f"[faults] rules: {e}") from None
         self.data_dir = os.path.expanduser(self.config.data_dir)
         self.logger = self._make_logger()
         self.stats = self._make_stats()
@@ -83,6 +115,7 @@ class Server:
         # served at GET /debug/events and mirrored into the log.
         self.journal = EventJournal(node=self.node_id, logger=self.logger)
         self.api: Optional[API] = None
+        self.hints = None  # HintManager, wired in _setup_cluster
         self._http = None
         self._http_thread = None
         self._closing = threading.Event()
@@ -201,6 +234,7 @@ class Server:
             )
         self.translate_store.open()
         self._setup_cluster(host, port)
+        self._setup_faults(host, port)
         # Parallel snapshot re-open (warm-start, docs/durability.md):
         # fragment decode is numpy-heavy and releases the GIL, so a
         # restart with a big holder comes up in parallel workers.
@@ -471,6 +505,27 @@ class Server:
         # Replica-read routing policy (docs/durability.md).
         self.cluster.replica_read = self.config.cluster_replica_read
         self.cluster.freshness_ms = self.config.cluster_freshness_ms
+        self.cluster.recovery_holddown = (
+            float(self.config.cluster_recovery_holddown_ms) / 1000.0
+        )
+        # Hinted handoff (docs/durability.md): durable bounded replay
+        # queues for writes to DOWN owners; hint-max-bytes 0 keeps the
+        # pre-hint skip-or-fail-loud policy.
+        if int(self.config.cluster_hint_max_bytes) > 0:
+            from .cluster.hints import HintManager
+
+            self.hints = HintManager(
+                self.data_dir,
+                node_id=self.node_id,
+                max_bytes=self.config.cluster_hint_max_bytes,
+                max_age=self.config.cluster_hint_max_age,
+                ack=self.config.storage_ack,
+                journal=self.journal,
+                logger=self.logger,
+            )
+            self.hints.cluster = self.cluster
+            self.cluster.hints = self.hints
+            self.hints.start()
         if (
             not self.config.cluster_hosts
             and not self.config.gossip_seeds
@@ -599,6 +654,27 @@ class Server:
             )
             t.start()
             self._monitors.append(t)
+
+    def _setup_faults(self, host: str, port: int):
+        """Stamp this node's identity onto the process-global fault
+        plane (partition-group membership tests against it) and install
+        any boot-time [faults] rules.  Identity = node id + advertised
+        HTTP endpoint + bound gossip endpoint, so one partition body
+        POSTed to every node lets each enforce only its own side."""
+        from .net.faults import PLANE
+
+        addrs = {self.node_id, _advertise_uri(host, port, self.scheme)}
+        if getattr(self, "gossip", None) is not None:
+            addrs.add(f"{self.gossip.addr[0]}:{self.gossip.addr[1]}")
+        PLANE.set_local(addrs)
+        if self.config.faults_rules:
+            PLANE.configure(
+                self.config.faults_rules, self.config.faults_seed
+            )
+            self.journal.append(
+                "faults.configure", rules=len(self.config.faults_rules),
+                seed=self.config.faults_seed, via="config",
+            )
 
     @property
     def scheme(self) -> str:
@@ -764,6 +840,10 @@ class Server:
             self._membership_events.put(None)
         if getattr(self, "gossip", None) is not None:
             self.gossip.close()
+        if self.hints is not None:
+            # Stop the replay worker and flush the queue files: pending
+            # hints are DURABLE — a restart reloads and resumes replay.
+            self.hints.close()
         # Close ORDER is load-bearing for shutdown scrapes: the mesh
         # engine closes only AFTER the HTTP socket stops accepting, and
         # engine.close() itself flushes the resident-bytes gauges under

@@ -318,6 +318,21 @@ def analyze(plan: QueryPlan, slow: bool = False) -> List[str]:
                     f"fusion: {mr} mask references evaluated as {me} "
                     f"distinct masks ({mr - me} evaluation(s) saved)"
                 )
+    # Degraded-routing annotations (docs/durability.md), aggregated to
+    # ONE note each — a 100-shard query on an all-DOWN owner set stamps
+    # one op per shard, and 100 identical notes would drown the plan.
+    lr_shards = sum(1 for op in plan.ops if op.get("last_resort"))
+    if lr_shards:
+        notes.append(
+            f"all owners DOWN: last-resort primary read "
+            f"({lr_shards} shard{'s' if lr_shards != 1 else ''})"
+        )
+    hinted = sum(int(op.get("hinted", 0) or 0) for op in plan.ops)
+    if hinted:
+        notes.append(
+            f"owner DOWN: write durably queued as hint for replay "
+            f"({hinted} miss{'es' if hinted != 1 else ''})"
+        )
     if plan.fanouts:
         n_remote = sum(k for _, _, k in plan.fanouts)
         n_local = 0

@@ -440,6 +440,42 @@ METRIC_REPLICA_READS = "pilosa_replica_reads_total"
 METRIC_INGEST_DEGRADED_BATCHES = "pilosa_ingest_degraded_batches_total"
 METRIC_CLIENT_RETRIES = "pilosa_client_retries_total"
 
+# -- hinted handoff (docs/durability.md "Hinted handoff") -------------------
+#   pilosa_hints_queued_total               writes to a DOWN owner durably
+#                                           queued as hint records for replay
+#   pilosa_hints_replayed_total             hint records acked by their
+#                                           recovered target
+#   pilosa_hints_dropped_total{reason=}     hint records dropped WITHOUT
+#                                           replay (overflow | expired |
+#                                           rejected | node_removed |
+#                                           io_error | rolled_back) — each
+#                                           drop is a fall-back to the PR 11
+#                                           skip-or-fail-loud policy
+#                                           (rolled_back = the unwind of a
+#                                           destructive write whose gate
+#                                           failed after partial enqueue)
+#   pilosa_hints_pending                    gauge: queued records awaiting
+#                                           replay (all targets)
+#   pilosa_hints_pending_bytes              gauge: their on-disk bytes
+#                                           (bounded by [cluster]
+#                                           hint-max-bytes)
+METRIC_HINTS_QUEUED = "pilosa_hints_queued_total"
+METRIC_HINTS_REPLAYED = "pilosa_hints_replayed_total"
+METRIC_HINTS_DROPPED = "pilosa_hints_dropped_total"
+METRIC_HINTS_PENDING = "pilosa_hints_pending"
+METRIC_HINTS_PENDING_BYTES = "pilosa_hints_pending_bytes"
+HINT_DROP_REASONS = (
+    "overflow", "expired", "rejected", "node_removed", "io_error",
+    "rolled_back",
+)
+
+# -- fault plane (docs/durability.md "Fault plane") -------------------------
+#   pilosa_faults_injected_total{action=}   deterministic fault-plane
+#                                           injections at the client/gossip
+#                                           boundaries (drop | delay | error
+#                                           | partition)
+METRIC_FAULTS_INJECTED = "pilosa_faults_injected_total"
+
 # -- per-tenant cost attribution (docs/observability.md) --------------------
 #   pilosa_tenant_queries_total{tenant=}        queries executed
 #   pilosa_tenant_device_seconds_total{tenant=} attributed device-seconds
@@ -615,11 +651,33 @@ REGISTRY.counter(
     help="Warm-sync passes the ingest sync worker ran",
 )
 REGISTRY.set_gauge(METRIC_INGEST_ACKED_UNSYNCED, 0)
-for _route in ("primary", "replica", "hedge"):
+for _route in ("primary", "replica", "hedge", "last_resort"):
     REGISTRY.counter(
         METRIC_REPLICA_READS,
         help="Reads routed off-node by the shard mapper",
         route=_route,
+    )
+REGISTRY.counter(
+    METRIC_HINTS_QUEUED,
+    help="Writes to DOWN owners durably queued as hint records",
+)
+REGISTRY.counter(
+    METRIC_HINTS_REPLAYED,
+    help="Hint records acked by their recovered target",
+)
+for _reason in HINT_DROP_REASONS:
+    REGISTRY.counter(
+        METRIC_HINTS_DROPPED,
+        help="Hint records dropped without replay (policy fallback)",
+        reason=_reason,
+    )
+REGISTRY.set_gauge(METRIC_HINTS_PENDING, 0)
+REGISTRY.set_gauge(METRIC_HINTS_PENDING_BYTES, 0)
+for _action in ("drop", "delay", "error", "partition"):
+    REGISTRY.counter(
+        METRIC_FAULTS_INJECTED,
+        help="Deterministic fault-plane injections",
+        action=_action,
     )
 REGISTRY.counter(
     METRIC_INGEST_DEGRADED_BATCHES,

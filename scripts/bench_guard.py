@@ -96,6 +96,17 @@ AUTO_REQUIRE = (
     # baselined so a later PR cannot silently drop the chaos lane.
     "availability_under_failure_pct",
     "replica_read_qps_gain",
+    # Hinted-handoff headline (bench.py --chaos-sweep, docs/durability.md
+    # "Hinted handoff"): the fraction of DESTRUCTIVE writes (Clears on
+    # shards the failed node owns) that ack through the degraded steady
+    # state.  0 before hinted handoff, 100 with it; ABS_FLOORed at 90 so
+    # a regression to the fail-loud policy can never pass as "new
+    # metric, no baseline".
+    "destructive_write_availability_pct",
+    # Partition-heal headline (bench.py --chaos-sweep --fault partition):
+    # heal -> cluster NORMAL + hint queues drained + bit-exact
+    # convergence; seconds regress UP via the unit map.
+    "partition_heal_seconds",
     # Whole-program fusion headlines (bench.py --dashboard-sweep,
     # docs/fusion.md): widget answers/second through the fused N=8
     # mixed drain, its drain-wall p50, and the fused-vs-sequential
@@ -113,6 +124,7 @@ AUTO_REQUIRE = (
 # and regresses DOWN too.
 NAME_HIGHER_BETTER = {
     "availability_under_failure_pct",
+    "destructive_write_availability_pct",
     "replica_read_qps_gain",
     "dashboard_fused_speedup",
 }
@@ -143,6 +155,7 @@ ABS_CEILING = {"profile_overhead_pct": 2.0}
 # by >=1.5x (the whole-program fusion acceptance, docs/fusion.md).
 ABS_FLOOR = {
     "availability_under_failure_pct": 90.0,
+    "destructive_write_availability_pct": 90.0,
     "dashboard_fused_speedup": 1.5,
 }
 

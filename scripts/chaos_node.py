@@ -11,7 +11,15 @@ in seconds instead of minutes.
 
   python scripts/chaos_node.py NODE_ID HTTP_PORT GOSSIP_PORT \
       SEED_PORT DATA_DIR [--replicas 2] [--ack logged] \
-      [--ae-interval 1.5]
+      [--ae-interval 1.5] [--recovery-holddown-ms 15000] \
+      [--hint-max-bytes N] [--replica-read MODE]
+
+``--recovery-holddown-ms`` matters for the partition drills: the
+default 15 s holddown (docs/durability.md) is the production guard
+against acceptor-wedged flapping, but a heal-and-measure drill wants
+recovery within a couple of gossip probes.  ``--hint-max-bytes 0``
+disables hinted handoff (the PR 11 skip-or-fail-loud policy) so a
+drill can demonstrate the before/after.
 
 Prints ``READY <node_id>`` on stdout once serving, then sleeps until
 killed — the callers SIGKILL/terminate it by design.
@@ -33,6 +41,9 @@ def main() -> None:
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--ack", default="logged")
     ap.add_argument("--ae-interval", type=float, default=1.5)
+    ap.add_argument("--recovery-holddown-ms", type=float, default=15000.0)
+    ap.add_argument("--hint-max-bytes", type=int, default=None)
+    ap.add_argument("--replica-read", default=None)
     args = ap.parse_args()
 
     from pilosa_tpu.config import Config
@@ -45,6 +56,11 @@ def main() -> None:
     cfg.cluster_replicas = args.replicas
     cfg.storage_ack = args.ack
     cfg.anti_entropy_interval = args.ae_interval
+    cfg.cluster_recovery_holddown_ms = args.recovery_holddown_ms
+    if args.hint_max_bytes is not None:
+        cfg.cluster_hint_max_bytes = args.hint_max_bytes
+    if args.replica_read is not None:
+        cfg.cluster_replica_read = args.replica_read
     cfg.gossip_port = args.gossip_port
     if args.node_id != "n0":
         cfg.gossip_seeds = [f"127.0.0.1:{args.seed_port}"]

@@ -82,6 +82,13 @@ required = [
     "pilosa_replica_reads_total",
     "pilosa_ingest_degraded_batches_total",
     "pilosa_client_retries_total",
+    # Hinted handoff + the deterministic fault plane
+    # (docs/durability.md "Hinted handoff" / "Fault plane").
+    "pilosa_hints_queued_total",
+    "pilosa_hints_replayed_total",
+    "pilosa_hints_dropped_total",
+    "pilosa_hints_pending",
+    "pilosa_faults_injected_total",
     # Whole-program fusion (docs/fusion.md).
     "pilosa_engine_fused_program_programs_total",
     "pilosa_engine_fused_program_queries_total",
@@ -852,6 +859,155 @@ try:
     print("chaos drill OK: SIGKILL mid-ingest -> degraded acks -> "
           "readyz warming->ready -> anti-entropy bit-exact "
           f"({len(acked)} acked bits, zero lost)")
+finally:
+    for p in procs:
+        try:
+            p.kill()
+        except ProcessLookupError:
+            pass
+    for p in procs:
+        p.communicate(timeout=30)
+EOF
+
+# Partition + hinted-handoff drill (docs/durability.md "Hinted
+# handoff"): a 2-node cluster is PARTITIONED via the deterministic
+# fault plane (POST /debug/faults — no process dies); a DESTRUCTIVE
+# clear driven through the degraded window must ACK (it failed loudly
+# before hinted handoff) with the miss durably queued; after healing,
+# the pilosa_hints_{queued,replayed} series prove the replay ran and
+# the partitioned node converges bit-exactly WITHOUT anti-entropy
+# resurrecting the cleared bit.
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, socket, subprocess, sys, tempfile, time
+import urllib.request
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+def post(port, path, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://localhost:{port}{path}", data=body, method="POST")
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+def get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://localhost:{port}{path}", timeout=timeout) as resp:
+        return resp.read()
+
+def getj(port, path, timeout=10):
+    return json.loads(get(port, path, timeout))
+
+tmp = tempfile.mkdtemp()
+script = os.path.join(os.getcwd(), "scripts", "chaos_node.py")
+ports = [free_port(), free_port()]
+gports = [free_port(), free_port()]
+env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+procs = [
+    subprocess.Popen(
+        [sys.executable, script, f"n{i}", str(ports[i]), str(gports[i]),
+         str(gports[0]), os.path.join(tmp, f"n{i}"),
+         "--ack", "logged", "--ae-interval", "1.5",
+         "--recovery-holddown-ms", "500"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    for i in range(2)
+]
+try:
+    for p in procs:
+        assert p.stdout.readline().startswith("READY"), "server did not boot"
+    end = time.time() + 30
+    while time.time() < end:
+        sts = [getj(ports[i], "/status") for i in range(2)]
+        if all(len(s["nodes"]) == 2 and s["state"] == "NORMAL" for s in sts):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"membership never converged: {sts}")
+
+    from pilosa_tpu.ops import SHARD_WIDTH
+    post(ports[0], "/index/i", b"{}")
+    post(ports[0], "/index/i/field/f", b'{"options": {"type": "set"}}')
+    cols = [s * SHARD_WIDTH + k for s in range(4) for k in range(8)]
+    post(ports[0], "/index/i/field/f/import",
+         json.dumps({"rowIDs": [1] * len(cols), "columnIDs": cols}).encode())
+    end = time.time() + 30
+    while time.time() < end:
+        oracle = post(ports[0], "/index/i/query", b"Count(Row(f=1))",
+                      timeout=60)["results"][0]
+        if oracle == len(cols):
+            break
+        time.sleep(0.3)
+    assert oracle == len(cols), (oracle, len(cols))
+
+    # Partition n1 from n0: one deterministic rule body to BOTH nodes.
+    partition = json.dumps({
+        "seed": 5,
+        "rules": [{
+            "action": "partition",
+            "a": [f"127.0.0.1:{ports[1]}", f"127.0.0.1:{gports[1]}"],
+            "b": [f"127.0.0.1:{ports[0]}", f"127.0.0.1:{gports[0]}"],
+        }],
+    }).encode()
+    for p in ports:
+        doc = post(p, "/debug/faults", partition)
+        assert doc["active"], doc
+    end = time.time() + 30
+    while time.time() < end:
+        if getj(ports[0], "/status")["state"] != "NORMAL":
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("partition verdict never landed")
+
+    # THE destructive write through the degraded window: acked, with
+    # the miss durably queued for n1 (this exact call failed loudly
+    # before hinted handoff).
+    out = post(ports[0], "/index/i/query", b"Clear(0, f=1)", timeout=30)
+    assert out["results"][0] is True, out
+    dv = getj(ports[0], "/debug/vars")
+    assert dv.get("hints", {}).get("pending", {}).get("n1") == 1, dv.get("hints")
+    text = get(ports[0], "/metrics").decode()
+    assert "pilosa_hints_queued_total 1" in text, "queued series missing"
+    assert "pilosa_faults_injected_total" in text
+
+    # Heal; the replay worker drains the hint, the series prove it,
+    # and n1's local truth converges bit-exactly — the cleared bit
+    # does NOT come back through anti-entropy.
+    for p in ports:
+        post(p, "/debug/faults", json.dumps({"rules": []}).encode())
+    end = time.time() + 60
+    while time.time() < end:
+        dv = getj(ports[0], "/debug/vars")
+        if not dv.get("hints", {}).get("pending"):
+            break
+        time.sleep(0.3)
+    else:
+        raise AssertionError(f"hint never replayed: {dv.get('hints')}")
+    text = get(ports[0], "/metrics").decode()
+    assert "pilosa_hints_replayed_total 1" in text, "replayed series missing"
+    end = time.time() + 45
+    n1 = -1
+    while time.time() < end:
+        n1 = post(ports[1], "/index/i/query",
+                  json.dumps({"query": "Count(Row(f=1))", "remote": True,
+                              "shards": sorted({c // SHARD_WIDTH for c in cols})
+                              }).encode(), timeout=60)["results"][0]
+        if n1 == len(cols) - 1:
+            break
+        time.sleep(0.5)
+    assert n1 == len(cols) - 1, (n1, len(cols) - 1)
+    time.sleep(3.2)  # two anti-entropy intervals: the clear must HOLD
+    out = post(ports[0], "/index/i/query", b"Count(Row(f=1))", timeout=60)
+    assert out["results"][0] == len(cols) - 1, (
+        f"anti-entropy reverted the clear: {out}")
+    print("partition drill OK: /debug/faults partition -> destructive "
+          "clear ACKED + hinted -> heal -> replay "
+          "(pilosa_hints_queued/replayed=1) -> bit-exact, zero reverts")
 finally:
     for p in procs:
         try:

@@ -337,6 +337,239 @@ def test_sigkill_mid_ingest_drill(tmp_path):
                 p.communicate(timeout=30)
 
 
+def test_partition_heal_drill(tmp_path):
+    """The hinted-handoff acceptance drill (docs/durability.md "Hinted
+    handoff"): a REAL 3-process gossip cluster is PARTITIONED — n1 cut
+    from {n0, n2} via the deterministic fault plane at runtime (POST
+    /debug/faults, one rule body to every node) — instead of killed.
+    Asserts, in order:
+
+    1. Destructive writes become ACKABLE under single-owner failure:
+       every Clear on an n1-owned shard driven through the degraded
+       window acks (0% before hinted handoff), each miss durably queued
+       (pilosa_hints_queued_total > 0, pending visible in /debug/vars).
+    2. Replay-before-readmission: at the moment n0 releases n1's
+       bounded-read quarantine, n1's local truth ALREADY reflects the
+       clears — the replay landed first.
+    3. Zero reverted clears: after heal + two further anti-entropy
+       intervals, no cleared bit resurfaces on ANY replica (the
+       majority-tie-to-set merge never ran against the stale node).
+    """
+    from pilosa_tpu.ops import SHARD_WIDTH
+
+    ports = [_free_port() for _ in range(3)]
+    gports = [_free_port() for _ in range(3)]
+
+    def boot(i):
+        return subprocess.Popen(
+            [
+                sys.executable, str(CHAOS_NODE), f"n{i}", str(ports[i]),
+                str(gports[i]), str(gports[0]), str(tmp_path / f"n{i}"),
+                "--ack", "logged", "--ae-interval", "1.5",
+                # The drill heals and measures recovery: the production
+                # 15s holddown would dominate; the fast setting is the
+                # documented drill tradeoff (docs/durability.md).
+                "--recovery-holddown-ms", "500",
+            ],
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+
+    procs = [boot(i) for i in range(3)]
+    try:
+        _await_ready(procs, 3)
+        end = time.time() + 30
+        while time.time() < end:
+            sts = [_get(ports[i], "/status") for i in range(3)]
+            if all(len(s["nodes"]) == 3 for s in sts) and all(
+                s["state"] == "NORMAL" for s in sts
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"membership never converged: {sts}")
+
+        _post(ports[0], "/index/i", b"{}")
+        _post(ports[0], "/index/i/field/f", b'{"options": {"type": "set"}}')
+        n_shards = 6
+        cols = [
+            s * SHARD_WIDTH + k for s in range(n_shards) for k in range(16)
+        ]
+        _post(
+            ports[0], "/index/i/field/f/import",
+            json.dumps(
+                {"rowIDs": [1] * len(cols), "columnIDs": cols}
+            ).encode(),
+            timeout=60,
+        )
+        end = time.time() + 30
+        while time.time() < end:
+            oracle = _post(
+                ports[0], "/index/i/query", b"Count(Row(f=1))", timeout=60
+            )["results"][0]
+            if oracle == len(cols):
+                break
+            time.sleep(0.3)
+        assert oracle == len(cols), (oracle, len(cols))
+
+        def owners(s):
+            with urllib.request.urlopen(
+                f"http://localhost:{ports[0]}/internal/fragment/nodes"
+                f"?index=i&shard={s}", timeout=10,
+            ) as resp:
+                return {n["id"] for n in json.loads(resp.read())}
+
+        n1_shards = [s for s in range(n_shards) if "n1" in owners(s)]
+        assert n1_shards, "placement gave n1 no shards?"
+
+        # Partition n1 from {n0, n2}: ONE deterministic rule body,
+        # POSTed to every node — each enforces only its own side.
+        partition = json.dumps({
+            "seed": 3,
+            "rules": [{
+                "action": "partition",
+                "a": [f"127.0.0.1:{ports[1]}", f"127.0.0.1:{gports[1]}"],
+                "b": [
+                    f"127.0.0.1:{ports[0]}", f"127.0.0.1:{gports[0]}",
+                    f"127.0.0.1:{ports[2]}", f"127.0.0.1:{gports[2]}",
+                ],
+            }],
+        }).encode()
+        for p in ports:
+            _post(p, "/debug/faults", partition)
+
+        end = time.time() + 30
+        while time.time() < end:
+            if _get(ports[0], "/status")["state"] == "DEGRADED":
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("partition verdict never landed on n0")
+
+        # (1) Destructive writes through the degraded window: EVERY
+        # clear on an n1-owned shard must ack — this exact shape failed
+        # loudly before hinted handoff.
+        cleared = []
+        for s in n1_shards:
+            col = s * SHARD_WIDTH  # k=0, seeded above
+            out = _post(
+                ports[0], "/index/i/query", f"Clear({col}, f=1)".encode(),
+                timeout=30,
+            )
+            assert out["results"][0] is True, (s, out)
+            cleared.append(col)
+        # Reads keep answering exactly through the partition (hedging).
+        out = _post(ports[0], "/index/i/query", b"Count(Row(f=1))", timeout=60)
+        assert out["results"][0] == oracle - len(cleared)
+
+        # The misses are durably queued and visible.
+        dv = _get(ports[0], "/debug/vars")
+        assert dv.get("hints", {}).get("pending", {}).get("n1") == len(
+            cleared
+        ), dv.get("hints")
+        with urllib.request.urlopen(
+            f"http://localhost:{ports[0]}/metrics", timeout=10
+        ) as resp:
+            metrics = resp.read().decode()
+        queued = [
+            ln for ln in metrics.splitlines()
+            if ln.startswith("pilosa_hints_queued_total")
+        ]
+        assert queued and float(queued[0].rsplit(" ", 1)[1]) >= len(cleared)
+
+        # Heal: empty rule tables everywhere.
+        for p in ports:
+            _post(p, "/debug/faults", json.dumps({"rules": []}).encode())
+
+        # (2) Replay-before-readmission: poll n0's quarantine view of
+        # n1; the FIRST time it reads released, n1's local truth must
+        # already hold every clear.
+        def n1_local_count():
+            return _post(
+                ports[1], "/index/i/query",
+                json.dumps({
+                    "query": "Count(Row(f=1))", "remote": True,
+                    "shards": n1_shards,
+                }).encode(), timeout=30,
+            )["results"][0]
+
+        expect_n1 = 16 * len(n1_shards) - len(cleared)
+        end = time.time() + 60
+        released = False
+        while time.time() < end:
+            hb = _get(ports[0], "/debug/vars").get("clusterHeartbeats", {})
+            q = hb.get("n1", {}).get("quarantined")
+            if q is False:
+                released = True
+                got = n1_local_count()
+                if got != expect_n1:
+                    import urllib.request as _ur
+                    for pi in (0, 1, 2):
+                        with _ur.urlopen(
+                            f"http://localhost:{ports[pi]}/debug/events?limit=400",
+                            timeout=10,
+                        ) as r:
+                            ev = json.loads(r.read())
+                        for e in ev.get("events", []):
+                            t = e.get("type", "")
+                            if ("hint" in t or "quarantine" in t
+                                    or "antientropy" in t or "write" in t):
+                                print(f"EV[n{pi}]", e, flush=True)
+                    for s in n1_shards:
+                        out_s = _post(
+                            ports[1], "/index/i/query",
+                            json.dumps({"query": "Row(f=1)", "remote": True,
+                                        "shards": [s]}).encode(), timeout=30,
+                        )["results"][0]["columns"]
+                        print(f"N1 shard {s} cols:", out_s[:4], "...",
+                              len(out_s), flush=True)
+                assert got == expect_n1, (
+                    "bounded-read quarantine released BEFORE the hint "
+                    "replay landed on n1"
+                )
+                break
+            time.sleep(0.2)
+        assert released, f"n1 quarantine never released: {hb}"
+        assert not _get(ports[0], "/debug/vars").get("hints", {}).get(
+            "pending"
+        )
+
+        # (3) Zero reverted clears: stable through two further
+        # anti-entropy intervals on every replica and cluster-wide.
+        end = time.time() + 30
+        while time.time() < end:
+            if _get(ports[0], "/status")["state"] == "NORMAL":
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("cluster never healed to NORMAL")
+        time.sleep(3.2)  # two 1.5s anti-entropy intervals
+        assert n1_local_count() == expect_n1, "clear reverted on n1"
+        out = _post(ports[0], "/index/i/query", b"Count(Row(f=1))", timeout=60)
+        assert out["results"][0] == oracle - len(cleared), (
+            "anti-entropy resurrected a cleared bit"
+        )
+        with urllib.request.urlopen(
+            f"http://localhost:{ports[0]}/metrics", timeout=10
+        ) as resp:
+            metrics = resp.read().decode()
+        replayed = [
+            ln for ln in metrics.splitlines()
+            if ln.startswith("pilosa_hints_replayed_total")
+        ]
+        assert replayed and float(
+            replayed[0].rsplit(" ", 1)[1]
+        ) >= len(cleared)
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except ProcessLookupError:
+                pass
+        for p in procs:
+            p.communicate(timeout=30)
+
+
 def test_capability_probe_contract():
     """The multi-process psum lane's gate (the ONLY remaining
     environmental gate on the chaos suites): the probe is cached for

@@ -1,0 +1,363 @@
+"""The deterministic network-fault plane (net/faults.py) + the
+asymmetric-partition regression it makes testable.
+
+Determinism is the contract: every probabilistic verdict draws from ONE
+seeded PRNG in intercept-call order, so the same schedule against the
+same traffic sequence yields the same verdict sequence — pinned here
+against hardcoded expectations (a change to the draw discipline is a
+breaking change to every chaos script that baselined against it)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import ImportRequest, QueryRequest
+from pilosa_tpu.net.client import ClientError, InternalClient
+from pilosa_tpu.net.faults import PLANE, FaultPlane, parse_rule
+from pilosa_tpu.ops import SHARD_WIDTH
+
+from harness import run_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """The plane is process-global (that is the point — client and
+    gossip consult one table); every test starts and ends clean."""
+    PLANE.clear()
+    PLANE.set_local(set())
+    yield
+    PLANE.clear()
+    PLANE.set_local(set())
+
+
+# -- rule parsing / validation ----------------------------------------------
+
+
+def test_parse_rule_specs():
+    r = parse_rule("drop peer=localhost:1234 route=/index/* prob=0.5 times=3")
+    assert r.action == "drop"
+    assert r.peer == "127.0.0.1:1234"  # localhost normalized
+    assert r.route == "/index/*"
+    assert r.prob == 0.5 and r.times == 3
+
+    r = parse_rule("partition a=127.0.0.1:1|127.0.0.1:2 b=127.0.0.1:3")
+    assert r.a == {"127.0.0.1:1", "127.0.0.1:2"}
+    assert r.b == {"127.0.0.1:3"}
+    assert r.symmetric
+
+    r = parse_rule({"action": "error", "status": 429})
+    assert r.status == 429
+
+    for bad in (
+        "explode peer=*",
+        "drop prob=2.0",
+        "partition a=127.0.0.1:1",  # missing b
+        "drop peer",
+        42,
+        # A misspelled key must fail, not degenerate into a
+        # match-everything rule that drops ALL traffic.
+        "drop per=127.0.0.1:1",
+        {"action": "drop", "peers": "127.0.0.1:1"},
+    ):
+        with pytest.raises(ValueError):
+            parse_rule(bad)
+
+
+def test_server_construction_validates_fault_rules(tmp_path):
+    """[faults] rules fail fast at Server construction, naming the
+    section — the same fail-fast contract as [storage] ack and
+    [cluster] replica-read."""
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    cfg = Config()
+    cfg.data_dir = str(tmp_path / "d")
+    cfg.faults_rules = ["explode peer=*"]
+    with pytest.raises(ValueError, match=r"\[faults\]"):
+        Server(cfg)
+
+
+def test_server_construction_validates_holddown_and_hint_bounds(tmp_path):
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server import Server
+
+    for attr, value, key in (
+        ("cluster_recovery_holddown_ms", -5, "recovery-holddown-ms"),
+        ("cluster_recovery_holddown_ms", "soon", "recovery-holddown-ms"),
+        ("cluster_hint_max_bytes", -1, "hint-max-bytes"),
+        ("cluster_hint_max_age", 0, "hint-max-age"),
+    ):
+        cfg = Config()
+        cfg.data_dir = str(tmp_path / "d")
+        setattr(cfg, attr, value)
+        with pytest.raises(ValueError, match=key):
+            Server(cfg)
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def test_same_schedule_same_verdict_sequence():
+    """THE pinned contract: seed 42 + one prob=0.5 drop rule over 16
+    identical intercepts yields exactly this verdict sequence, and
+    re-installing the same schedule replays it."""
+    expected = [
+        False, True, True, True, False, False, False, True,
+        True, True, True, False, True, True, False, False,
+    ]
+    plane = FaultPlane()
+    plane.configure(["drop peer=127.0.0.1:9 prob=0.5"], seed=42)
+    got = [
+        plane.intercept("127.0.0.1:9", "/q") is not None for _ in range(16)
+    ]
+    assert got == expected
+    # Re-configure (the POST /debug/faults path) replays identically.
+    plane.configure(["drop peer=127.0.0.1:9 prob=0.5"], seed=42)
+    assert [
+        plane.intercept("127.0.0.1:9", "/q") is not None for _ in range(16)
+    ] == expected
+    # A different seed is a different (but equally deterministic) run.
+    plane.configure(["drop peer=127.0.0.1:9 prob=0.5"], seed=43)
+    other = [
+        plane.intercept("127.0.0.1:9", "/q") is not None for _ in range(16)
+    ]
+    assert other != expected
+
+
+def test_match_count_windows_not_wall_clock():
+    """``after``/``times`` bound rules by MATCH COUNT — wall-clock never
+    gates a verdict, so schedules replay exactly."""
+    plane = FaultPlane()
+    plane.configure(["drop peer=* after=2 times=3"])
+    got = [plane.intercept("127.0.0.1:9", "/q") is not None for _ in range(8)]
+    assert got == [False, False, True, True, True, False, False, False]
+
+
+# -- boundary hooks ----------------------------------------------------------
+
+
+def test_client_drop_is_transport_shaped_and_error_carries_status():
+    PLANE.configure([
+        "error peer=127.0.0.1:1 status=503",
+        "drop peer=127.0.0.1:2",
+    ])
+    c1 = InternalClient("http://localhost:1")
+    with pytest.raises(ClientError) as ei:
+        c1.status()
+    assert ei.value.code == 503  # server-shaped: would hedge, not verdict
+
+    c2 = InternalClient("http://localhost:2")
+    with pytest.raises(ClientError) as ei:
+        c2.status()
+    # Transport-shaped (code None): the executor's failure verdict.
+    assert ei.value.code is None
+    assert "injected" in str(ei.value)
+    # No socket was touched: nothing listens on these ports, yet the
+    # failures were instant (no retry backoff burned).
+    assert c1.requests == 1 and c2.requests == 1
+
+
+def test_partition_rule_enforces_own_side_and_asymmetry():
+    plane = FaultPlane()
+    plane.set_local({"n0", "127.0.0.1:1"})
+    plane.configure([{
+        "action": "partition", "a": ["127.0.0.1:1"], "b": ["127.0.0.1:2"],
+    }])
+    # We are in a: traffic to b is cut; traffic elsewhere is not.
+    assert plane.intercept("127.0.0.1:2", "/q") is not None
+    assert plane.intercept("127.0.0.1:3", "/q") is None
+    # The same rule body on a node in NEITHER group does nothing.
+    plane.set_local({"n2", "127.0.0.1:3"})
+    assert plane.intercept("127.0.0.1:2", "/q") is None
+    # Asymmetric: a->b cut, b->a open.
+    plane.set_local({"n1", "127.0.0.1:2"})
+    plane.configure([{
+        "action": "partition", "a": ["127.0.0.1:1"], "b": ["127.0.0.1:2"],
+        "symmetric": False,
+    }])
+    assert plane.intercept("127.0.0.1:1", "/q") is None  # b->a flows
+    plane.set_local({"n0", "127.0.0.1:1"})
+    assert plane.intercept("127.0.0.1:2", "/q") is not None  # a->b cut
+
+
+def test_gossip_send_honors_drop(tmp_path):
+    """An outgoing gossip datagram to a partitioned peer is silently
+    lost — the UDP socket never sees it."""
+    from pilosa_tpu.cluster.gossip import GossipNode
+
+    g = GossipNode("g0", port=0)
+    try:
+        PLANE.configure(["drop peer=127.0.0.1:45678"])
+        g._send(("127.0.0.1", 45678), {"type": "ping", "seq": "s"})
+        g._send(("127.0.0.1", 45679), {"type": "ping", "seq": "s"})
+        snap = PLANE.snapshot()
+        # Exactly the partitioned peer's datagram was swallowed; the
+        # other peer's send passed the plane untouched.
+        assert snap["rules"][0]["injected"] == 1
+        assert snap["rules"][0]["matched"] == 1
+        # Push/pull (the TCP stream) is cut by the same rule.
+        assert g._push_pull(("127.0.0.1", 45678)) is False
+        assert PLANE.snapshot()["rules"][0]["injected"] == 2
+    finally:
+        g.close()
+
+
+def test_debug_faults_endpoint_round_trip(tmp_path):
+    """POST /debug/faults installs rules at runtime (the chaos lanes'
+    channel), GET exposes the table with matched/injected tallies, and
+    POSTing an empty rules list heals."""
+    h = run_cluster(tmp_path, 1)
+    try:
+        port = h[0].port
+        body = json.dumps({
+            "seed": 7,
+            "rules": ["drop peer=127.0.0.1:59999 route=/index/*"],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://localhost:{port}/debug/faults", data=body,
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["active"] and doc["seed"] == 7
+        assert doc["rules"][0]["action"] == "drop"
+
+        with pytest.raises(ClientError):
+            InternalClient("http://localhost:59999").query("i", "Count(Row(f=1))")
+        with urllib.request.urlopen(
+            f"http://localhost:{port}/debug/faults", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["rules"][0]["injected"] == 1
+
+        # /debug/vars surfaces the active plane.
+        with urllib.request.urlopen(
+            f"http://localhost:{port}/debug/vars", timeout=10
+        ) as resp:
+            dv = json.loads(resp.read())
+        assert dv.get("faults", {}).get("active") is True
+
+        req = urllib.request.Request(
+            f"http://localhost:{port}/debug/faults",
+            data=json.dumps({"rules": []}).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert not doc["active"]
+
+        # A bad spec answers 400 naming the problem, table untouched.
+        req = urllib.request.Request(
+            f"http://localhost:{port}/debug/faults",
+            data=json.dumps({"rules": ["explode"]}).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        h.close()
+
+
+# -- the asymmetric-partition regression (ISSUE satellite) -------------------
+
+
+def test_asymmetric_partition_converges_no_double_hints(tmp_path):
+    """A sees B DOWN while B still reaches A (asymmetric link, cut via
+    the fault plane at the real InternalClient boundary).  Asserts the
+    PR 11 heartbeat-refutes-verdict rule converges both views, a write
+    caught in the failure window is queued as a hint EXACTLY once (the
+    hedge recursion must not double-queue the same miss), and the
+    bounded-read quarantine releases exactly once."""
+    import time as _time
+
+    from pilosa_tpu.cluster.hints import HintManager
+
+    h = run_cluster(tmp_path, 3, replica_n=2)
+    try:
+        client = h.client(0)
+        client.create_index("i")
+        client.create_field("i", "f")
+        cols = [s * SHARD_WIDTH + 3 for s in range(8)]
+        h[0].api.import_bits(
+            ImportRequest("i", "f", row_ids=[1] * len(cols), column_ids=cols)
+        )
+        mgr = HintManager(
+            h[0].data_dir, node_id="node0", journal=h[0].journal
+        )
+        mgr.cluster = h[0].cluster
+        h[0].cluster.hints = mgr
+        c0 = h[0].cluster
+        c0.recovery_holddown = 0.05
+
+        # Cut node0 -> node1 ONLY (node1's outbound side is untouched:
+        # its own client calls to node0 keep flowing — the asymmetric
+        # link).  The in-process plane matches on DESTINATION, so only
+        # traffic toward node1's port is lost.
+        n1_port = h[1].port
+        PLANE.configure([f"drop peer=127.0.0.1:{n1_port}"])
+
+        # A read that routes a shard to node1 fails in transport ->
+        # failure verdict + hedge to the surviving replica; the answer
+        # is still exact.
+        out = h[0].api.query(QueryRequest("i", "Count(Row(f=1))"))
+        assert out.results[0] == len(cols)
+        assert c0.node_by_id("node1").state == "DOWN"
+        # B -> A traffic genuinely flows through the cut: node1's own
+        # fan-out (which dials node0/node2, not itself) still answers
+        # exactly, and B's view of A never degrades.
+        out_b = h[1].api.query(QueryRequest("i", "Count(Row(f=1))"))
+        assert out_b.results[0] == len(cols)
+        assert h[1].cluster.node_by_id("node0").state != "DOWN"
+
+        # A destructive ClearRow through the degraded window: every
+        # node1-owned shard's miss queues exactly ONCE — the dedup set
+        # must keep the mapper's re-route from double-queuing.
+        n1_shards = [
+            s for s in range(8)
+            if any(n.id == "node1" for n in c0.shard_nodes("i", s))
+        ]
+        assert n1_shards, "placement gave node1 no shards?"
+        assert h[0].api.query(
+            QueryRequest("i", "ClearRow(f=1)")
+        ).results[0] is True
+        assert mgr.pending("node1") == len(n1_shards), (
+            "each (node, shard) miss must queue exactly once — "
+            f"expected {len(n1_shards)}, got {mgr.pending('node1')}"
+        )
+
+        # Heal the link; B's heartbeat (which always reached A's gossip
+        # — here delivered directly) refutes the verdict after the
+        # holddown: both views converge READY.
+        PLANE.clear()
+        _time.sleep(0.06)
+        c0.note_heartbeat("node1", ae_passes=0)
+        assert c0.node_by_id("node1").state == "READY"
+        assert "node1" in c0._read_quarantine  # held until replay + AE
+
+        # Replay drains, anti-entropy advances: quarantine releases
+        # EXACTLY once.
+        assert mgr.replay_pending() == 1
+        c0.note_heartbeat("node1", ae_passes=1)
+        assert "node1" not in c0._read_quarantine
+        releases = [
+            e for e in h[0].journal.events("cluster.quarantine.release")
+            if e.fields.get("node") == "node1"
+        ]
+        assert len(releases) == 1
+        c0.note_heartbeat("node1", ae_passes=2)
+        assert len([
+            e for e in h[0].journal.events("cluster.quarantine.release")
+            if e.fields.get("node") == "node1"
+        ]) == 1
+
+        # The cleared row is gone EVERYWHERE — including on node1,
+        # where only the hint replay (not the original fan-out) could
+        # have delivered it.
+        by_id = {srv.node_id: srv for srv in h.servers}
+        for s in n1_shards:
+            frag = by_id["node1"].holder.fragment("i", "f", "standard", s)
+            assert frag is None or not frag.bit(1, s * SHARD_WIDTH + 3)
+    finally:
+        h.close()

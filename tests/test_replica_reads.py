@@ -170,7 +170,12 @@ def test_bounded_quarantines_recovered_replica_until_antientropy(tmp_path):
         assert c.replica_fresh("node1", "i", 60000)
         assert c.heartbeats()["node1"]["quarantined"] is False
 
-        # The syncer's own pass counter feeds the wire signal.
+        # The syncer's own pass counter feeds the wire signal.  (A
+        # post-recovery status from EVERY live peer must land first —
+        # the hinted-handoff await-status quiescence defers passes
+        # until each potential hint holder has advertised; node1's
+        # heartbeats above credited node1, node2 reports here.)
+        c.note_heartbeat("node2", ae_passes=0)
         before = c.ae_passes
         from pilosa_tpu.cluster.syncer import HolderSyncer
 
