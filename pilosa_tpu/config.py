@@ -139,6 +139,12 @@ class Config:
         # after boot, serving from the host path meanwhile (readyz
         # reports `warming` with a residency fraction until done).
         self.storage_warm_start = True
+        # engine residency (docs/residency.md): the device working-set
+        # budget in bytes — a SOFT target past which field stacks evict
+        # (cost-priced) and cold stacks serve from the compressed host
+        # tier while an async promotion admits their touched rows.
+        # 0 = the engine default (8 GiB).
+        self.engine_device_budget_bytes = 0
         # mesh (TPU-native: devices for the shard mesh; 0 = all)
         self.mesh_devices = 0
         # multi-host JAX runtime (jax.distributed): coordinator address
@@ -283,6 +289,10 @@ class Config:
         self.storage_warm_start = st.get(
             "warm-start", self.storage_warm_start
         )
+        eng = doc.get("engine", {})
+        self.engine_device_budget_bytes = int(
+            eng.get("device-budget-bytes", self.engine_device_budget_bytes)
+        )
         mesh = doc.get("mesh", {})
         self.mesh_devices = mesh.get("devices", self.mesh_devices)
         # ``coordinator`` / ``processes`` / ``process-id`` are the
@@ -371,6 +381,7 @@ class Config:
             ("server_max_body_bytes", "MAX_BODY_BYTES", int),
             ("server_read_timeout", "READ_TIMEOUT", _parse_duration),
             ("server_idle_timeout", "IDLE_TIMEOUT", _parse_duration),
+            ("engine_device_budget_bytes", "ENGINE_DEVICE_BUDGET_BYTES", int),
             ("mesh_devices", "MESH_DEVICES", int),
             ("jax_coordinator", "JAX_COORDINATOR", str),
             ("jax_num_processes", "JAX_NUM_PROCESSES", int),
@@ -454,6 +465,9 @@ warm-start = {str(self.storage_warm_start).lower()}
 
 [translation]
 primary-url = "{self.translation_primary_url}"
+
+[engine]
+device-budget-bytes = {self.engine_device_budget_bytes}
 
 [mesh]
 devices = {self.mesh_devices}

@@ -1471,9 +1471,13 @@ class Executor:
 
         if fused is not None:
             local_shards, fused_count = fused
-
-            def map_fn(shard):  # noqa: F811 — remote shards still loop
-                raise Error("unexpected local shard in fused count")
+            # NOTE: the base map_fn stays in force for the remote
+            # fan-out below.  A topology change between the fused
+            # dispatch and map_reduce (resize mid-query) can re-route a
+            # "remote" shard back to THIS node; it was never covered by
+            # the fused count (remote excludes local_shards), so the
+            # host loop serving it is exact — a raise here failed reads
+            # during any resize that raced a fused count.
 
             remote = [s for s in shards if s not in local_shards]
             if remote:
@@ -1633,6 +1637,11 @@ class Executor:
         try:
             return eng.bitmap_row(index, c, shards)
         except (ValueError, PeerlessMeshError):
+            # Claim any half-written dispatch note (e.g. the residency
+            # layer's host_fallback stamp) so it cannot merge into the
+            # NEXT query's plan on this pooled thread (the hazard
+            # documented at _mesh_count_many's finally).
+            plans_mod.take_dispatch_note()
             return None  # unsupported argument shape / peer outage: host path
 
     def _mesh_count_many(self, index, calls, shards, opt):
@@ -1941,6 +1950,10 @@ class Executor:
             # Copy: waiters share the flight's list and callers may trim.
             return list(out) if isinstance(out, list) else out
         except (ValueError, PeerlessMeshError):
+            # topn_cache_only is a DIRECT engine call (no batcher finally
+            # to claim its note): drop any host_fallback stamp here so it
+            # cannot leak into the next query's plan on this thread.
+            plans_mod.take_dispatch_note()
             return None
 
     def _execute_topn_shards(self, index, c, shards, opt):
@@ -2233,6 +2246,10 @@ class Executor:
                 ),
             )
         except (ValueError, PeerlessMeshError):
+            # Direct engine call: claim any half-written dispatch note
+            # (residency host_fallback) before falling back, so it
+            # cannot merge into an unrelated query's plan.
+            plans_mod.take_dispatch_note()
             return None
         if counts is None:
             return None

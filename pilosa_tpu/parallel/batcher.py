@@ -716,21 +716,47 @@ class CountBatcher:
                 it._resolve()
             return
         good = []
+        import contextlib
+
+        probe_mode = getattr(
+            self.engine, "probe_residency", contextlib.nullcontext
+        )
         for it in items:
             try:
-                if it.kind == "count":
-                    from .engine import _Lowering
+                # Probe mode: a residency fallback re-raised here is
+                # ATTRIBUTION for a failure the dispatch already
+                # counted — it must not count a second host fallback
+                # per item (the hit-rate denominator).
+                with probe_mode():
+                    if it.kind == "count":
+                        from .engine import _Lowering
 
-                    lw = _Lowering(
-                        self.engine,
-                        self.engine.canonical_shards(it.index),
-                        slot_vector=True,
-                    )
-                    self.engine._lower(it.index, it.call, lw)
-                else:
-                    self.engine.probe_fused_item(it.index, it.spec, it.shards)
+                        lw = _Lowering(
+                            self.engine,
+                            self.engine.canonical_shards(it.index),
+                            slot_vector=True,
+                        )
+                        if hasattr(self.engine, "_collect_row_hints"):
+                            lw.row_hints = self.engine._collect_row_hints(
+                                it.index, it.call
+                            )
+                        self.engine._lower(it.index, it.call, lw)
+                    else:
+                        self.engine.probe_fused_item(
+                            it.index, it.spec, it.shards
+                        )
+                plans_mod.take_dispatch_note()  # probe leftovers: discard
                 good.append(it)
             except Exception as e:  # noqa: BLE001
+                # The probe may have stamped a dispatch note explaining
+                # WHY this item failed (e.g. the residency layer's
+                # path=host_fallback with the stack's resident
+                # fraction) — fan it onto the item's plan so ?profile=1
+                # and the /debug/plans analyzer see it even though the
+                # answer comes from the executor's fallback.
+                note = plans_mod.take_dispatch_note()
+                if it.plan is not None and note is not None:
+                    it.plan.note_op(**plans_mod.rider_note(note, 1))
                 it.error = e
                 it._resolve()
         if good and len(good) < len(items):

@@ -65,6 +65,7 @@ from ..util.stats import (
     METRIC_ENGINE_FUSED_PROGRAMS,
     METRIC_ENGINE_FUSED_QUERIES,
     METRIC_ENGINE_REBUILDS,
+    METRIC_ENGINE_RESIDENT_BLOCK_FRACTION,
     METRIC_ENGINE_RESIDENT_BYTES,
     METRIC_INGEST_SYNC_CHUNKS,
     METRIC_MESH_DEVICES,
@@ -77,6 +78,7 @@ from ..util.stats import (
 )
 from . import fusion as fusion_mod
 from . import kernels
+from . import residency as residency_mod
 from . import sparse as sparse_mod
 from .mesh import SHARD_AXIS, pad_shards, put_global
 
@@ -155,11 +157,13 @@ class _FieldStack:
 
     __slots__ = (
         "matrix", "row_index", "versions", "shards", "pos", "frag_sync",
-        "occ",
+        "occ", "partial", "absent_rows", "block_mask", "universe_rows",
+        "universe_blocks", "footprint",
     )
 
     def __init__(self, matrix, row_index: Dict[int, int], versions, shards,
-                 frag_sync=None, occ=None):
+                 frag_sync=None, occ=None, partial=False, absent_rows=None,
+                 block_mask=None, universe_rows=None, universe_blocks=None):
         self.matrix = matrix
         self.row_index = row_index
         self.versions = versions
@@ -179,6 +183,50 @@ class _FieldStack:
         # device blocks to read at all.  None only on multi-process
         # meshes (the sparse path is local-only there anyway).
         self.occ = occ
+        # -- tiered residency (docs/residency.md) -------------------------
+        # A PARTIAL stack holds only the promoted working-set rows:
+        # row_index maps promoted row ids to matrix slots, absent_rows
+        # records rows KNOWN EMPTY at promotion time (lowered to zero,
+        # no slot), and any other row id is simply not resident — the
+        # lowering raises ResidencyMiss and the query serves from the
+        # host tier while the promotion worker admits it.
+        self.partial = partial
+        self.absent_rows = absent_rows if absent_rows is not None else set()
+        # Resident-block mask, uint64[R, S]: blocks whose device words
+        # are valid.  Promotions upload every OCCUPIED block of a
+        # promoted row (the rest are zero, which occupancy proves
+        # correct), so mask >= occ is the residency invariant the sparse
+        # planner re-checks before trusting a partial stack's occupancy
+        # (engine._sparse_plan).  None on full stacks (all blocks).
+        self.block_mask = block_mask
+        # Row-universe size at (re)build/promotion time: the denominator
+        # of pilosa_engine_resident_block_fraction and the /debug/vars
+        # workingSet per-index resident-vs-total accounting.
+        self.universe_rows = (
+            universe_rows if universe_rows is not None
+            else (matrix.shape[0] if hasattr(matrix, "shape") else 0)
+        )
+        # OCCUPIED blocks across the full row universe at promotion
+        # time (the pilosa_engine_resident_block_fraction denominator
+        # for partial stacks); None = unknown (full stacks compute the
+        # fraction as resident==universe at scrape time).
+        self.universe_blocks = universe_blocks
+        # Bytes this stack charges the admission budget: the device
+        # matrix PLUS the host-side occupancy/block-mask summaries the
+        # residency layer keeps per stack (ISSUE 15 satellite: the
+        # summaries were uncounted, so real footprint exceeded the cap).
+        self.footprint = int(getattr(matrix, "nbytes", 0))
+        for summary in (self.occ, self.block_mask):
+            if summary is not None:
+                self.footprint += int(summary.nbytes)
+
+    def resident_fraction(self) -> float:
+        """Resident rows / row universe (1.0 for full stacks)."""
+        if not self.partial:
+            return 1.0
+        if not self.universe_rows:
+            return 1.0
+        return min(1.0, len(self.row_index) / self.universe_rows)
 
 
 class _TopNCandidates:
@@ -229,6 +277,12 @@ class _Lowering:
         # mode): the sparse planner reads row-index VALUES back out of a
         # lowered prog to combine occupancy host-side (_sparse_plan).
         self.scalar_value_of: Dict[int, int] = {}
+        # (index, field, view) -> set of row ids the lowered tree(s)
+        # will touch, or None meaning the whole stack is required —
+        # collected BEFORE lowering (engine._collect_row_hints) so a
+        # cold-stack miss can enqueue ONE promotion covering the whole
+        # query's working set instead of converging one row per retry.
+        self.row_hints: Dict[tuple, Optional[set]] = {}
         if slot_vector:
             self.scalar_values = []
             self.operands.append(None)  # slot vector, filled by finish()
@@ -263,7 +317,8 @@ class _Lowering:
         key = (index, field, view)
         if key not in self._stacks:
             self._stacks[key] = self.engine.field_stack(
-                index, field, view, self.canonical
+                index, field, view, self.canonical,
+                rows_hint=self.row_hints.get(key),
             )
         return self._stacks[key]
 
@@ -480,6 +535,27 @@ def _scatter_words_donated(mesh, *args):
     return _scatter_jits(mesh)["words_donated"](mesh, *args)
 
 
+@functools.lru_cache(maxsize=64)
+def _zeros_exec(mesh, R, S):
+    """Per-(mesh, R, S) zero-stack allocator jitted with the pinned
+    row-major layout: a partial promotion's backing matrix is born ON
+    device (no host->device transfer of zeros) and the scatter chain
+    then ships only the promoted rows' occupied blocks.  R arrives
+    power-of-two tiered (engine._promote), so the executable cache
+    stays bounded."""
+    from .mesh import _row_major_format
+
+    fmt = _row_major_format(NamedSharding(mesh, P(None, SHARD_AXIS)), 3)
+    return jax.jit(
+        lambda: jnp.zeros((R, S, bitops.WORDS), jnp.uint32),
+        out_shardings=fmt,
+    )
+
+
+def _device_zeros(mesh, R, S):
+    return _zeros_exec(mesh, R, S)()
+
+
 class IngestSyncer:
     """Stage-decoupled ingest device-sync worker (docs/ingest.md).
 
@@ -595,9 +671,9 @@ class _NotSparse(Exception):
     """Internal: a lowered tree has no occupancy-guided form."""
 
 
-# Re-exported for back-compat; the class lives in errors.py so it has an
-# import-cycle-free home (see that module's docstring).
-from .errors import PeerlessMeshError  # noqa: E402
+# Re-exported for back-compat; the classes live in errors.py so they
+# have an import-cycle-free home (see that module's docstring).
+from .errors import PeerlessMeshError, ResidencyMiss  # noqa: E402
 
 
 class MeshEngine:
@@ -641,6 +717,27 @@ class MeshEngine:
         # (weakref to evicted device matrix, nbytes): evicted stacks whose
         # HBM may still be held by an in-flight dispatch.
         self._pending_free: list = []
+        # Tiered residency (docs/residency.md): the async promotion
+        # manager that turns field_stack misses too big for the budget
+        # into background working-set promotions + host-tier fallbacks
+        # instead of blocking uploads or OOMs.
+        self.residency = residency_mod.ResidencyManager(self)
+        # Queries answered from the host tier because their stack (or
+        # the rows they touch) was not resident (bench's hit-rate
+        # numerator pairs this with the stack cache-hit counter).
+        self.host_fallbacks = 0
+        # Thread-local probe marker: re-raising fallback paths (batch
+        # failure attribution, promotion-commit reconcile) must not
+        # re-count an already-counted fallback (_host_fallback).
+        self._probe_tls = threading.local()
+        # Eviction pricing hook: index name -> measured device-cost
+        # signal (higher = hotter = evicted later).  Defaults to the
+        # per-tenant device-cost EWMA the PR 9 ledger maintains (tenant
+        # keys default to the index name at the serving layer);
+        # overridable for tests and exotic deployments.
+        self.cost_of_index = (
+            lambda index: plans_mod.LEDGER.cost_ewma(index)
+        )
         self._zeros: Dict[int, object] = {}
         self._scalars: Dict[int, object] = {}
         self._bits: Dict[Tuple[int, int], object] = {}
@@ -897,12 +994,20 @@ class MeshEngine:
         field: str,
         view: str,
         canonical: Optional[List[int]] = None,
+        rows_hint: Optional[set] = None,
     ) -> Optional[_FieldStack]:
         """Sharded stack of every row of a view across the index's
         canonical shard axis.  Callers combining several stacks (or a
         stack plus a mask) in ONE dispatch pass the same ``canonical``
         snapshot so every operand shares the shard-axis layout even if a
-        concurrent import grows the index mid-query."""
+        concurrent import grows the index mid-query.
+
+        ``rows_hint`` is the row-id working set the caller's query will
+        touch (None = the whole stack).  It changes nothing while the
+        full stack fits the device budget; past the budget it is what
+        the async promotion admits instead of the whole stack
+        (docs/residency.md), and the call raises ``ResidencyMiss`` so
+        the query serves from the host tier meanwhile."""
         key = (index, field, view)
         if canonical is None:
             canonical = self.canonical_shards(index)
@@ -910,9 +1015,12 @@ class MeshEngine:
         # already hold the former via _collective; direct callers take
         # both here).
         with self._dispatch_lock, self._stacks_lock:
-            return self._field_stack_locked(key, index, field, view, canonical)
+            return self._field_stack_locked(
+                key, index, field, view, canonical, rows_hint=rows_hint
+            )
 
-    def _field_stack_locked(self, key, index, field, view, canonical):
+    def _field_stack_locked(self, key, index, field, view, canonical,
+                            rows_hint=None):
         view_obj = self.holder.view(index, field, view)
         token = (
             self.holder.shard_epoch(index),
@@ -928,6 +1036,7 @@ class MeshEngine:
             self._cache_hit("stack")
             self._stacks.move_to_end(key)
             return cached
+        prior_rows = None
         if cached is not None:
             # Write deltas scatter into the resident HBM matrix instead
             # of re-uploading the whole view (the SURVEY "mutability on
@@ -943,20 +1052,43 @@ class MeshEngine:
                 self._cache_hit("stack")
                 self._stacks.move_to_end(key)
                 return updated
+            if cached.partial:
+                # A partial stack being rebuilt keeps its working set:
+                # the replacement promotion covers the rows dashboards
+                # were already hitting, not just the triggering query's.
+                prior_rows = set(cached.row_index)
             self._evict(key)
         if not canonical:
             return None
         self._cache_miss("stack")
 
+        # -- admission policy (docs/residency.md) -------------------------
+        # Estimate the FULL stack footprint from the row universe before
+        # paying host assembly: a stack that fits the budget (evicting
+        # colder stacks if needed) builds synchronously exactly as
+        # before; one that cannot fit enqueues an async promotion of the
+        # touched working set and serves this query from the host tier.
+        # Multi-process meshes skip the estimate walk entirely — the
+        # working-set regime is single-process-only (the gate below
+        # would never fire) and the walk would tax every rebuild.
+        if not self.multiproc:
+            universe = self._row_universe(index, field, view, canonical)
+            S = pad_shards(len(canonical), self.mesh)
+            full_foot = max(1, len(universe)) * S * self._row_shard_bytes()
+            if not self._admissible(full_foot):
+                if rows_hint is not None and prior_rows:
+                    rows_hint = set(rows_hint) | prior_rows
+                elif rows_hint is None and prior_rows:
+                    rows_hint = prior_rows
+                self._miss_to_host(key, rows_hint, 0.0, full_foot)
+
         _token, frag_sync, row_index, mat, occ = self._assemble_host(
             index, field, view, canonical
         )
-        while (
-            self._resident_bytes + self._pending_bytes() + mat.nbytes
-            > self.max_resident_bytes
-            and self._stacks
-        ):
-            self._evict(next(iter(self._stacks)))
+        footprint = mat.nbytes + (0 if occ is None else occ.nbytes)
+        # Cost-priced eviction down to the (soft) working-set target:
+        # colder tenants' stacks go first, LRU within a tenant.
+        self._evict_for(footprint)
         self.stack_rebuilds += 1
         self._rebuilds_counter.inc()
         stack = _FieldStack(
@@ -968,8 +1100,157 @@ class MeshEngine:
             occ=occ,
         )
         self._stacks[key] = stack
-        self._resident_bytes += mat.nbytes
+        self._resident_bytes += stack.footprint
         return stack
+
+    @staticmethod
+    def _row_shard_bytes() -> int:
+        """Device+summary bytes one (row, shard) charges the budget:
+        the uint32[WORDS] words plus the uint64 occupancy and
+        resident-block summaries the residency layer keeps per stack."""
+        return bitops.WORDS * 4 + 16
+
+    def _row_universe(self, index, field, view, canonical) -> List[int]:
+        """Sorted distinct row ids across the view's local fragments —
+        the denominator of partial residency and the input to the
+        admission estimate (the full build walks it again; the walk is
+        id-only and cheap next to the word copies)."""
+        rows = set()
+        for s in canonical:
+            f = self.holder.fragment(index, field, view, s)
+            if f is not None:
+                rows.update(f.row_ids())
+        return sorted(rows)
+
+    def _admissible(self, nbytes: int) -> bool:
+        """Could ``nbytes`` fit the device budget if every resident
+        stack were evicted?  Evicted-but-live buffers and in-flight
+        promotion allocations are unavoidable and always count."""
+        return (
+            nbytes + self._pending_bytes() + self.residency.inflight_bytes()
+            <= self.max_resident_bytes
+        )
+
+    def _evict_for(self, need_bytes: int, protect=frozenset()) -> bool:
+        """Cost-priced eviction loop: free resident stacks until
+        ``need_bytes`` more fits under ``max_resident_bytes`` (a SOFT
+        working-set target — when nothing more is evictable the caller
+        still admits, trusting the next pressure cycle to converge).
+        Victims are ordered by the per-tenant device-cost EWMA of their
+        index (cold tenants lose their stacks first — PR 9's measured
+        signal), LRU within equal cost.  Runs under the engine locks."""
+
+        def fits():
+            return (
+                self._resident_bytes + self._pending_bytes()
+                + self.residency.inflight_bytes() + need_bytes
+                <= self.max_resident_bytes
+            )
+
+        if fits():
+            return True
+        lru_pos = {k: i for i, k in enumerate(self._stacks)}
+        order = sorted(
+            (k for k in self._stacks if k not in protect),
+            key=lambda k: (self._index_cost(k[0]), lru_pos[k]),
+        )
+        for k in order:
+            if fits():
+                return True
+            self._evict(k)
+        return fits()
+
+    def _index_cost(self, index: str) -> float:
+        """The eviction-pricing signal for one index, tolerant of a
+        broken hook (pricing must never fail an admission)."""
+        try:
+            return float(self.cost_of_index(index))
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def _host_fallback(self, key, rows, fraction: float, msg: str):
+        """THE residency fallback protocol, in one place: count the
+        fallback, enqueue the async promotion, stamp the plan note the
+        /debug/plans analyzer renders as "host fallback: stack NN%
+        resident", and raise ResidencyMiss so the executor serves the
+        query from the compressed host tier.  ``probe_residency`` mode
+        (the batcher's batch-failure attribution probe, the promotion
+        commit's reconcile) suppresses the COUNTERS — a probe re-raises
+        for a query whose first raise was already counted, and the
+        worker-side reconcile serves no query at all — while the plan
+        note and the promotion request (idempotent: the manager merges)
+        still land."""
+        quiet = getattr(self._probe_tls, "quiet", False)
+        if not quiet:
+            self.host_fallbacks += 1
+            self.residency.note_host_fallback()
+        self.residency.request(key, rows)
+        plans_mod.note_dispatch(
+            path="host_fallback",
+            stack="/".join(key),
+            resident_fraction=round(fraction, 4),
+        )
+        raise ResidencyMiss(msg, key=key, resident_fraction=fraction)
+
+    class _ProbeMode:
+        """Context manager marking the calling thread's residency
+        fallbacks as PROBES (no counter movement) — see _host_fallback."""
+
+        __slots__ = ("_tls",)
+
+        def __init__(self, tls):
+            self._tls = tls
+
+        def __enter__(self):
+            self._tls.quiet = True
+
+        def __exit__(self, *exc):
+            self._tls.quiet = False
+            return False
+
+    def probe_residency(self):
+        """Mark residency fallbacks on this thread as probes for the
+        block (used by the batcher's failure-attribution re-lowering:
+        the query's first raise already counted)."""
+        return self._ProbeMode(self._probe_tls)
+
+    def _miss_to_host(self, key, rows_hint, fraction: float, need_bytes: int):
+        """A stack is not resident and will not fit as a whole."""
+        self._host_fallback(
+            key, rows_hint, fraction,
+            f"stack {key} not device-resident ({need_bytes} B vs budget "
+            f"{self.max_resident_bytes} B); async promotion enqueued — "
+            "serving from the host tier",
+        )
+
+    def _partial_miss(self, index, field, view, row_id, lw, stack):
+        """A query touched a row outside a partial stack's resident set:
+        request promotion of the query's whole hinted working set (plus
+        this row) and fall back to the host tier."""
+        key = (index, field, view)
+        hint = lw.row_hints.get(key) if lw is not None else None
+        rows = set(hint) if hint else set()
+        rows.add(row_id)
+        frac = stack.resident_fraction()
+        self._host_fallback(
+            key, rows, frac,
+            f"row {row_id} of {key} not resident "
+            f"({frac:.0%} of the stack is); promotion enqueued",
+        )
+
+    def _require_full_stack(self, index, field, view, stack):
+        """Aggregate dispatches (BSI plane walks, TopN candidate
+        matrices, GroupBy row tables) read whole stacks; a partial stack
+        cannot serve them — promote to full (async) and host-fallback."""
+        if stack is None or not stack.partial:
+            return stack
+        key = (index, field, view)
+        frac = stack.resident_fraction()
+        self._host_fallback(
+            key, None, frac,
+            f"aggregate over partial stack {key} "
+            f"({frac:.0%} resident); full promotion enqueued",
+        )
 
     def _assemble_host(self, index, field, view, canonical):
         """Host half of a stack build: walk the view's fragments and
@@ -1051,9 +1332,17 @@ class MeshEngine:
         put_global collectives alone would hang the mesh."""
         keys = []
         if not self.multiproc:
-            for index in (
-                indexes if indexes is not None else list(self.holder.indexes)
-            ):
+            index_list = list(
+                indexes if indexes is not None else self.holder.indexes
+            )
+            # Hot tenants first: order residency builds by the
+            # per-tenant device-cost EWMA (PR 9's measured signal,
+            # persisted across restarts by the server) instead of
+            # holder iteration order — the indexes production traffic
+            # actually hits become resident before cold ones, so the
+            # serving set recovers first.
+            index_list.sort(key=lambda i: -self._index_cost(i))
+            for index in index_list:
                 idx = self.holder.index(index)
                 if idx is None or not self.canonical_shards(index):
                     continue
@@ -1071,10 +1360,11 @@ class MeshEngine:
         import queue as queue_mod
 
         q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1)
+        stop = threading.Event()
 
         def prefetch():
             for key in keys:
-                if self._closed:
+                if self._closed or stop.is_set():
                     break
                 index, field, view = key
                 try:
@@ -1095,7 +1385,7 @@ class MeshEngine:
             if item is None:
                 break
             key, canonical, assembled = item
-            if self._closed:
+            if self._closed or stop.is_set():
                 state["skipped"] += 1
                 continue
             try:
@@ -1108,6 +1398,17 @@ class MeshEngine:
             except Exception as e:  # noqa: BLE001
                 self._log(f"warm-start admit {key}: {e}")
                 state["skipped"] += 1
+            # Stop once the working-set target is reached instead of
+            # racing the cap stack-by-stack: the remaining (colder,
+            # thanks to the EWMA ordering) stacks stay in the host tier
+            # and admit on demand.
+            if not stop.is_set() and not self._under_warm_target():
+                stop.set()
+        # Keys the early stop kept the prefetch thread from ever
+        # assembling still count as skipped — built + skipped must
+        # reconcile with total so the journal entry and /readyz
+        # warmStart fraction report completed warming honestly.
+        state["skipped"] = state["total"] - state["built"]
         state["done"] = True
         self.journal.append(
             "engine.warm_start",
@@ -1142,9 +1443,11 @@ class MeshEngine:
                     )
                     is not None
                 )
+            footprint = mat.nbytes + (0 if occ is None else occ.nbytes)
             if (
-                self._resident_bytes + self._pending_bytes() + mat.nbytes
-                > self.max_resident_bytes
+                self._resident_bytes + self._pending_bytes()
+                + self.residency.inflight_bytes() + footprint
+                > self.warm_target_bytes()
             ):
                 return False  # budget: warming never evicts the working set
             self.stack_rebuilds += 1
@@ -1158,8 +1461,22 @@ class MeshEngine:
                 occ=occ,
             )
             self._stacks[key] = stack
-            self._resident_bytes += mat.nbytes
+            self._resident_bytes += stack.footprint
             return True
+
+    # Warming admits only up to this fraction of the device budget —
+    # the boot working-set target.  The headroom is the on-demand lane:
+    # queries (and their promotions) admit what traffic actually needs
+    # without immediately evicting what warming just built.
+    WARM_TARGET_FRACTION = 0.9
+
+    def warm_target_bytes(self) -> int:
+        return int(self.max_resident_bytes * self.WARM_TARGET_FRACTION)
+
+    def _under_warm_target(self) -> bool:
+        with self._stacks_lock:
+            used = self._resident_bytes + self._pending_bytes()
+        return used + self.residency.inflight_bytes() < self.warm_target_bytes()
 
     def ingest_syncer(self) -> IngestSyncer:
         """The lazy ingest device-sync worker (docs/ingest.md)."""
@@ -1187,6 +1504,275 @@ class MeshEngine:
                     )
                     n += 1
         return n
+
+    # -- async working-set promotion (docs/residency.md) --------------------
+
+    # Rows per promotion chunk: the host decode/assembly of chunk N+1
+    # overlaps the (asynchronously dispatched) device scatter of chunk
+    # N — the IngestSyncer overlap pattern applied to cache fill.
+    PROMOTE_CHUNK_ROWS = 64
+    # Occupied-block fraction at or under which a promoted row ships as
+    # word-level scatters of its occupied 2 KiB blocks only (the
+    # "promote blocks, not stacks" transfer path); denser rows ship as
+    # one full-row scatter.
+    PROMOTE_SPARSE_ROW = 0.5
+
+    def _promote(self, key, rows):
+        """Promote ``key``'s working set into device residency; runs on
+        the ResidencyManager worker thread.  ``rows`` is the merged row
+        set misses requested (None = full stack required).  Returns
+        (outcome, device_bytes_shipped) with outcome one of
+        "full" | "partial" | "declined" | "skipped".
+
+        Safety: per-shard sync points are captured BEFORE any row words
+        are read, so a write landing mid-promotion leaves the committed
+        stack with sync versions older than the write — the next
+        ``field_stack`` runs the authoritative incremental sync and
+        re-scatters exactly the dirty rows (idempotent full-word sets).
+        The commit itself re-checks the version token under the engine
+        locks and reconciles through that same authoritative path
+        (tests/test_residency.py pins the race)."""
+        index, field, view = key
+        if self.multiproc or self._closed:
+            return "skipped", 0
+        # Phase 0: snapshot intent under the locks.
+        with self._dispatch_lock, self._stacks_lock:
+            canonical = self.canonical_shards(index)
+            if not canonical:
+                return "skipped", 0
+            view_obj = self.holder.view(index, field, view)
+            token = (
+                self.holder.shard_epoch(index),
+                id(view_obj),
+                -1 if view_obj is None else view_obj.version,
+            )
+            existing = self._stacks.get(key)
+            if (
+                existing is not None
+                and not existing.partial
+                and existing.versions == token
+            ):
+                return "skipped", 0  # a query sync-built it first
+            want = None if rows is None else set(rows)
+            if existing is not None and existing.partial and want is not None:
+                # Growing an existing partial stack keeps its working
+                # set: the new matrix covers old + requested rows.
+                want |= set(existing.row_index)
+        # Phase 1: UNLOCKED host walk.  Sync points FIRST — any write
+        # after this line has version > recorded and replays through
+        # the incremental sync after commit.
+        frags = [self.holder.fragment(index, field, view, s) for s in canonical]
+        frag_sync = [
+            (None, -1) if f is None else (weakref.ref(f), f._version)
+            for f in frags
+        ]
+        universe = sorted(
+            {r for f in frags if f is not None for r in f.row_ids()}
+        )
+        # Occupied blocks across the WHOLE universe (O(1) per row-shard:
+        # fragments maintain exact occupancy) — the denominator of
+        # pilosa_engine_resident_block_fraction for partial stacks.
+        universe_blocks = sum(
+            int(f.row_occupancy(r)).bit_count()
+            for f in frags if f is not None
+            for r in f.row_ids()
+        )
+        S = pad_shards(len(canonical), self.mesh)
+        full_foot = max(1, len(universe)) * S * self._row_shard_bytes()
+        if want is None or self._admissible(full_foot):
+            # Full promotion: the whole stack fits (or an aggregate
+            # needs all of it and it fits) — assemble exactly like the
+            # sync build and admit in one put.  The upload registers
+            # its in-flight bytes like the partial branch, so
+            # concurrent admissions cannot stack on top of it and
+            # overshoot the budget mid-transfer.
+            if not self._admissible(full_foot):
+                return "declined", 0
+            self.residency.add_inflight(full_foot)
+            credited = True
+            try:
+                with self._dispatch_lock, self._stacks_lock:
+                    self._evict_for(0, protect=frozenset((key,)))
+                assembled = self._assemble_host(index, field, view, canonical)
+                mat_dev = put_global(
+                    self.mesh, assembled[3], P(None, SHARD_AXIS)
+                )
+                # The committed footprint replaces the in-flight credit
+                # (carrying both through the commit's eviction pass
+                # would double-charge and over-evict).
+                self.residency.sub_inflight(full_foot)
+                credited = False
+                return self._commit_promotion(
+                    key, canonical, token, assembled[1], assembled[2],
+                    mat_dev, assembled[4], partial=False, absent=set(),
+                    universe_rows=len(universe),
+                    universe_blocks=universe_blocks,
+                    shipped=int(assembled[3].nbytes),
+                )
+            finally:
+                if credited:
+                    self.residency.sub_inflight(full_foot)
+        # Partial promotion: only the touched rows, pow2 row capacity so
+        # compiled programs tier.
+        uni = set(universe)
+        target = sorted(r for r in want if r in uni)
+        absent = {r for r in want if r not in uni}
+        if not target and not absent:
+            return "skipped", 0
+        # Power-of-two row capacity so partial-stack programs tier
+        # (compile key = matrix shape); min 1 — a one-row working set
+        # must fit a one-row budget.
+        R_cap = 1 << (max(1, len(target)) - 1).bit_length()
+        part_foot = R_cap * S * self._row_shard_bytes()
+        if not self._admissible(part_foot):
+            return "declined", 0
+        self.residency.add_inflight(part_foot)
+        credited = True
+        try:
+            with self._dispatch_lock, self._stacks_lock:
+                # Make room up front (cost-priced); the in-flight bytes
+                # are already counted so concurrent admissions can't
+                # stack on top of this upload.
+                self._evict_for(0, protect=frozenset((key,)))
+            mat = _device_zeros(self.mesh, R_cap, S)
+            row_index = {r: i for i, r in enumerate(target)}
+            occ = np.zeros((R_cap, S), dtype=np.uint64)
+            shipped = 0
+            for ci in range(0, len(target), self.PROMOTE_CHUNK_ROWS):
+                chunk = target[ci : ci + self.PROMOTE_CHUNK_ROWS]
+                updates, word_updates, n_words, sb = (
+                    self._assemble_promotion_chunk(chunk, row_index, frags, occ)
+                )
+                shipped += sb
+                if updates or word_updates:
+                    # Async dispatch: returns as soon as the scatter is
+                    # enqueued — the next chunk's host assembly overlaps
+                    # this chunk's device transfer.  The matrix is
+                    # private until commit, so donation needs no lock.
+                    mat = self._scatter_chain(mat, updates, word_updates, n_words)
+            # Release the in-flight credit BEFORE commit: the committed
+            # footprint replaces it, and carrying both through the
+            # commit's eviction pass would double-charge the budget and
+            # over-evict the working set.
+            self.residency.sub_inflight(part_foot)
+            credited = False
+            return self._commit_promotion(
+                key, canonical, token, frag_sync, row_index, mat, occ,
+                partial=True, absent=absent, universe_rows=len(universe),
+                universe_blocks=universe_blocks, shipped=shipped,
+            )
+        finally:
+            if credited:
+                self.residency.sub_inflight(part_foot)
+
+    def _assemble_promotion_chunk(self, chunk_rows, row_index, frags, occ):
+        """Host half of one promotion chunk: read each (row, shard)'s
+        words, compute occupancy FROM those words (never a second
+        fragment read — the same false-negative rule as
+        _assemble_host), and emit scatter operands.  Rows at or under
+        PROMOTE_SPARSE_ROW occupied-block fraction ship word-level
+        (only their occupied 2 KiB blocks cross PCIe); denser rows ship
+        whole.  Returns (updates, word_updates, n_words, bytes)."""
+        updates: list = []
+        word_updates: list = []
+        n_words = 0
+        shipped = 0
+        sparse_cap = int(bitops.OCC_BLOCKS * self.PROMOTE_SPARSE_ROW)
+        for r in chunk_rows:
+            ri = row_index[r]
+            for si, f in enumerate(frags):
+                if f is None or not f.row_occupancy(r):
+                    # A write racing this check bumps the fragment
+                    # version past the captured sync point; the
+                    # incremental sync replays the row after commit.
+                    continue
+                words = np.asarray(f.row_words(r), dtype=np.uint32)
+                o64 = int(bitops.occupancy64(words))
+                if not o64:
+                    continue
+                occ[ri, si] = np.uint64(o64)
+                blocks = np.nonzero(
+                    np.unpackbits(
+                        np.uint64(o64).reshape(1).view(np.uint8),
+                        bitorder="little",
+                    )
+                )[0]
+                if len(blocks) <= sparse_cap:
+                    widxs = (
+                        blocks[:, None].astype(np.int64)
+                        * bitops.OCC_BLOCK_WORDS
+                        + np.arange(bitops.OCC_BLOCK_WORDS)[None, :]
+                    ).ravel().astype(np.int32)
+                    word_updates.append((ri, si, widxs, words[widxs]))
+                    n_words += len(widxs)
+                    shipped += len(widxs) * 4
+                else:
+                    updates.append((ri, si, words))
+                    shipped += words.nbytes
+        return updates, word_updates, n_words, shipped
+
+    def _commit_promotion(self, key, canonical, token, frag_sync, row_index,
+                          mat, occ, partial, absent, universe_rows, shipped,
+                          universe_blocks=None):
+        """Admit a promoted matrix under the engine locks with the
+        version-token gate: stale identities abort, and a version
+        advanced by a mid-promotion write reconciles IMMEDIATELY
+        through the authoritative incremental-sync path before any
+        query can read the stack."""
+        index, field, view = key
+        with self._dispatch_lock, self._stacks_lock:
+            if self._closed:
+                return "skipped", shipped
+            if self.canonical_shards(index) != canonical:
+                return "skipped", shipped  # shard axis moved: re-request
+            view_obj = self.holder.view(index, field, view)
+            if id(view_obj) != token[1]:
+                return "skipped", shipped  # view reopened: stale identity
+            if key in self._stacks:
+                self._evict(key)
+            block_mask = occ.copy() if (partial and occ is not None) else None
+            stack = _FieldStack(
+                mat, row_index, token, list(canonical),
+                frag_sync=frag_sync, occ=occ, partial=partial,
+                absent_rows=set(absent), block_mask=block_mask,
+                universe_rows=universe_rows,
+                universe_blocks=universe_blocks,
+            )
+            self._evict_for(stack.footprint)
+            self._stacks[key] = stack
+            self._resident_bytes += stack.footprint
+            self.stack_rebuilds += 1
+            self._rebuilds_counter.inc()
+            now_token = (
+                self.holder.shard_epoch(index),
+                id(view_obj),
+                -1 if view_obj is None else view_obj.version,
+            )
+            if now_token != token:
+                # Token re-check: a write landed mid-promotion.  Fall
+                # back to the authoritative path NOW — the incremental
+                # sync re-scatters the dirty rows (or, if the shape
+                # changed, evicts and rebuilds/re-requests).  Probe
+                # mode: this serves no query, so a ResidencyMiss here
+                # must not count a phantom host fallback; its dispatch
+                # note is discarded (no plan on the worker thread).
+                try:
+                    with self.probe_residency():
+                        self._field_stack_locked(
+                            key, index, field, view, canonical
+                        )
+                except ResidencyMiss:
+                    plans_mod.take_dispatch_note()
+                    return "declined", shipped
+            if not self._closing_down:
+                self.journal.append(
+                    "engine.promote",
+                    index=index, field=field, view=view,
+                    partial=bool(partial),
+                    rows=len(row_index), universeRows=int(universe_rows),
+                    bytes=int(shipped),
+                )
+        return ("partial" if partial else "full"), shipped
 
     # Rows per scatter dispatch (operand = rows x 128 KiB of host->device
     # transfer per chunk); deltas of any size chain chunks — the first
@@ -1252,6 +1838,15 @@ class MeshEngine:
             for r, upd in dirty.items():
                 row_idx = cached.row_index.get(r)
                 if row_idx is None:
+                    if cached.partial:
+                        # An UNPROMOTED row changed: it is not resident
+                        # (the host tier serves it), but it may no
+                        # longer be the known-empty row the lowering
+                        # zeros — drop the absent marker so the next
+                        # query over it host-falls-back and promotes
+                        # instead of reading a stale zero.
+                        cached.absent_rows.discard(r)
+                        continue
                     return None  # brand-new row: shape change
                 if upd[0] == "words":
                     _, widxs, vals, occ64 = upd
@@ -1282,12 +1877,23 @@ class MeshEngine:
             if cached.occ is not None:
                 for row_idx, si, occ64 in occ_updates:
                     cached.occ[row_idx, si] = np.uint64(occ64)
+                    if cached.block_mask is not None:
+                        # The scatter just landed these words on device:
+                        # the resident-block mask grows to cover them
+                        # (mask >= occ stays invariant — the sparse
+                        # planner's partial-stack gate).
+                        cached.block_mask[row_idx, si] |= np.uint64(occ64)
         cached.versions = token
         cached.frag_sync = new_sync
         return cached
 
     def _scatter_sync_chain(self, cached, updates, word_updates, n_words):
-        mat = cached.matrix
+        cached.matrix = self._scatter_chain(
+            cached.matrix, updates, word_updates, n_words
+        )
+        self.stack_updates += 1
+
+    def _scatter_chain(self, mat, updates, word_updates, n_words):
         # EVERY chunk donates — the update runs in place instead of
         # opening with a full-stack device copy (~9 ms on a 3 GB
         # stack, formerly the dominant cost of every write+query
@@ -1342,8 +1948,7 @@ class MeshEngine:
                 jnp.asarray(widx_w),
                 jnp.asarray(vals_w),
             )
-        cached.matrix = mat
-        self.stack_updates += 1
+        return mat
 
     def _evict(self, key):
         # Drop the cache reference only — never .delete() the device
@@ -1355,7 +1960,7 @@ class MeshEngine:
         # check cannot over-admit against memory that is still live.
         stack = self._stacks.pop(key, None)
         if stack is not None:
-            self._resident_bytes -= stack.matrix.nbytes
+            self._resident_bytes -= stack.footprint
             self._pending_free.append(
                 (weakref.ref(stack.matrix), stack.matrix.nbytes)
             )
@@ -1468,10 +2073,17 @@ class MeshEngine:
             if f.view(view_name) is None:
                 continue
             stack = lw.stack_for(index, field_name, view_name)
-            if stack is None or row_id not in stack.row_index:
+            if stack is None:
+                continue
+            ridx = stack.row_index.get(row_id)
+            if ridx is None:
+                if stack.partial and row_id not in stack.absent_rows:
+                    self._partial_miss(
+                        index, field_name, view_name, row_id, lw, stack
+                    )
                 continue
             i_mat = lw.add_matrix(stack.matrix)
-            i_idx = lw.scalar_ref(stack.row_index[row_id])
+            i_idx = lw.scalar_ref(ridx)
             leaves.append(("row", i_mat, i_idx))
         if not leaves:
             return self._lower_zero(lw)
@@ -1498,6 +2110,13 @@ class MeshEngine:
         stack = lw.stack_for(index, field, VIEW_STANDARD)
         if stack is None:
             return self._lower_zero(lw)
+        ridx = stack.row_index.get(row_id)
+        if ridx is None and stack.partial and row_id not in stack.absent_rows:
+            # Partial stack, UNCOVERED row: absence does not mean empty
+            # here — the row lives in the host tier.  Request promotion
+            # of the query's working set and serve from the host path
+            # (raises ResidencyMiss).
+            self._partial_miss(index, field, VIEW_STANDARD, row_id, lw, stack)
         if lw.scalar_values is not None:
             # Slot-vector (batched) mode: row PRESENCE must be data, not
             # program structure — a ("zero",) leaf for a missing row id
@@ -1506,11 +2125,11 @@ class MeshEngine:
             # the fixed tiers exist to kill.  ("rowm", ...) gathers with
             # the slot's index and masks to zero when it carries -1.
             i_mat = lw.add_matrix(stack.matrix)
-            return ("rowm", i_mat, lw.scalar_ref(stack.row_index.get(row_id, -1)))
-        if row_id not in stack.row_index:
+            return ("rowm", i_mat, lw.scalar_ref(-1 if ridx is None else ridx))
+        if ridx is None:
             return self._lower_zero(lw)
         i_mat = lw.add_matrix(stack.matrix)
-        i_idx = lw.scalar_ref(stack.row_index[row_id])
+        i_idx = lw.scalar_ref(ridx)
         return ("row", i_mat, i_idx)
 
     def _plane_spec(self, stack: _FieldStack, depth: int):
@@ -1537,6 +2156,11 @@ class MeshEngine:
         stack = lw.stack_for(index, field_name, view_bsi_name(field_name))
         if stack is None:
             return self._lower_zero(lw)
+        # BSI predicates walk every plane row: a partial stack cannot
+        # serve them — full promotion + host fallback.
+        self._require_full_stack(
+            index, field_name, view_bsi_name(field_name), stack
+        )
         i_mat = lw.add_matrix(stack.matrix)
         pspec = self._plane_spec(stack, depth)
 
@@ -1576,6 +2200,67 @@ class MeshEngine:
             cond.op
         ]
         return ("range", kind, i_mat, pspec, i_bits)
+
+    def _collect_row_hints(self, index: str, c: Call, out=None):
+        """(index, field, view) -> row ids the lowered tree will touch
+        (None = whole stack required), mirroring _lower's leaf walk
+        WITHOUT fetching stacks.  Collected BEFORE lowering so a
+        cold-stack miss enqueues ONE promotion covering the query's
+        whole working set instead of converging one row per retry.
+        Best-effort: anything the walk doesn't understand marks the
+        field's stack full-required; lowering surfaces real errors."""
+        if out is None:
+            out = {}
+
+        def add(field, view, row_id):
+            key = (index, field, view)
+            cur = out.get(key, ())
+            if cur is None:
+                return  # full already required
+            rows = cur if cur != () else set()
+            rows.add(int(row_id))
+            out[key] = rows
+
+        try:
+            name = c.name
+            if name == "Row":
+                field = c.field_arg()
+                row_id, ok = c.uint_arg(field)
+                if ok:
+                    add(field, VIEW_STANDARD, row_id)
+            elif name == "Not":
+                from ..core.index import EXISTENCE_FIELD_NAME
+
+                add(EXISTENCE_FIELD_NAME, VIEW_STANDARD, 0)
+                for ch in c.children:
+                    self._collect_row_hints(index, ch, out)
+            elif name in ("Union", "Intersect", "Difference", "Xor"):
+                for ch in c.children:
+                    self._collect_row_hints(index, ch, out)
+            elif name == "Range" and c.has_condition_arg():
+                (field, _cond), = c.args.items()
+                out[(index, field, view_bsi_name(field))] = None
+            elif name == "Range":
+                import datetime as dt
+
+                from ..core import timequantum
+
+                field = c.field_arg()
+                row_id, ok = c.uint_arg(field)
+                idx = self.holder.index(index)
+                f = idx.field(field) if idx is not None else None
+                if ok and f is not None and f.time_quantum():
+                    start = dt.datetime.strptime(
+                        c.args["_start"], "%Y-%m-%dT%H:%M"
+                    )
+                    end = dt.datetime.strptime(c.args["_end"], "%Y-%m-%dT%H:%M")
+                    for vname in timequantum.views_by_time_range(
+                        VIEW_STANDARD, start, end, f.time_quantum()
+                    ):
+                        add(field, vname, row_id)
+        except Exception:  # noqa: BLE001 — hints are advisory only
+            pass
+        return out
 
     # -- fused evaluation ---------------------------------------------------
 
@@ -1835,6 +2520,7 @@ class MeshEngine:
 
     def _dispatch_count(self, index, c, shards, canonical):
         lw = _Lowering(self, canonical)
+        lw.row_hints = self._collect_row_hints(index, c)
         prog = self._lower(index, c, lw)
         mask = self._mask_words(shards, canonical)
         plan = self._sparse_plan(prog, lw, shards, canonical)
@@ -1911,6 +2597,18 @@ class MeshEngine:
                 )
                 if st is None or ridx is None or ridx >= st.occ.shape[0]:
                     raise _NotSparse
+                if st.block_mask is not None and np.any(
+                    st.occ[ridx] & ~st.block_mask[ridx]
+                ):
+                    # Partial-stack residency invariant broken: an
+                    # occupied block is not device-resident.  The sync
+                    # path keeps mask >= occ, so this is structurally
+                    # unreachable — but if it ever fires, serve from
+                    # the host tier rather than count stale zeros.
+                    raise ResidencyMiss(
+                        "occupied blocks not device-resident on a "
+                        "partial stack"
+                    )
                 mkey = id(st.matrix)
                 mslot = mat_slots.get(mkey)
                 if mslot is None:
@@ -2499,6 +3197,7 @@ class MeshEngine:
         # local-only there, so the scalar detour buys nothing.
         if len(u_calls) == 1 and not self.multiproc:
             lw1 = _Lowering(self, canonical)
+            lw1.row_hints = self._collect_row_hints(index, u_calls[0])
             prog1 = self._lower(index, u_calls[0], lw1)
             mask1 = self._mask_words(u_shards[0], canonical)
             plan = self._sparse_plan(prog1, lw1, u_shards[0], canonical)
@@ -2518,6 +3217,8 @@ class MeshEngine:
                 )
             return jnp.broadcast_to(dev, (len(calls),))
         lw = _Lowering(self, canonical, slot_vector=True)
+        for c in u_calls:
+            self._collect_row_hints(index, c, lw.row_hints)
         progs = []
         for c, shards in zip(u_calls, u_shards):
             prog = self._lower(index, c, lw)
@@ -2609,6 +3310,7 @@ class MeshEngine:
             )
         def sp_dispatch():
             lw = _Lowering(self, canonical)
+            lw.row_hints = self._collect_row_hints(index, c)
             prog = self._lower(index, c, lw)
             mask = self._mask_words(shards, canonical)
             self._note_fused_dispatch()
@@ -2664,6 +3366,9 @@ class MeshEngine:
         stack = self.field_stack(index, field_name, view_bsi_name(field_name))
         if stack is None:
             return None
+        self._require_full_stack(
+            index, field_name, view_bsi_name(field_name), stack
+        )
         canonical = stack.shards
         mask = self._mask_words(shards, canonical)
 
@@ -2731,6 +3436,9 @@ class MeshEngine:
         stack = self.field_stack(index, field_name, view_bsi_name(field_name))
         if stack is None:
             return None
+        self._require_full_stack(
+            index, field_name, view_bsi_name(field_name), stack
+        )
         canonical = stack.shards
         mask = self._mask_words(shards, canonical)
 
@@ -2805,6 +3513,7 @@ class MeshEngine:
         stack = self.field_stack(index, field, VIEW_STANDARD)
         if stack is None:
             return None
+        self._require_full_stack(index, field, VIEW_STANDARD, stack)
         present = np.asarray(
             [r in stack.row_index for r in candidate_rows], dtype=bool
         )
@@ -2968,6 +3677,7 @@ class MeshEngine:
         stack = self.field_stack(index, field, VIEW_STANDARD)
         if stack is None:
             return [], None, None
+        self._require_full_stack(index, field, VIEW_STANDARD, stack)
         if replay_cands is not None:
             entry = self._build_topn_candidates(
                 index, field, stack, list(replay_cands)
@@ -3059,6 +3769,7 @@ class MeshEngine:
         stack = self.field_stack(index, field, VIEW_STANDARD)
         if stack is None:
             return []
+        self._require_full_stack(index, field, VIEW_STANDARD, stack)
         entry = self._topn_candidates(index, field, stack, row_ids)
         if row_ids:
             n = 0  # explicit ids: never truncate
@@ -3133,6 +3844,7 @@ class MeshEngine:
             stack = self.field_stack(index, fname, VIEW_STANDARD, canonical)
             if stack is None:
                 return None
+            self._require_full_stack(index, fname, VIEW_STANDARD, stack)
             stacks.append(stack)
             t = tuple(stack.row_index.get(r, 0) for r in rows)
             # Full-row-table (gather-free) lists become static compile
@@ -3211,6 +3923,10 @@ class MeshEngine:
         keep every buffer reachable.  Wired from server.close().
         Idempotent; a closed engine can still serve (caches simply
         rebuild) but deployments shouldn't."""
+        try:
+            self.residency.close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
         syncer = self._ingest_syncer
         if syncer is not None:
             try:
@@ -3273,8 +3989,30 @@ class MeshEngine:
         with self._stacks_lock:
             resident = self._resident_bytes
             pending = self._pending_bytes()
+            res_blocks = 0
+            tot_blocks = 0
+            for st in self._stacks.values():
+                if st.occ is not None:
+                    # Occupied blocks actually resident on device
+                    # (popcount_np: numpy<2 safe, unlike bitwise_count).
+                    rb = bitops.popcount_np(st.occ)
+                    tb = (
+                        st.universe_blocks
+                        if st.partial and st.universe_blocks is not None
+                        else rb
+                    )
+                else:  # multi-process: no summaries — row-weighted
+                    rb = len(st.row_index) if st.partial else st.universe_rows
+                    tb = st.universe_rows
+                res_blocks += rb
+                tot_blocks += max(tb, rb)  # writes may grow occ past the
+                #                            promotion-time denominator
         REGISTRY.set_gauge(METRIC_ENGINE_RESIDENT_BYTES, resident)
         REGISTRY.set_gauge(METRIC_ENGINE_EVICTED_BYTES, pending)
+        REGISTRY.set_gauge(
+            METRIC_ENGINE_RESIDENT_BLOCK_FRACTION,
+            round(res_blocks / tot_blocks, 4) if tot_blocks else 1.0,
+        )
         REGISTRY.set_gauge(METRIC_ENGINE_COMPILE_KEYS, _compile_cache_keys())
         n_dev = int(self.mesh.devices.size)
         REGISTRY.set_gauge(METRIC_MESH_DEVICES, n_dev)
@@ -3287,6 +4025,48 @@ class MeshEngine:
             METRIC_MESH_SHARDS_PER_DEVICE,
             pad_shards(widest, self.mesh) // n_dev if widest else 0,
         )
+
+    def _working_set_snapshot(self) -> dict:
+        """Per-index resident-vs-total working-set accounting for
+        /debug/vars engineCaches (docs/residency.md): the PR 9 plan
+        analyzer reads this to annotate slow queries with their stack's
+        residency, and operators read eviction pressure from it."""
+        per: Dict[str, dict] = {}
+        with self._stacks_lock:
+            for (idx, _f, _v), st in self._stacks.items():
+                d = per.setdefault(
+                    idx,
+                    {
+                        "stacks": 0, "partialStacks": 0,
+                        "residentBytes": 0, "totalBytes": 0,
+                    },
+                )
+                d["stacks"] += 1
+                if st.partial:
+                    d["partialStacks"] += 1
+                d["residentBytes"] += int(st.footprint)
+                S = int(st.matrix.shape[1]) if hasattr(st.matrix, "shape") else 0
+                d["totalBytes"] += (
+                    int(st.universe_rows) * S * self._row_shard_bytes()
+                )
+        for d in per.values():
+            d["residentFraction"] = (
+                round(min(1.0, d["residentBytes"] / d["totalBytes"]), 4)
+                if d["totalBytes"]
+                else 1.0
+            )
+        res = self.residency.snapshot()
+        return {
+            "perIndex": per,
+            "pendingPromotions": res["pendingPromotions"],
+            "inflightBytes": res["inflightBytes"],
+            "evictionPressure": {
+                "evictions": int(self._evictions_counter.get()),
+                "promotionsDeclined": res["declined"],
+                "hostFallbacks": self.host_fallbacks,
+            },
+            "deviceBudgetBytes": self.max_resident_bytes,
+        }
 
     def cache_snapshot(self) -> dict:
         """Cache/skip telemetry for /debug/vars: per-cache hit/miss
@@ -3316,6 +4096,9 @@ class MeshEngine:
             "resultMemoEntries": len(self.result_memo),
             "sparseDispatches": self.sparse_dispatches,
             "deviceBytesSkipped": self.device_bytes_skipped,
+            "hostFallbacks": self.host_fallbacks,
+            "residency": self.residency.snapshot(),
+            "workingSet": self._working_set_snapshot(),
             "batchCseDeduped": self.batch_cse_deduped,
             "fusedPrograms": self.fused_programs,
             "fusedProgramQueries": self.fused_program_queries,

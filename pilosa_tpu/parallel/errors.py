@@ -14,3 +14,19 @@ class PeerlessMeshError(RuntimeError):
     rejected, commit lost).  Entering the collective would hang forever,
     so fused paths fall back to the per-shard host path instead: peer
     outage degrades to local service, never to a hung psum."""
+
+
+class ResidencyMiss(PeerlessMeshError):
+    """The query's field stack (or the rows/blocks it touches) is not
+    device-resident and would not fit the device budget as a whole — an
+    async promotion of the touched working set has been ENQUEUED and the
+    query must serve from the compressed host tier instead of blocking
+    on (or OOMing) a device upload (docs/residency.md).  Subclasses
+    PeerlessMeshError deliberately: every fused engine path the executor
+    guards already degrades to the bit-exact per-shard host loop on that
+    type, so a cold stack costs latency, never correctness or a 500."""
+
+    def __init__(self, msg: str, key=None, resident_fraction: float = 0.0):
+        super().__init__(msg)
+        self.key = key
+        self.resident_fraction = resident_fraction

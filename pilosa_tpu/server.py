@@ -367,9 +367,20 @@ class Server:
                 mesh = multihost.global_mesh(self.config.mesh_devices or None)
             else:
                 mesh = make_mesh(self.config.mesh_devices or None)
+            kwargs = {}
+            if self.config.engine_device_budget_bytes > 0:
+                kwargs["max_resident_bytes"] = (
+                    self.config.engine_device_budget_bytes
+                )
             engine = MeshEngine(
-                self.holder, mesh, logger=self.logger, journal=self.journal
+                self.holder, mesh, logger=self.logger, journal=self.journal,
+                **kwargs,
             )
+            # Seed the residency/warm-start cost signal from the last
+            # run's persisted per-tenant device-cost EWMAs
+            # (docs/residency.md): a restarted node re-warms its HOT
+            # tenants' stacks first instead of holder iteration order.
+            self._load_tenant_costs()
             if self.config.mesh_peers:
                 from concurrent.futures import ThreadPoolExecutor
 
@@ -816,6 +827,52 @@ class Server:
                 for v in f.views.values():
                     for frag in v.fragments.values():
                         frag.flush_cache()
+        # Piggyback the per-tenant device-cost EWMA persistence on the
+        # flush tick: the snapshot is tiny (<=256 tenants) and feeds the
+        # NEXT boot's warm-start ordering (docs/residency.md).
+        self._save_tenant_costs()
+
+    # Persisted per-tenant device-cost EWMAs (docs/residency.md): the
+    # warm-start ordering signal survives restarts.
+    TENANT_COSTS_FILE = ".tenant_costs"
+
+    def _tenant_costs_path(self) -> str:
+        return os.path.join(self.data_dir, self.TENANT_COSTS_FILE)
+
+    def _save_tenant_costs(self):
+        from .util import plans as plans_mod
+
+        try:
+            snap = plans_mod.LEDGER.ewma_snapshot()
+            if not snap:
+                return
+            import json as json_mod
+
+            tmp = self._tenant_costs_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json_mod.dump(
+                    {t: round(v, 9) for t, v in snap.items()}, f
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._tenant_costs_path())
+        except Exception as e:  # noqa: BLE001 — telemetry persistence
+            self.logger.printf("tenant-cost snapshot failed: %s", e)
+
+    def _load_tenant_costs(self):
+        from .util import plans as plans_mod
+
+        try:
+            with open(self._tenant_costs_path()) as f:
+                import json as json_mod
+
+                doc = json_mod.load(f)
+            if isinstance(doc, dict):
+                plans_mod.LEDGER.seed_costs(doc)
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 — corrupt snapshot: cold order
+            self.logger.printf("tenant-cost snapshot unreadable: %s", e)
 
     def _monitor_runtime(self):
         """Runtime metrics loop (server.go monitorRuntime :726-790:
@@ -836,6 +893,8 @@ class Server:
     def close(self):
         self._closing.set()
         self.journal.append("server.shutdown", node=self.node_id)
+        # Persist the warm-start ordering signal before teardown.
+        self._save_tenant_costs()
         if getattr(self, "_membership_events", None) is not None:
             self._membership_events.put(None)
         if getattr(self, "gossip", None) is not None:

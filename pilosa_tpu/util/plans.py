@@ -294,6 +294,16 @@ def analyze(plan: QueryPlan, slow: bool = False) -> List[str]:
                 f"{op.get('blocks_surviving', '?')}/{op.get('blocks_total', '?')}"
                 f" blocks, {op.get('bytes_skipped', 0)} bytes skipped"
             )
+        elif path == "host_fallback":
+            # Tiered residency (docs/residency.md): the stack (or the
+            # rows this query touched) was not device-resident; the
+            # query served from the compressed host tier while the
+            # async promotion ran.
+            notes.append(
+                f"host fallback: stack {op.get('stack', '?')} "
+                f"{_pct(float(op.get('resident_fraction', 0.0)))} resident "
+                "(async promotion enqueued)"
+            )
         reason = op.get("memo_reason")
         if op.get("memo") == "miss" and reason == "version_token_advanced":
             notes.append("memo miss: version token advanced (write since last run)")
@@ -490,6 +500,13 @@ class TenantLedger:
         # pull-time collection, same as the engine/cache gauges.
         self._flushed: Dict[str, list] = {}
         self._admission = None
+        # tenant -> EWMA device-seconds per query — the ledger's own
+        # copy of the measured-cost signal (the admission controller
+        # keeps an equivalent one).  The residency layer prices stack
+        # eviction with it (hot tenants keep their working set,
+        # docs/residency.md), warm-start orders residency builds by it,
+        # and the server persists/reseeds it across restarts.
+        self._ewma: Dict[str, float] = {}
 
     def bind_admission(self, admission):
         """Wire the measured-cost feedback loop: every accounted query
@@ -533,6 +550,10 @@ class TenantLedger:
                 )
         return tenant, row, self._series[tenant]
 
+    # EWMA smoothing for the ledger's own cost signal (matches the
+    # admission controller's AdmissionController.COST_EWMA).
+    COST_EWMA = 0.2
+
     def account(self, plan: QueryPlan):
         dev = plan.device_seconds
         touched = plan.bytes_touched
@@ -543,9 +564,38 @@ class TenantLedger:
             row[1] += dev
             row[2] += touched
             row[3] += skipped
+            prev = self._ewma.get(tenant)
+            self._ewma[tenant] = (
+                dev if prev is None
+                else (1 - self.COST_EWMA) * prev + self.COST_EWMA * dev
+            )
         adm = self._admission
         if adm is not None and hasattr(adm, "note_cost"):
             adm.note_cost(tenant, dev)
+
+    def cost_ewma(self, tenant: str) -> float:
+        """The tenant's measured device-cost EWMA (0.0 when unseen) —
+        the residency eviction/warm-start pricing signal."""
+        with self._lock:
+            return self._ewma.get(tenant, 0.0)
+
+    def ewma_snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._ewma)
+
+    def seed_costs(self, costs: Dict[str, float]):
+        """Reseed the cost EWMAs from a persisted snapshot (server boot:
+        warm-start orders residency builds by LAST RUN's hot tenants).
+        Live measurements take over as queries flow — seeding never
+        overwrites a tenant that already has a live signal."""
+        with self._lock:
+            for tenant, v in costs.items():
+                try:
+                    v = float(v)
+                except (TypeError, ValueError):
+                    continue
+                if tenant not in self._ewma and v > 0:
+                    self._ewma[str(tenant)] = v
 
     def note_shed(self, tenant: str):
         with self._lock:
@@ -593,6 +643,7 @@ class TenantLedger:
         with self._lock:
             self._tenants.clear()
             self._flushed.clear()
+            self._ewma.clear()
             # Registry counters stay at their last-flushed values
             # (monotonic contract); only the ledger's own view resets.
 

@@ -388,6 +388,36 @@ METRIC_ENGINE_RESIDENT_BYTES = "pilosa_engine_resident_bytes"
 METRIC_ENGINE_EVICTED_BYTES = "pilosa_engine_evicted_bytes"
 METRIC_ENGINE_EVICTIONS = "pilosa_engine_evictions_total"
 METRIC_ENGINE_REBUILDS = "pilosa_engine_stack_rebuilds_total"
+# -- tiered residency (docs/residency.md) -----------------------------------
+#   pilosa_engine_promotions_total          async working-set promotions that
+#                                           made a stack FULLY resident
+#   pilosa_engine_partial_promotions_total  promotions that admitted only the
+#                                           touched row/block subset of a
+#                                           stack (device as a cache over the
+#                                           compressed host tier)
+#   pilosa_engine_promotions_declined_total promotion requests declined (the
+#                                           working set would not fit the
+#                                           device budget even partially)
+#   pilosa_engine_promoted_bytes_total      device bytes shipped by the
+#                                           promotion worker (its wall-clock
+#                                           busy seconds live in the manager
+#                                           snapshot — the ratio is the
+#                                           host-decode/device-upload overlap
+#                                           throughput bench.py reports as
+#                                           promotion_overlap_mbits_s)
+#   pilosa_engine_host_fallbacks_total      queries served from the host tier
+#                                           because their stack was not (yet)
+#                                           resident — each enqueued an async
+#                                           promote instead of blocking
+#   pilosa_engine_resident_block_fraction   gauge: occupancy blocks resident
+#                                           on device / blocks in the full
+#                                           row universe, over known stacks
+METRIC_ENGINE_PROMOTIONS = "pilosa_engine_promotions_total"
+METRIC_ENGINE_PARTIAL_PROMOTIONS = "pilosa_engine_partial_promotions_total"
+METRIC_ENGINE_PROMOTIONS_DECLINED = "pilosa_engine_promotions_declined_total"
+METRIC_ENGINE_PROMOTED_BYTES = "pilosa_engine_promoted_bytes_total"
+METRIC_ENGINE_HOST_FALLBACKS = "pilosa_engine_host_fallbacks_total"
+METRIC_ENGINE_RESIDENT_BLOCK_FRACTION = "pilosa_engine_resident_block_fraction"
 METRIC_ENGINE_COMPILE = "pilosa_engine_compile_total"
 METRIC_ENGINE_COMPILE_SECONDS = "pilosa_engine_compile_seconds"
 METRIC_ENGINE_COMPILE_KEYS = "pilosa_engine_compile_cache_keys"
@@ -614,6 +644,27 @@ REGISTRY.counter(
 REGISTRY.counter(
     METRIC_ENGINE_REBUILDS, help="Engine full field-stack (re)builds"
 )
+REGISTRY.counter(
+    METRIC_ENGINE_PROMOTIONS,
+    help="Async residency promotions completing a FULL stack",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_PARTIAL_PROMOTIONS,
+    help="Async residency promotions admitting a partial (working-set) stack",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_PROMOTIONS_DECLINED,
+    help="Promotion requests declined (would not fit the device budget)",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_PROMOTED_BYTES,
+    help="Device bytes shipped by the residency promotion worker",
+)
+REGISTRY.counter(
+    METRIC_ENGINE_HOST_FALLBACKS,
+    help="Queries served from the host tier while their stack promotes",
+)
+REGISTRY.set_gauge(METRIC_ENGINE_RESIDENT_BLOCK_FRACTION, 1.0)
 REGISTRY.counter(
     METRIC_ENGINE_COMPILE, help="XLA backend compiles observed in-process"
 )

@@ -116,6 +116,16 @@ AUTO_REQUIRE = (
     "dashboard_fused_qps",
     "dashboard_p50_ms",
     "dashboard_fused_speedup",
+    # Tiered-residency headlines (bench.py --residency-sweep,
+    # docs/residency.md): the warm dashboard p50 at 4x oversubscription
+    # (ms regress UP), the device-served fraction of the repeated phase
+    # (ABS_FLOORed below — the ISSUE 15 >0.5 acceptance is a standing
+    # contract), and the promotion worker's overlap throughput.
+    # Required once baselined so the bigger-than-HBM lane cannot be
+    # silently dropped.
+    "oversubscribed_4x_count_p50_ms",
+    "residency_hit_rate",
+    "promotion_overlap_mbits_s",
 )
 
 # Direction overrides for metrics whose UNIT would mislead: the unit
@@ -127,6 +137,7 @@ NAME_HIGHER_BETTER = {
     "destructive_write_availability_pct",
     "replica_read_qps_gain",
     "dashboard_fused_speedup",
+    "residency_hit_rate",
 }
 
 # Built-in per-metric tolerance (used when no --metric-tolerance names
@@ -157,6 +168,9 @@ ABS_FLOOR = {
     "availability_under_failure_pct": 90.0,
     "destructive_write_availability_pct": 90.0,
     "dashboard_fused_speedup": 1.5,
+    # The ISSUE 15 acceptance: >0.5 of the repeated-dashboard phase
+    # must serve from device residency at 4x oversubscription.
+    "residency_hit_rate": 0.5,
 }
 
 

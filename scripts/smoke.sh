@@ -94,6 +94,12 @@ required = [
     "pilosa_engine_fused_program_queries_total",
     "pilosa_engine_fused_program_masks_evaluated_total",
     "pilosa_engine_fused_program_masks_referenced_total",
+    # Tiered residency (docs/residency.md).
+    "pilosa_engine_promotions_total",
+    "pilosa_engine_partial_promotions_total",
+    "pilosa_engine_promotions_declined_total",
+    "pilosa_engine_host_fallbacks_total",
+    "pilosa_engine_resident_block_fraction",
 ]
 missing = [s for s in required if s not in text]
 assert not missing, f"/metrics is missing required series: {missing}"
@@ -1017,3 +1023,123 @@ finally:
     for p in procs:
         p.communicate(timeout=30)
 EOF
+
+# Tiered-residency smoke (docs/residency.md): boot a server whose engine
+# has a DELIBERATELY tiny device budget (no full stack fits).  A cold
+# query must succeed via the host-tier fallback while an async partial
+# promotion runs; the repeat must dispatch on device (no new fallback, a
+# new psum dispatch); and the residency series must carry the story at
+# /metrics + /debug/vars engineCaches.workingSet.
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import time
+import urllib.request
+
+from pilosa_tpu.api import API
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.net import serve
+from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+holder = Holder()
+holder.open()
+idx = holder.create_index("rsmoke")
+f = idx.create_field("rf")
+rows, cols = [], []
+for r in range(8):
+    for c in range(0, 64 + 8 * r, 2):
+        rows.append(r)
+        cols.append(c)
+f.import_bulk(rows, cols)
+ROW_SHARD = 32768 * 4 + 16
+# Budget fits ~3 of the 8 rows: the full stack must NOT fit.
+eng = MeshEngine(holder, make_mesh(1), max_resident_bytes=3 * ROW_SHARD)
+# The repeat must exercise the RESIDENCY path, not the result memo.
+eng.result_memo.maxsize = 0
+api = API(holder=holder, mesh_engine=eng)
+srv, _ = serve(api, port=0)
+port = srv.server_address[1]
+
+
+def post_count():
+    req = urllib.request.Request(
+        f"http://localhost:{port}/index/rsmoke/query",
+        data=b"Count(Intersect(Row(rf=1), Row(rf=2)))",
+        method="POST",
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+
+def scrape():
+    return urllib.request.urlopen(
+        f"http://localhost:{port}/metrics", timeout=30
+    ).read().decode()
+
+
+def sample(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rpartition(" ")[2])
+    return None
+
+
+# Host-side expected count for Intersect(Row 1, Row 2).
+s1 = {c for r, c in zip(rows, cols) if r == 1}
+s2 = {c for r, c in zip(rows, cols) if r == 2}
+want = len(s1 & s2)
+
+# COLD: correct via host fallback, promotion enqueued.
+doc = post_count()
+assert doc["results"][0] == want, doc
+assert eng.host_fallbacks >= 1, eng.host_fallbacks
+text = scrape()
+assert sample(text, "pilosa_engine_host_fallbacks_total") >= 1, "fallback series"
+
+# Promotion drains in the background; poll the COUNTER, like an operator.
+end = time.time() + 30
+while time.time() < end:
+    text = scrape()
+    if (sample(text, "pilosa_engine_partial_promotions_total") or 0) >= 1:
+        break
+    time.sleep(0.2)
+else:
+    raise AssertionError("partial promotion never landed")
+
+# WARM repeat: device-served — no new fallback, a NEW psum dispatch.
+fb0 = eng.host_fallbacks
+disp0 = sample(scrape(), "pilosa_mesh_psum_dispatches_total") or 0
+doc = post_count()
+assert doc["results"][0] == want, doc
+assert eng.host_fallbacks == fb0, "repeat fell back to the host tier"
+text = scrape()
+assert (sample(text, "pilosa_mesh_psum_dispatches_total") or 0) > disp0, (
+    "repeat did not dispatch on device")
+for series in (
+    "pilosa_engine_promotions_total",
+    "pilosa_engine_partial_promotions_total",
+    "pilosa_engine_evictions_total",
+    "pilosa_engine_resident_block_fraction",
+):
+    assert series in text, f"/metrics missing {series}"
+frac = sample(text, "pilosa_engine_resident_block_fraction")
+assert 0.0 < frac < 1.0, f"partial stack should report fraction in (0,1): {frac}"
+
+# /debug/vars engineCaches carries the working-set state the plan
+# analyzer annotates slow queries with.
+dv = json.loads(urllib.request.urlopen(
+    f"http://localhost:{port}/debug/vars", timeout=30).read())
+ws = dv["engineCaches"]["workingSet"]
+per = ws["perIndex"]["rsmoke"]
+assert per["partialStacks"] >= 1, ws
+assert 0.0 < per["residentFraction"] < 1.0, ws
+assert "evictionPressure" in ws and "pendingPromotions" in ws, ws
+print(
+    "residency smoke OK: cold query -> host fallback + async partial "
+    f"promotion -> repeat on device (resident fraction {frac}); "
+    "pilosa_engine_{promotions,partial_promotions,evictions}_total + "
+    "pilosa_engine_resident_block_fraction live at /metrics"
+)
+srv.shutdown(); srv.server_close()
+eng.close()
+EOF
+
+echo "smoke OK"
