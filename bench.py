@@ -1735,6 +1735,203 @@ def density_sweep():
     )
 
 
+# ---- repair-on-write: O(changed-bits) maintenance (--repair-sweep) ---------
+
+RPS_SHARDS = 8
+RPS_SEG_ROWS = 16
+RPS_BUILD_BITS = 4000  # per seg shard
+RPS_ROUNDS = 12
+RPS_WRITES_PER_ROUND = 64  # bits per touched shard per round
+RPS_READS_PER_ROUND = 5    # timed dashboard serves per write burst
+RPS_IDLE_REPS = 24
+
+
+def repair_sweep():
+    """Repair-on-write differential oracle + headline lane
+    (docs/incremental.md): a fixed dashboard (two Counts, a TopN, a
+    GroupBy, a Sum) runs repeatedly while randomized instrumented
+    writes stream in between rounds.  Every round's served results are
+    compared bit-exact against a full recompute with the repair layer
+    suspended AND the memo cleared — including rounds that force a
+    stale-base fallback through an un-instrumented write path
+    (clear_row publishes OPAQUE, so the repair layer must refuse and
+    recompute).  Emits the guarded headlines:
+
+      result_memo_hit_rate_under_write_load   fraction of dashboard
+                                              probes answered by the
+                                              memo or an O(changed-bits)
+                                              repair (acceptance >=0.8)
+      dashboard_p50_under_ingest_vs_idle      dashboard wall p50 ratio,
+                                              write rounds vs idle
+                                              (acceptance <=1.5x)
+
+    plus dashboard_repair_serve_p50_ms (the first serve after a write
+    burst — the one that pays the repair) and
+    repair_touched_words_per_repair (the O(touched rows) cost evidence:
+    words read scale with the write, not the data)."""
+    progress("importing jax (repair sweep)")
+    import jax
+
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+    from pilosa_tpu.ops import SHARD_WIDTH
+
+    rng = np.random.default_rng(16)
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("rpw")
+    idx.create_field("seg")
+    idx.create_field("g1")
+    idx.create_field("g2")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=1023))
+    shards = list(range(RPS_SHARDS))
+
+    seg_view = idx.field("seg").view_if_not_exists("standard")
+    for s in shards:
+        frag = seg_view.fragment_if_not_exists(s)
+        frag.bulk_import(
+            rng.integers(0, RPS_SEG_ROWS, RPS_BUILD_BITS),
+            rng.integers(0, SHARD_WIDTH, RPS_BUILD_BITS),
+        )
+    for fname, nrows in (("g1", 6), ("g2", 5)):
+        gview = idx.field(fname).view_if_not_exists("standard")
+        for s in shards:
+            gview.fragment_if_not_exists(s).bulk_import(
+                rng.integers(0, nrows, 800),
+                rng.integers(0, SHARD_WIDTH, 800),
+            )
+    progress("repair sweep build done")
+
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder, mesh_engine=eng)
+
+    def q(query):
+        return ex.execute("rpw", query).results[0]
+
+    # BSI values through the executor (instrumented set_value path).
+    for col in rng.integers(0, RPS_SHARDS * SHARD_WIDTH, 600):
+        q(f"Set({int(col)}, v={int(rng.integers(0, 1024))})")
+
+    dashboard = (
+        "Count(Intersect(Row(seg=1), Row(seg=2)))",
+        "Count(Union(Row(seg=3), Row(seg=4), Row(seg=5)))",
+        "TopN(seg, n=8)",
+        "GroupBy(Rows(field=g1), Rows(field=g2))",
+        "Sum(field=v)",
+    )
+    MEMO_CACHES = ("result_memo", "memo_sum", "memo_topn", "memo_groupby")
+
+    def dash():
+        return [q(query) for query in dashboard]
+
+    def recompute():
+        with eng.repairs.suspended():
+            eng.result_memo.clear()
+            return [q(query) for query in dashboard]
+
+    def memo_tally():
+        stats = eng.cache_snapshot()["caches"]
+        hits = sum(stats.get(n, {"hits": 0})["hits"] for n in MEMO_CACHES)
+        misses = sum(
+            stats.get(n, {"misses": 0})["misses"] for n in MEMO_CACHES
+        )
+        return hits, misses
+
+    # Warm + idle phase: every repeat must answer from the memo.
+    base = dash()
+    assert base == recompute(), "idle dashboard vs recompute"
+    h0, m0 = memo_tally()
+    t_idle, got = sync_p50(lambda i: dash(), reps=RPS_IDLE_REPS)
+    assert got == base
+    h1, m1 = memo_tally()
+    rate_idle = (h1 - h0) / max((h1 - h0) + (m1 - m0), 1)
+    progress(f"idle: p50 {t_idle * 1e3:.2f}ms, memo rate {rate_idle:.3f}")
+
+    # Write rounds: randomized instrumented writes, then the dashboard,
+    # then the suspended-recompute oracle.  Every third round also
+    # forces a stale base through clear_row (un-instrumented -> OPAQUE
+    # packet): the repair layer must fall back, not serve stale.
+    rep0 = sum(eng.repairs.repaired.values())
+    fb0 = sum(eng.repairs.fallbacks.values())
+    tw0 = eng.repairs.touched_words
+    times = []        # every timed dashboard run (the serving p50)
+    first_times = []  # first run after each write burst: pays the repair
+    hits_acc = miss_acc = 0
+    forced_stale = 0
+    for rnd in range(RPS_ROUNDS):
+        for s in rng.choice(RPS_SHARDS, 2, replace=False):
+            holder.fragment("rpw", "seg", "standard", int(s)).bulk_import(
+                rng.integers(0, RPS_SEG_ROWS, RPS_WRITES_PER_ROUND),
+                rng.integers(0, SHARD_WIDTH, RPS_WRITES_PER_ROUND),
+            )
+        gf = "g1" if rnd % 2 else "g2"
+        gs = int(rng.integers(0, RPS_SHARDS))
+        holder.fragment("rpw", gf, "standard", gs).set_bit(
+            int(rng.integers(0, 5)),
+            gs * SHARD_WIDTH + int(rng.integers(0, SHARD_WIDTH)),
+        )
+        q(f"Set({int(rng.integers(0, RPS_SHARDS * SHARD_WIDTH))}, "
+          f"v={int(rng.integers(0, 1024))})")
+        if rnd % 3 == 2:
+            # Un-instrumented write: row 0's bits vanish with no delta
+            # packet — repair MUST refuse (opaque) and recompute.
+            frag = holder.fragment("rpw", "seg", "standard", 0)
+            frag.clear_row(0)
+            forced_stale += 1
+        # Dashboards read more often than they're written: five timed
+        # serves per write burst (the first pays the repair; the later
+        # ones hit the memo the repair refreshed).  The oracle recompute
+        # runs OUTSIDE the tally window — its deliberate misses must
+        # not be billed to the serving path.
+        hb, mb = memo_tally()
+        served = None
+        for rep in range(RPS_READS_PER_ROUND):
+            t0 = time.perf_counter()
+            served = dash()
+            dt = time.perf_counter() - t0
+            times.append(dt)
+            if rep == 0:
+                first_times.append(dt)
+        ha, ma = memo_tally()
+        hits_acc += ha - hb
+        miss_acc += ma - mb
+        want = recompute()
+        assert served == want, (
+            f"repair sweep round {rnd}: served != recompute\n"
+            f"  served: {served}\n  want:   {want}"
+        )
+    repaired = sum(eng.repairs.repaired.values()) - rep0
+    fallbacks = sum(eng.repairs.fallbacks.values()) - fb0
+    touched = eng.repairs.touched_words - tw0
+    # A probe that ends in repair counts as served-without-recompute;
+    # its memo miss is the write's fault, not the layer's.
+    rate_w = (hits_acc + repaired) / max(hits_acc + miss_acc, 1)
+    t_write = statistics.median(times)
+    assert fallbacks >= forced_stale, (fallbacks, forced_stale)
+    assert repaired > 0, "no repair ever served — the lane is dead"
+
+    emit_raw("result_memo_hit_rate_under_write_load", rate_w, "ratio",
+             rate_w / max(rate_idle, 1e-9))
+    emit_raw("dashboard_p50_under_ingest_vs_idle", t_write / t_idle, "x",
+             t_idle / t_write)
+    emit_raw("repair_touched_words_per_repair",
+             touched / max(repaired, 1), "words", 1.0)
+    emit_raw("dashboard_repair_serve_p50_ms",
+             statistics.median(first_times) * 1e3, "ms", 1.0)
+    snap = eng.repairs.snapshot()
+    progress(
+        f"write rounds: p50 {t_write * 1e3:.2f}ms ({t_write / t_idle:.2f}x "
+        f"idle), repair-serve p50 {statistics.median(first_times) * 1e3:.2f}"
+        f"ms, rate {rate_w:.3f}, repaired {repaired}, "
+        f"fallbacks {fallbacks} (forced {forced_stale}), "
+        f"touched words {touched}, hub {snap['hub']}"
+    )
+
+
 # ---- tiered residency: index >> device budget (--residency-sweep) ----------
 
 RSW_FIELDS = 4
@@ -2988,6 +3185,16 @@ if __name__ == "__main__":
         "OOMs by construction (docs/residency.md)",
     )
     ap.add_argument(
+        "--repair-sweep",
+        action="store_true",
+        help="run the repair-on-write sweep ONLY: a repeated dashboard "
+        "(Count/TopN/GroupBy/Sum) under interleaved randomized writes, "
+        "every round's served results asserted bit-exact against a "
+        "repair-suspended recompute (including forced stale-base "
+        "fallbacks); emits result_memo_hit_rate_under_write_load and "
+        "dashboard_p50_under_ingest_vs_idle (docs/incremental.md)",
+    )
+    ap.add_argument(
         "--ingest-sweep",
         action="store_true",
         help="run the ingest throughput sweep ONLY (sustained bulk-import "
@@ -3115,6 +3322,8 @@ if __name__ == "__main__":
         )
     elif args.profile_overhead:
         profile_overhead_bench()
+    elif args.repair_sweep:
+        repair_sweep()
     elif args.ingest_sweep:
         ingest_sweep()
     elif args.streaming_sweep:

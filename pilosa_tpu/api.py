@@ -228,6 +228,10 @@ class API:
         # deployment designates as sequencer.
         self._mesh_ticket_lock = threading.Lock()
         self._mesh_ticket_next = 0
+        # Continuous queries (net/cq.py), created on first POST /cq —
+        # most deployments never pay the sweeper thread.
+        self._cq = None
+        self._cq_lock = threading.Lock()
         if cluster is not None:
             self.attach_cluster(cluster, node)
 
@@ -254,6 +258,17 @@ class API:
             )
 
         self.holder.set_on_create_shard(on_create_shard)
+
+    @property
+    def cq(self):
+        """Continuous-query manager, created on first use."""
+        if self._cq is None:
+            with self._cq_lock:
+                if self._cq is None:
+                    from .net.cq import CQManager
+
+                    self._cq = CQManager(self)
+        return self._cq
 
     # -- queries (api.go Query :102) ---------------------------------------
 

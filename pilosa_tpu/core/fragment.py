@@ -44,6 +44,7 @@ from ..util.stats import (
     METRIC_INGEST_ACKED_UNSYNCED,
     REGISTRY,
 )
+from .delta import HUB as _DELTA
 
 
 def _timed(op: str):
@@ -200,6 +201,7 @@ class Fragment:
         cache_debounce: float = 0.0,
         row_attr_store=None,
         on_touch=None,
+        view_gen: int = 0,
         ack: str = DEFAULT_ACK,
     ):
         self.index = index
@@ -218,8 +220,17 @@ class Fragment:
         # This fragment's contribution to the process-wide
         # pilosa_ingest_acked_unsynced_bytes gauge.
         self._unsynced = 0
-        # Owning view's version bump (engine stack invalidation).
+        # Owning view's version bump (engine stack invalidation) and its
+        # process-unique generation token (the delta-bus log key part
+        # that survives drop/recreate of a same-named view).
         self._on_touch = on_touch
+        self._view_gen = view_gen
+        # Delta capture staging (core/delta.py): an instrumented write
+        # path stashes (rows, widxs, before-words) here just before its
+        # _touch/_touch_rows call, which consumes it into one packet
+        # stamped with the bump's version.  Un-instrumented paths leave
+        # it None and publish OPAQUE — the repair layer then falls back.
+        self._delta_pending = None
 
         self._store = RowStore()
         self.row_counts = self._store.counts
@@ -538,8 +549,7 @@ class Fragment:
                 self._word_log_push(v, packed)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         WRITE_SEQ.v += 1
-        if self._on_touch is not None:
-            self._on_touch()
+        self._note_touch()
 
     def _word_row_dirty(self, row_id: int, v: int):
         # The row's packed keys (if any) stay in the log — the sync's
@@ -628,8 +638,92 @@ class Fragment:
         for blk in np.unique(rows // HASH_BLOCK_SIZE).tolist():
             checksums.pop(blk, None)
         WRITE_SEQ.v += 1
-        if self._on_touch is not None:
-            self._on_touch()
+        self._note_touch()
+
+    def _note_touch(self):
+        """Tail of every _touch/_touch_rows: bump the view version and,
+        when a repair subscription is live for this view, publish the
+        staged write delta (core/delta.py) stamped with EXACTLY the
+        version this bump produced.  Runs under the fragment lock, so
+        packet content and version order can never tear.  An
+        un-instrumented write path leaves ``_delta_pending`` None and
+        publishes OPAQUE — the repair layer sees the hole and falls
+        back to recompute instead of serving a silently-wrong repair."""
+        pending, self._delta_pending = self._delta_pending, None
+        if self._on_touch is None:
+            return
+        ver = self._on_touch()
+        if ver is None or not _DELTA.wants(
+            self.index, self.field, self.view, self._view_gen
+        ):
+            # No packet log for this view — still wake index-level
+            # listeners (continuous queries watch whole indexes).
+            _DELTA.touched(self.index)
+            return
+        if pending is None:
+            _DELTA.publish_opaque(
+                self.index, self.field, self.view, self._view_gen, ver
+            )
+        else:
+            rows, widxs, before = pending
+            _DELTA.publish(
+                self.index,
+                self.field,
+                self.view,
+                self._view_gen,
+                ver,
+                self.shard,
+                rows,
+                widxs,
+                before,
+            )
+
+    def _delta_wanted(self) -> bool:
+        """Pre-write gate: capture before-words only when a repair
+        subscription is live.  Unsubscribed ingest pays one dict miss."""
+        return self._on_touch is not None and _DELTA.wants(
+            self.index, self.field, self.view, self._view_gen
+        )
+
+    def _delta_capture_packed(self, packed: np.ndarray):
+        """Before-words for a packed-position batch, read pre-merge.
+        ``packed`` holds ``row*SHARD_WIDTH + pos`` keys, sorted — the
+        (row, word64) pairs fall out with one dedup pass and one
+        rowstore gather per touched row."""
+        pk = packed.astype(np.int64, copy=False)
+        wk = pk >> 6
+        uw = wk[np.r_[True, wk[1:] != wk[:-1]]]
+        rshift = ops.SHARD_WIDTH_EXP - 6
+        rows = (uw >> rshift).astype(np.int64)
+        widxs = (uw & ((1 << rshift) - 1)).astype(np.int64)
+        starts = np.flatnonzero(np.r_[True, rows[1:] != rows[:-1]])
+        bnds = np.append(starts, rows.size)
+        before = np.empty(rows.size, dtype=np.uint64)
+        for k in range(starts.size):
+            lo, hi = int(bnds[k]), int(bnds[k + 1])
+            before[lo:hi] = self._store.words64_at(
+                int(rows[lo]), widxs[lo:hi]
+            )
+        return rows, widxs, before
+
+    def words64_at(self, row_id: int, widxs) -> np.ndarray:
+        """Locked read of a row's uint64 words at sorted word indexes —
+        the repair layer's truth read (parallel/repair.py)."""
+        with self._mu:
+            return self._store.words64_at(row_id, widxs)
+
+    def _delta_capture_bit(self, row_id: int, in_row: int):
+        """Stage the delta of a single-bit write that DID flip: the
+        store mutation already landed, so before = after ^ bit."""
+        if not self._delta_wanted():
+            return
+        w = np.asarray([in_row >> 6], dtype=np.int64)
+        bit = np.uint64(1) << np.uint64(in_row & 63)
+        self._delta_pending = (
+            np.asarray([row_id], dtype=np.int64),
+            w,
+            self._store.words64_at(row_id, w) ^ bit,
+        )
 
     def sync_snapshot(self, version: int):
         """ATOMIC (new_version, {row_id: words}) of every row touched
@@ -738,6 +832,7 @@ class Fragment:
         if self._mutex_owners is not None:
             self._mutex_owners[in_row] = row_id
         self._append_op(codec.OP_TYPE_ADD, p)
+        self._delta_capture_bit(row_id, in_row)
         self._touch(row_id, in_row)
         self.cache.add(row_id, self._store.count(row_id))
         return True
@@ -759,6 +854,7 @@ class Fragment:
         ):
             self._mutex_owners[in_row] = -1
         self._append_op(codec.OP_TYPE_REMOVE, p)
+        self._delta_capture_bit(row_id, in_row)
         self._touch(row_id, in_row)
         self.cache.add(row_id, self._store.count(row_id))
         return True
@@ -909,6 +1005,19 @@ class Fragment:
         if not changed_rows:
             return False
         rows = np.asarray(changed_rows, dtype=np.int64)
+        if self._delta_wanted():
+            # Every changed plane flipped exactly the column's bit, so
+            # each row's before-word = its after-word ^ bit.
+            widx = np.asarray([in_row >> 6], dtype=np.int64)
+            bit = np.uint64(1) << np.uint64(in_row & 63)
+            self._delta_pending = (
+                rows,
+                np.full(len(changed_rows), in_row >> 6, dtype=np.int64),
+                np.asarray(
+                    [store.words64_at(r, widx)[0] ^ bit for r in changed_rows],
+                    dtype=np.uint64,
+                ),
+            )
         self._touch_rows(
             rows,
             np.full(len(changed_rows), in_row >> 5, dtype=np.int32),
@@ -942,6 +1051,11 @@ class Fragment:
         words per row come out of the same sorted keys (``packed >> 5``)
         in one vectorized pass.  Returns bits changed.  Caller
         invalidates the rank cache and snapshots."""
+        delta = (
+            self._delta_capture_packed(packed)
+            if self._delta_wanted()
+            else None
+        )
         rows, bounds, positions = self._split_packed(packed)
         new_counts, changed, touched = self._store.bulk_merge(
             rows, bounds, positions, clear=clear, packed=packed
@@ -979,6 +1093,7 @@ class Fragment:
             )
             wbounds = np.append(0, np.cumsum(wsizes))
         if rows.size:
+            self._delta_pending = delta
             self._touch_rows(rows, words, wbounds)
             self.cache.bulk_update(rows, new_counts)
         return int(changed.sum())

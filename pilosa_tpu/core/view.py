@@ -16,6 +16,12 @@ from . import fragment as fragment_mod
 VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
 
+# Process-unique view generation tokens: two views that ever carried the
+# same (index, field, name) — e.g. an index dropped and recreated — must
+# never share a delta-bus log or a memo token, or their independent
+# version counters would collide (the ABA ``id()`` cannot rule out).
+_VIEW_GEN = itertools.count(1)
+
 
 def view_bsi_name(field_name: str) -> str:
     return VIEW_BSI_PREFIX + field_name
@@ -60,10 +66,17 @@ class View:
         # HBM stack forever).
         self._version_counter = itertools.count(1)
         self.version = 0
+        self.gen = next(_VIEW_GEN)
 
-    def _bump_version(self):
-        # next() on itertools.count is atomic under the GIL.
-        self.version = next(self._version_counter)
+    def _bump_version(self) -> int:
+        # next() on itertools.count is atomic under the GIL.  The new
+        # value is returned so the writing fragment can stamp the
+        # write's delta packet with EXACTLY the version this bump
+        # produced (core/delta.py): the repair layer's coverage check
+        # relies on every version in a token gap having one packet.
+        v = next(self._version_counter)
+        self.version = v
+        return v
 
     def open(self, pool=None):
         """Load existing fragments from disk.  ``pool`` (a
@@ -118,6 +131,7 @@ class View:
                 cache_debounce=self.cache_debounce,
                 row_attr_store=self.row_attr_store,
                 on_touch=self._bump_version,
+                view_gen=self.gen,
                 ack=self.ack,
             )
             self.fragments[shard] = frag

@@ -1932,9 +1932,31 @@ class Executor:
             min_threshold = DEFAULT_MIN_THRESHOLD
         try:
             if not c.children:
-                return self.mesh_engine.topn_cache_only(
+                # Cache-only TopN rides the versioned result memo: a
+                # probe miss first tries the repair layer (count-table
+                # maintained from write deltas, re-ranked on serve), and
+                # only then pays the full device scan.
+                eng = self.mesh_engine
+                probe = getattr(eng, "memo_probe_topn", None)
+                key = None
+                if probe is not None:
+                    key, hit = probe(
+                        index, field_name, shards, n, min_threshold,
+                        row_ids or None,
+                    )
+                    if hit is not None:
+                        p = plans_mod.current_plan()
+                        if p is not None:
+                            p.note_op(op="TopN", path="memo", memo="hit")
+                        return [tuple(pr) for pr in hit]
+                out = eng.topn_cache_only(
                     index, field_name, shards, n, min_threshold, row_ids or None
                 )
+                if key is not None and out is not None:
+                    eng.memo_store_topn(
+                        key, field_name, n, min_threshold, row_ids or None, out
+                    )
+                return out
             out = self._sflight.do(
                 ("topn", seq, index, str(c), tuple(sorted(local))),
                 lambda: self.mesh_engine.batched_topn_full(
@@ -2225,6 +2247,17 @@ class Executor:
         if not shards:
             return None
         fields = [child.args["field"] for child in c.children]
+        # The count TENSOR rides the versioned result memo (the
+        # assembled list never does — limit/offset assembly below reruns
+        # on every serve, so a memo hit cannot drift from a recompute).
+        eng = self.mesh_engine
+        probe = getattr(eng, "memo_probe_groupby", None)
+        key = hit = None
+        if probe is not None:
+            qsig = str(c)
+            if filter_call is not None:
+                qsig += "|flt:" + str(filter_call)
+            key, hit = probe(index, qsig, fields, filter_call, shards)
         row_lists = []
         for f in fields:
             rows = set()
@@ -2235,22 +2268,33 @@ class Executor:
             row_lists.append(sorted(rows))
         if any(not rows for rows in row_lists):
             return set(shards), []
-        try:
-            counts = self._sflight.do(
-                # row_lists are DERIVED from fragment state already
-                # versioned by WRITE_SEQ, so they need not (and must
-                # not — O(total rows) hashing per query) join the key.
-                ("groupby", seq, index, str(c), tuple(sorted(shards))),
-                lambda: self.mesh_engine.group_counts(
-                    index, fields, row_lists, filter_call, shards
-                ),
-            )
-        except (ValueError, PeerlessMeshError):
-            # Direct engine call: claim any half-written dispatch note
-            # (residency host_fallback) before falling back, so it
-            # cannot merge into an unrelated query's plan.
-            plans_mod.take_dispatch_note()
-            return None
+        shape = tuple(len(rows) for rows in row_lists)
+        if hit is not None and tuple(np.asarray(hit).shape) == shape:
+            p = plans_mod.current_plan()
+            if p is not None:
+                p.note_op(op="GroupBy", path="memo", memo="hit")
+            counts = hit
+        else:
+            try:
+                counts = self._sflight.do(
+                    # row_lists are DERIVED from fragment state already
+                    # versioned by WRITE_SEQ, so they need not (and must
+                    # not — O(total rows) hashing per query) join the key.
+                    ("groupby", seq, index, str(c), tuple(sorted(shards))),
+                    lambda: self.mesh_engine.group_counts(
+                        index, fields, row_lists, filter_call, shards
+                    ),
+                )
+            except (ValueError, PeerlessMeshError):
+                # Direct engine call: claim any half-written dispatch note
+                # (residency host_fallback) before falling back, so it
+                # cannot merge into an unrelated query's plan.
+                plans_mod.take_dispatch_note()
+                return None
+            if counts is not None and key is not None:
+                eng.memo_store_groupby(
+                    key, fields, row_lists, filter_call, counts
+                )
         if counts is None:
             return None
         limit_arg, has_limit = c.uint_arg("limit")

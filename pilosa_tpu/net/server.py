@@ -201,6 +201,11 @@ class Handler:
             self._post_import_roaring,
         )
         r("POST", "/index/{index}/query", self._post_query)
+        # Continuous queries (docs/incremental.md): subscribe a PQL
+        # query, long-poll its result deltas as writes stream in.
+        r("POST", "/cq", self._post_cq)
+        r("GET", "/cq/{cqid}", self._get_cq)
+        r("DELETE", "/cq/{cqid}", self._delete_cq)
         r("GET", "/export", self._get_export)
         r("POST", "/recalculate-caches", self._recalculate_caches)
         r("POST", "/cluster/resize/abort", self._resize_abort)
@@ -585,6 +590,29 @@ class Handler:
             out["plan"] = resp.plan
         return out
 
+    # -- continuous queries (docs/incremental.md) --------------------------
+
+    def _post_cq(self, q, b, **kw):
+        doc = json.loads(b) if b else {}
+        index, query = doc.get("index"), doc.get("query")
+        if not index or not query:
+            raise ApiError("cq requires 'index' and 'query'")
+        return self.api.cq.create(index, query)
+
+    def _get_cq(self, q, b, *, cqid, **kw):
+        since = int(q.get("since", ["0"])[0])
+        wait_ms = int(q.get("wait_ms", ["0"])[0])
+        try:
+            return self.api.cq.poll(cqid, since=since, wait_ms=wait_ms)
+        except KeyError:
+            raise NotFoundError("no such continuous query: %s" % cqid) from None
+
+    def _delete_cq(self, q, b, *, cqid, **kw):
+        try:
+            return self.api.cq.delete(cqid)
+        except KeyError:
+            raise NotFoundError("no such continuous query: %s" % cqid) from None
+
     def _post_import(self, q, b, *, index, field, **kw):
         doc = json.loads(b)
         remote = _qbool(q, "remote")
@@ -928,6 +956,11 @@ class Handler:
         # series.
         if eng is not None and hasattr(eng, "cache_snapshot"):
             out["engineCaches"] = eng.cache_snapshot()
+        # Continuous-query state (docs/incremental.md) — probe the slot
+        # directly: a scrape must not conjure the sweeper thread.
+        cq = getattr(self.api, "_cq", None)
+        if cq is not None:
+            out["continuousQueries"] = cq.snapshot()
         # Ingest pipeline telemetry (docs/ingest.md): the device-sync
         # worker's coalescing stats, surfaced top-level so operators
         # watching a bulk load don't have to dig through engineCaches.

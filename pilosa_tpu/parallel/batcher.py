@@ -293,20 +293,44 @@ class CountBatcher:
         planner fuses them with their drain-mates into ONE device
         program (docs/fusion.md).  Returns the op's standard result
         shape; raises the item's own error on failure."""
+        key, hit = self._memo_probe_op(index, kind, spec, shards)
+        if hit is not None:
+            plan = plans_mod.current_plan()
+            if plan is not None:
+                from .fusion import OP_NAMES
+
+                plan.note_op(
+                    op=OP_NAMES.get(kind, kind), path="memo", memo="hit"
+                )
+            return hit
         item = self._submit(index, None, shards, allow_direct=True,
-                            kind=kind, spec=spec)
+                            kind=kind, spec=spec, memo_key=key)
         if item is None:
-            return self._direct_op(index, kind, spec, shards)
+            return self._direct_op(index, kind, spec, shards, memo_key=key)
         if not item.event.wait(self.WAIT_TIMEOUT):
             raise RuntimeError("batched op timed out (engine wedged?)")
         if item.error is not None:
             raise item.error
         return item.result
 
-    def _direct_op(self, index, kind, spec, shards):
+    def _memo_probe_op(self, index, kind, spec, shards):
+        """engine.memo_probe_op, duck-typed like _memo_probe: the
+        versioned memo (and its repair layer) now answers repeat
+        Sum/Min/Max/TopN the way it answers repeat Counts."""
+        probe = getattr(self.engine, "memo_probe_op", None)
+        if probe is None:
+            return None, None
+        return probe(index, kind, spec, shards)
+
+    def _direct_op(self, index, kind, spec, shards, memo_key=None):
         t0 = time.monotonic()
         try:
-            return self.engine.solo_op(index, kind, spec, shards)
+            out = self.engine.solo_op(index, kind, spec, shards)
+            if memo_key is not None:
+                store = getattr(self.engine, "memo_store_op", None)
+                if store is not None:
+                    store(memo_key, kind, spec, out)
+            return out
         finally:
             note = plans_mod.take_dispatch_note()
             plan = plans_mod.current_plan()
@@ -836,8 +860,18 @@ class CountBatcher:
                     )
                     # Populate the result memo under the tokens read at
                     # submit time (engine.memo_probe's ordering note).
-                    if it.memo_key is not None and it.kind == "count":
-                        self.engine.memo_store(it.memo_key, it.result)
+                    # Counts hand the tree through so the repair layer
+                    # can register the entry's footprint; aggregate ops
+                    # store through the per-kind op memo.
+                    if it.memo_key is not None:
+                        if it.kind == "count":
+                            self.engine.memo_store(
+                                it.memo_key, it.result, call=it.call
+                            )
+                        else:
+                            self.engine.memo_store_op(
+                                it.memo_key, it.kind, it.spec, it.result
+                            )
                 t_done = time.monotonic()
                 self.pipeline.record("decode", t_done - t_ready)
                 # Device-cost attribution: the batch held one device

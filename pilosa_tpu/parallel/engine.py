@@ -77,6 +77,7 @@ from ..util.stats import (
     REGISTRY,
 )
 from . import fusion as fusion_mod
+from . import repair as repair_mod
 from . import kernels
 from . import residency as residency_mod
 from . import sparse as sparse_mod
@@ -840,8 +841,12 @@ class MeshEngine:
         # serialize + field walk (~60 µs, most of a memo-hit's cost)
         # runs once per distinct tree.  Entries pin their tree (key is
         # id(); the value holds the object so the id can't be reused).
-        self._memo_sig_cache: Dict[int, tuple] = {}
+        self._memo_sig_cache: Dict[int, list] = {}
         self._memo_sig_lock = threading.Lock()
+        # Repair-on-write layer: memo entries carrying their query's
+        # row/field footprint, advanced to the current version tokens
+        # from write deltas instead of recomputed (docs/incremental.md).
+        self.repairs = repair_mod.RepairLayer(self)
         # Batched-count CSE: identical (query, shards) entries of one
         # drained batch evaluate ONCE (_dispatch_count_batch); this
         # counts the collapsed duplicates.
@@ -2319,6 +2324,9 @@ class MeshEngine:
                         return jnp.int32(hit)
                     return hit
                 self._cache_miss("result_memo")
+                repaired = self.repairs.probe("count", key)
+                if repaired is not None:
+                    return jnp.int32(repaired)
         dev = self._collective(
             "count",
             {"index": index, "query": str(c), "shards": list(shards),
@@ -2330,6 +2338,11 @@ class MeshEngine:
         # later hits hand the SAME buffer back and the caller's
         # device_get is the only transfer.
         self.result_memo.put(key, dev)
+        # Footprint registration for repair-on-write: the device scalar
+        # is held lazily (first repair reads it back); admission aborts
+        # if a write landed mid-compute (repair.py _admit).
+        if key is not None:
+            self.repairs.register_count(key, c, dev)
         return dev
 
     # Call names whose referenced fields _collect_fields can enumerate —
@@ -2373,16 +2386,53 @@ class MeshEngine:
             return None
         ent = self._memo_sig_cache.get(id(c))
         if ent is not None and ent[0] is c:
+            ent[3] = True  # second-chance reference bit (GIL-atomic)
             qstr, fields = ent[1], ent[2]
         else:
             fields = self._collect_fields(c)
             if fields is None:
                 return None
             qstr = str(c)
-            with self._memo_sig_lock:
-                if len(self._memo_sig_cache) >= 1024:
-                    self._memo_sig_cache.clear()
-                self._memo_sig_cache[id(c)] = (c, qstr, fields)
+            self._memo_sig_insert(c, qstr, fields)
+        toks = self.memo_tokens(index, fields)
+        if toks is None:
+            return None
+        return (index, qstr, tuple(sorted(set(shards))), toks)
+
+    _SIG_CACHE_MAX = 1024
+
+    def _memo_sig_insert(self, c, qstr, fields):
+        """Admit a tree signature under second-chance eviction: a full
+        cache evicts the oldest UNREFERENCED half and clears the
+        survivors' reference bits.  A hot steady-state dashboard mix
+        past the cap keeps every repeat signature (its bit is re-set on
+        every hit) — the old wholesale clear() dumped the lot and every
+        hot query repaid the ~60 µs serialize+walk at once."""
+        with self._memo_sig_lock:
+            cache = self._memo_sig_cache
+            if len(cache) >= self._SIG_CACHE_MAX:
+                need = self._SIG_CACHE_MAX // 2
+                survivors: Dict[int, list] = {}
+                evicted = 0
+                for k, ent in cache.items():
+                    if evicted < need and not ent[3]:
+                        evicted += 1
+                        continue
+                    ent[3] = False
+                    survivors[k] = ent
+                if evicted < need:
+                    # Everything was referenced: drop the oldest anyway
+                    # (insertion order) so the cache stays bounded.
+                    for k in list(survivors)[: need - evicted]:
+                        del survivors[k]
+                self._memo_sig_cache = survivors
+            self._memo_sig_cache[id(c)] = [c, qstr, fields, False]
+
+    def memo_tokens(self, index: str, fields):
+        """Version tokens over every view of ``fields`` — the shared
+        currency of the memo key AND the repair layer's base/target
+        walk (parallel/repair.py).  None when the index is unknown or a
+        concurrent writer grew a view dict mid-walk."""
         idx_obj = self.holder.index(index)
         if idx_obj is None:
             return None
@@ -2395,13 +2445,13 @@ class MeshEngine:
                     continue
                 for vname in sorted(f.views):
                     v = f.views[vname]
-                    toks.append((fname, vname, id(v), v.version))
+                    toks.append((fname, vname, v.gen, v.version))
         except RuntimeError:
             # A concurrent writer grew a view dict mid-walk (first write
             # to a new time view): skip the memo for this query rather
             # than surface an iteration error on the read path.
             return None
-        return (index, qstr, tuple(sorted(set(shards))), tuple(toks))
+        return tuple(toks)
 
     def memo_probe(self, index: str, c: Call, shards):
         """(key, value-or-None) for the batcher's submit fast path: a
@@ -2421,10 +2471,153 @@ class MeshEngine:
             self._cache_hit("result_memo")
             return key, v
         self._cache_miss("result_memo")
+        repaired = self.repairs.probe("count", key)
+        if repaired is not None:
+            return key, repaired
         return key, None
 
-    def memo_store(self, key, value):
+    def memo_store(self, key, value, call=None):
         self.result_memo.put(key, value)
+        if call is not None and key is not None and value is not None:
+            self.repairs.register_count(key, call, value)
+
+    # -- non-Count op memo (Sum/Min/Max/TopN ride the same versioned
+    # memo; the batcher's submit_op probes/stores through these) -------------
+
+    def memo_key_op(self, index: str, kind: str, spec: dict, shards):
+        """Memo key for an aggregate op: identical shape to _memo_key
+        but signed by the op's canonical spec text instead of a Count
+        tree (fusion.op_signature owns the vocabulary)."""
+        if self.result_memo.maxsize <= 0:
+            return None
+        fields = fusion_mod.op_fields(kind, spec, self._collect_fields)
+        if fields is None:
+            return None
+        toks = self.memo_tokens(index, fields)
+        if toks is None:
+            return None
+        qstr = "op:" + fusion_mod.op_signature(kind, spec)
+        return (index, qstr, tuple(sorted(set(shards))), toks)
+
+    _OP_CACHE_TAG = {"sum": "memo_sum", "min": "memo_min",
+                     "max": "memo_max", "topnf": "memo_topn"}
+
+    def memo_probe_op(self, index: str, kind: str, spec: dict, shards):
+        """(key, value-or-None) for submit_op: a hit answers the op
+        with zero device dispatch, tagged per op kind in /debug/vars.
+        A miss probes the repair layer (Sum only registers; Min/Max
+        are memo-only — their extrema aren't delta-maintainable)."""
+        tag = self._OP_CACHE_TAG.get(kind)
+        if tag is None or self.multiproc:
+            return None, None
+        key = self.memo_key_op(index, kind, spec, shards)
+        if key is None:
+            return None, None
+        v = self.result_memo.get(key)
+        if v is not None:
+            self._cache_hit(tag)
+            return key, (list(v) if kind == "topnf" else v)
+        self._cache_miss(tag)
+        if kind == "sum":
+            repaired = self.repairs.probe("sum", key)
+            if repaired is not None:
+                return key, repaired
+        return key, None
+
+    def memo_store_op(self, key, kind: str, spec: dict, value):
+        """Store a fresh op result under its submit-time key; Sum also
+        registers its plane footprint for repair.  DECLINED sentinels
+        (fused TopN fallback) are never memoized."""
+        if key is None or value is None or value is fusion_mod.DECLINED:
+            return
+        if kind == "topnf":
+            self.result_memo.put(key, tuple(map(tuple, value)))
+            return
+        self.result_memo.put(key, value)
+        if kind == "sum":
+            self.repairs.register_sum(
+                key, spec["field"], spec.get("filter"), value
+            )
+
+    # -- executor-lane memo (cache-only TopN / fused GroupBy results live
+    # in the same versioned memo; the executor probes/stores through
+    # these because its lanes never pass through the batcher) ----------------
+
+    def memo_probe_topn(self, index, field_name, shards, n, threshold,
+                        row_ids):
+        """(key, pairs-or-None) for the cache-only TopN lane: signed by
+        the field + rank parameters, tokened over every view of the
+        field.  A miss probes the repair layer, whose count table is
+        re-ranked with exactly topn_cache_only's host reduce."""
+        if self.multiproc or self.result_memo.maxsize <= 0:
+            return None, None
+        toks = self.memo_tokens(index, {field_name})
+        if toks is None:
+            return None, None
+        qstr = "topn:%s|%d|%d|%s" % (
+            field_name, n, threshold,
+            ",".join(map(str, row_ids)) if row_ids else "",
+        )
+        key = (index, qstr, tuple(sorted(set(shards))), toks)
+        v = self.result_memo.get(key)
+        if v is not None:
+            self._cache_hit("memo_topn")
+            return key, [tuple(p) for p in v]
+        self._cache_miss("memo_topn")
+        repaired = self.repairs.probe("topn", key)
+        if repaired is not None:
+            return key, repaired
+        return key, None
+
+    def memo_store_topn(self, key, field_name, n, threshold, row_ids,
+                        pairs):
+        if key is None or pairs is None:
+            return
+        self.result_memo.put(key, tuple(map(tuple, pairs)))
+        self.repairs.register_topn(key, field_name, n, threshold, row_ids)
+
+    def memo_probe_groupby(self, index, c_str, fields, filter_call, shards):
+        """(key, counts-tensor-or-None) for the fused GroupBy lane.  The
+        memo value is the SHAPED count tensor, not the assembled result:
+        the executor re-runs its own limit/offset assembly over it, so a
+        memo hit cannot drift from a recompute.  Tokens cover the group
+        fields AND the filter's fields — row_lists derive from the group
+        fields' standard views, so unchanged tokens pin the tensor's
+        axes too."""
+        if self.multiproc or self.result_memo.maxsize <= 0:
+            return None, None
+        tfields = set(fields)
+        if filter_call is not None:
+            ffields = self._collect_fields(filter_call)
+            if ffields is None:
+                return None, None
+            tfields |= ffields
+        toks = self.memo_tokens(index, tfields)
+        if toks is None:
+            return None, None
+        key = (index, "groupby:" + c_str,
+               tuple(sorted(set(shards))), toks)
+        v = self.result_memo.get(key)
+        if v is not None:
+            self._cache_hit("memo_groupby")
+            return key, v
+        self._cache_miss("memo_groupby")
+        repaired = self.repairs.probe("groupby", key)
+        if repaired is not None:
+            return key, repaired
+        return key, None
+
+    def memo_store_groupby(self, key, fields, row_lists, filter_call,
+                           counts):
+        if key is None or counts is None:
+            return
+        shaped = np.asarray(counts, dtype=np.int64).reshape(
+            tuple(len(rows) for rows in row_lists)
+        )
+        self.result_memo.put(key, shaped)
+        self.repairs.register_groupby(
+            key, fields, row_lists, filter_call, shaped
+        )
 
     @property
     def _peerless_multiproc(self) -> bool:
@@ -3965,6 +4158,7 @@ class MeshEngine:
                 self._fused_plans.clear()
                 memo_entries = len(self.result_memo)
                 self.result_memo.clear()
+                self.repairs.clear()
                 self._closed = True
             finally:
                 self._closing_down = False
@@ -4094,6 +4288,7 @@ class MeshEngine:
             "zeros": len(self._zeros),
             "scalars": len(self._scalars),
             "resultMemoEntries": len(self.result_memo),
+            "resultRepair": self.repairs.snapshot(),
             "sparseDispatches": self.sparse_dispatches,
             "deviceBytesSkipped": self.device_bytes_skipped,
             "hostFallbacks": self.host_fallbacks,

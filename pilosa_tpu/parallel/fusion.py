@@ -59,6 +59,35 @@ OP_NAMES = {
     "topnf": "TopN",
 }
 
+
+def op_signature(kind: str, spec: dict) -> str:
+    """Canonical text of an aggregate op spec — the result-memo's
+    signature for non-Count ops (engine.memo_key_op), same discipline
+    as _entry_sort_key's build ordering text."""
+    if kind in ("sum", "min", "max"):
+        return f"{kind}|{spec['field']}|{spec.get('filter')}"
+    if kind == "topn":
+        return f"topn|{spec['field']}|{spec['src']}|{list(spec.get('rows') or ())}"
+    return (
+        f"topnf|{spec['field']}|{spec.get('src')}|{spec.get('n')}|"
+        f"{spec.get('threshold')}|{spec.get('row_ids')}"
+    )
+
+
+def op_fields(kind: str, spec: dict, collect_fields):
+    """Every field an op's version tokens must cover: the aggregated
+    field itself plus the filter/src tree's fields (walked by the
+    engine's collector).  None when the tree isn't walkable — the op
+    then skips the memo entirely, correctness first."""
+    fields = {spec["field"]}
+    tree = spec.get("filter") if kind in ("sum", "min", "max") else spec.get("src")
+    if tree is not None:
+        sub = collect_fields(tree)
+        if sub is None:
+            return None
+        fields |= sub
+    return fields
+
 def _pow2(n: int) -> int:
     return max(1, 1 << (max(1, n) - 1).bit_length())
 

@@ -590,11 +590,39 @@ SHED_REASONS = ("overload", "tenant_fair", "queue_full")
 SERVER_REQUEST_PATHS = ("inline", "pool", "shed")
 
 # Engine cache names labelling the hit/miss counter series (engine.py
-# resolves one handle pair per name at construction).
+# resolves one handle pair per name at construction).  The memo_* names
+# are the per-op-kind result-memo tallies (Sum/Min/Max/TopN/GroupBy ride
+# the same versioned memo as fused Counts, docs/incremental.md).
 ENGINE_CACHES = (
     "stack", "mask", "zeros", "scalar", "canonical", "result_memo",
     "batch_cse", "fused_plan",
+    "memo_sum", "memo_min", "memo_max", "memo_topn", "memo_groupby",
 )
+
+# -- repair-on-write materialized results (docs/incremental.md) --------------
+#   pilosa_result_repairs_total{kind=}        memo entries advanced to the
+#                                             current version tokens in
+#                                             O(changed bits) instead of
+#                                             recomputed
+#   pilosa_result_repair_fallbacks_total{kind=} repair attempts that fell
+#                                             back to a full recompute
+#                                             (opaque write, coverage hole,
+#                                             structural change, lost race)
+#   pilosa_result_repair_seconds              host time per repair attempt
+#   pilosa_result_repair_touched_words_total  64-bit words a repair actually
+#                                             read — the O(touched) evidence
+#                                             vs the index's total words
+#   pilosa_cq_active                          live continuous-query
+#                                             subscriptions (POST /cq)
+#   pilosa_cq_deltas_total                    result deltas streamed to
+#                                             continuous-query subscribers
+METRIC_RESULT_REPAIRS = "pilosa_result_repairs_total"
+METRIC_RESULT_REPAIR_FALLBACKS = "pilosa_result_repair_fallbacks_total"
+METRIC_RESULT_REPAIR_SECONDS = "pilosa_result_repair_seconds"
+METRIC_RESULT_REPAIR_TOUCHED_WORDS = "pilosa_result_repair_touched_words_total"
+METRIC_CQ_ACTIVE = "pilosa_cq_active"
+METRIC_CQ_DELTAS = "pilosa_cq_deltas_total"
+REPAIR_KINDS = ("count", "sum", "topn", "groupby")
 
 # Pre-register the always-on surface so /metrics exposes every required
 # series (with zero counts) from process start — scrape checks must not
@@ -618,6 +646,29 @@ for _cache in ENGINE_CACHES:
 REGISTRY.counter(
     METRIC_DEVICE_BYTES_SKIPPED,
     help="Device HBM bytes skipped by occupancy-guided sparse dispatches",
+)
+for _kind in REPAIR_KINDS:
+    REGISTRY.counter(
+        METRIC_RESULT_REPAIRS,
+        help="Materialized results repaired in-place from write deltas",
+        kind=_kind,
+    )
+    REGISTRY.counter(
+        METRIC_RESULT_REPAIR_FALLBACKS,
+        help="Repair attempts that fell back to full recompute",
+        kind=_kind,
+    )
+REGISTRY.histogram(
+    METRIC_RESULT_REPAIR_SECONDS,
+    help="Host time per materialized-result repair attempt (seconds)",
+)
+REGISTRY.counter(
+    METRIC_RESULT_REPAIR_TOUCHED_WORDS,
+    help="64-bit words read by result repairs (O(touched), not O(index))",
+)
+REGISTRY.set_gauge(METRIC_CQ_ACTIVE, 0)
+REGISTRY.counter(
+    METRIC_CQ_DELTAS, help="Result deltas streamed to continuous queries"
 )
 REGISTRY.counter(
     METRIC_ENGINE_FUSED_PROGRAMS,

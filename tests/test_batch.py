@@ -352,6 +352,10 @@ def test_singleflight_collapses_identical_aggregates(holder, mesh):
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 
+    # The warm-up runs above memoized both queries (the Sum/TopN memo
+    # lanes would answer all 24 workers with zero flights) — clear the
+    # memo and hold repair off so the burst truly needs computation.
+    eng.result_memo.clear()
     before = eng.fused_dispatches
     # Barrier: all workers release together so flight overlap is
     # deterministic, not a thread-spawn race.
@@ -370,10 +374,11 @@ def test_singleflight_collapses_identical_aggregates(holder, mesh):
         )
         for _ in range(12)
     ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(60)
+    with eng.repairs.suspended():
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
     assert not errs and all(results), (errs, results)
     assert ex._sflight.shared > 0, "no requests were collapsed"
     # Far fewer dispatches than callers (leaders only; bursts may split).

@@ -143,8 +143,12 @@ def test_bulk_import_while_querying_engine():
     for a, b in zip(seen, seen[1:]):
         assert b >= a, (a, b)
     # Quiesced: the fused path agrees with the host-only executor.
+    # Force a real dispatch (repair-on-write may have served every
+    # post-import read without one) so the scatter-sync provably ran.
     plain = Executor(h)
-    assert ex.execute("i", q).results == plain.execute("i", q).results
+    with eng.repairs.suspended():
+        eng.result_memo.clear()
+        assert ex.execute("i", q).results == plain.execute("i", q).results
     assert eng.stack_rebuilds == 1, "import under query forced a rebuild"
     assert eng.stack_updates >= 1
 

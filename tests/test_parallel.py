@@ -314,11 +314,15 @@ def test_executor_mesh_group_by(holder, mesh):
     calls.clear()
     assert fused.execute("i", q).results == plain.execute("i", q).results
     assert not calls
-    # Combination-count overflow falls back to the host iterator.
+    # Combination-count overflow falls back to the host iterator.  The
+    # earlier run of this exact query memoized its tensor — clear the
+    # memo (and keep repair out) so group_counts is really consulted.
     engine.MAX_GROUP_COMBOS = 8
+    engine.result_memo.clear()
     q = "GroupBy(Rows(field=a), Rows(field=b), Rows(field=c))"  # 5*3*2=30
     calls.clear()
-    assert fused.execute("i", q).results == plain.execute("i", q).results
+    with engine.repairs.suspended():
+        assert fused.execute("i", q).results == plain.execute("i", q).results
     assert calls  # group_counts consulted but declined -> host path ran
 
 
@@ -493,6 +497,10 @@ def test_incremental_stack_sync(holder, mesh):
     only for shape changes (new rows)."""
     build_data(holder)
     eng = MeshEngine(holder, mesh)
+    # Repair-on-write would serve every re-count below WITHOUT a
+    # dispatch (test_repair.py owns that contract); this test pins the
+    # scatter-sync machinery, so it must observe real dispatches.
+    eng.repairs._suspended = 1
     ex = Executor(holder)
     call = pql.parse("Row(f=10)").calls[0]
     shards = list(range(8))
@@ -544,6 +552,7 @@ def test_failed_incremental_sync_evicts_stack(holder, mesh, monkeypatch):
 
     build_data(holder)
     eng = MeshEngine(holder, mesh)
+    eng.repairs._suspended = 1  # the count must DISPATCH (sync path)
     ex = Executor(holder)
     call = pql.parse("Row(f=10)").calls[0]
     shards = list(range(8))
@@ -612,6 +621,7 @@ def test_word_level_sync_payload(holder, mesh):
     # End-to-end: engine counts stay correct through the word path.
     build_data(holder)
     eng = MeshEngine(holder, mesh)
+    eng.repairs._suspended = 1  # pin the word-scatter path, not repair
     ex = Executor(holder)
     call = pql.parse("Row(f=10)").calls[0]
     shards = list(range(8))
@@ -640,6 +650,7 @@ def test_bulk_import_write_through(holder, mesh):
     big.import_bulk(rows, cols)
 
     eng = MeshEngine(holder, mesh)
+    eng.repairs._suspended = 1  # pin write-through scatters, not repair
     ex = Executor(holder, mesh_engine=eng)
     q = "Count(Union(Row(big=0), Row(big=1)))"
     base = ex.execute("i", q).results[0]

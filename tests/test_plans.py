@@ -88,9 +88,12 @@ def test_memo_hit_plan_means_no_new_dispatch(mesh):
     ]
     assert eng.fused_dispatches == disp0, "memo-hit plan still dispatched"
     # A write advances the version tokens: the next plan records WHY the
-    # memo missed, and the analyzer annotates it.
+    # memo missed, and the analyzer annotates it.  (Repair-on-write
+    # would serve this dispatch-free — test_repair.py owns that; here
+    # the miss-reason plumbing itself is under test.)
     f.import_bulk([1], [3 * OCC_BLOCK_BITS + 5])
-    resp = api.query(QueryRequest("i", INTERSECT, profile=True))
+    with eng.repairs.suspended():
+        resp = api.query(QueryRequest("i", INTERSECT, profile=True))
     op = resp.plan["ops"][0]
     assert op["memo"] == "miss"
     assert op["memo_reason"] == "version_token_advanced"

