@@ -20,6 +20,7 @@ import numpy as np
 from . import __version__, pql
 from .util import fanout, plans, tracing
 from .util.stats import (
+    INGEST_PATH_SYSTEM,
     INGEST_PATHS,
     METRIC_INGEST_BATCHES,
     METRIC_INGEST_BITS,
@@ -30,6 +31,7 @@ from .util.stats import (
     REGISTRY,
 )
 from .core import timequantum
+from .core.index import SYSTEM_INDEX
 from .core.field import FieldOptions
 from .core.fragment import SHARD_WIDTH
 from .core.holder import Holder
@@ -197,9 +199,14 @@ class API:
                 REGISTRY.counter(METRIC_INGEST_BITS, path=path),
                 REGISTRY.histogram(METRIC_INGEST_SECONDS, path=path),
             )
-            for path in INGEST_PATHS
+            for path in INGEST_PATHS + (INGEST_PATH_SYSTEM,)
         }
         self._ingest_changed = REGISTRY.counter(METRIC_INGEST_CHANGED)
+        # Self-observation surfaces (docs/observability.md), wired by the
+        # Server when [observability] enables them: the history sampler
+        # (util/history.py) and the SLO watcher (util/slo.py).
+        self.history = None
+        self.slo = None
         self.holder = holder if holder is not None else Holder()
         if not self.holder.opened:
             self.holder.open()
@@ -546,6 +553,13 @@ class API:
         series — otherwise a cluster import double-counts, once at the
         coordinator and again at each forwarded owner — but still
         notify the local sync worker."""
+        if index_name == SYSTEM_INDEX:
+            # Self-observation guard: the history sampler's own writes go
+            # through this exact path, so without rerouting they would
+            # inflate the headline pilosa_ingest_* series the sampler is
+            # recording — a feedback loop.  path="system" keeps them
+            # visible but out of every headline tuple.
+            path = INGEST_PATH_SYSTEM
         if not remote:
             batches, bits_c, hist = self._ingest_series[path]
             batches.inc()
@@ -814,6 +828,7 @@ class API:
         req: ImportValueRequest,
         remote: bool = False,
         clear: bool = False,
+        fresh: bool = False,
     ):
         self._check_writable()
         idx = self.index(req.index)
@@ -832,7 +847,9 @@ class API:
             ef = idx.existence_field()
             if not clear and ef is not None and len(cols):
                 ef.import_bulk([0] * len(cols), cols)
-            f.import_values(cols, values, clear=clear)
+            # fresh (set-only BSI write) is a local caller's guarantee
+            # about local columns — it never rides the cluster fan-out.
+            f.import_values(cols, values, clear=clear, fresh=fresh)
 
         t0 = time.monotonic()
         if self.cluster is None or remote:

@@ -169,6 +169,26 @@ class Config:
         # probabilistic rule draws from.
         self.faults_seed = 0
         self.faults_rules: List[str] = []
+        # Self-hosted observability ([observability], docs/observability.md):
+        # the history sampler writes every registry series into the internal
+        # `_system` index each sample-interval, retention drops expired YMDH
+        # views, and the SLO watcher evaluates burn rates over that history.
+        # History is OFF by default (tests/dev opt in); the smoke lane runs
+        # it at 1s.
+        self.obs_history = False
+        self.obs_sample_interval = 10.0
+        self.obs_retention = 3600.0
+        # SLO targets — 0 disables the respective objective.  error-rate is
+        # a fraction of requests (5xx / all); latency-p95-ms a millisecond
+        # bound on the query p95.  A burn fires when the observed value
+        # exceeds target * burn-threshold sustained over slo-window.
+        self.obs_slo_error_rate = 0.0
+        self.obs_slo_latency_p95_ms = 0.0
+        self.obs_slo_window = 300.0
+        self.obs_slo_burn_threshold = 2.0
+        # Flight-recorder bundles persisted to <data-dir>/.flightrec/ on a
+        # burn trigger; oldest pruned past this count.
+        self.obs_flightrec_max_bundles = 8
 
     # -- loading -----------------------------------------------------------
 
@@ -316,6 +336,26 @@ class Config:
         flt = doc.get("faults", {})
         self.faults_seed = int(flt.get("seed", self.faults_seed))
         self.faults_rules = flt.get("rules", self.faults_rules)
+        obs = doc.get("observability", {})
+        self.obs_history = obs.get("history", self.obs_history)
+        if "sample-interval" in obs:
+            self.obs_sample_interval = _parse_duration(obs["sample-interval"])
+        if "history-retention" in obs:
+            self.obs_retention = _parse_duration(obs["history-retention"])
+        self.obs_slo_error_rate = float(
+            obs.get("slo-error-rate", self.obs_slo_error_rate)
+        )
+        self.obs_slo_latency_p95_ms = float(
+            obs.get("slo-latency-p95-ms", self.obs_slo_latency_p95_ms)
+        )
+        if "slo-window" in obs:
+            self.obs_slo_window = _parse_duration(obs["slo-window"])
+        self.obs_slo_burn_threshold = float(
+            obs.get("slo-burn-threshold", self.obs_slo_burn_threshold)
+        )
+        self.obs_flightrec_max_bundles = int(
+            obs.get("flightrec-max-bundles", self.obs_flightrec_max_bundles)
+        )
 
     def load_env(self, environ=None):
         env = environ if environ is not None else os.environ
@@ -388,6 +428,18 @@ class Config:
             ("jax_process_id", "JAX_PROCESS_ID", int),
             ("mesh_peers", "MESH_PEERS", list),
             ("mesh_sequencer", "MESH_SEQUENCER", str),
+            ("obs_history", "OBS_HISTORY", bool),
+            ("obs_sample_interval", "OBS_SAMPLE_INTERVAL", _parse_duration),
+            ("obs_retention", "OBS_HISTORY_RETENTION", _parse_duration),
+            ("obs_slo_error_rate", "OBS_SLO_ERROR_RATE", float),
+            ("obs_slo_latency_p95_ms", "OBS_SLO_LATENCY_P95_MS", float),
+            ("obs_slo_window", "OBS_SLO_WINDOW", _parse_duration),
+            ("obs_slo_burn_threshold", "OBS_SLO_BURN_THRESHOLD", float),
+            (
+                "obs_flightrec_max_bundles",
+                "OBS_FLIGHTREC_MAX_BUNDLES",
+                int,
+            ),
         ]:
             v = get(name, cast)
             if v is not None:
@@ -476,6 +528,16 @@ processes = {self.jax_num_processes}
 process-id = {self.jax_process_id}
 peers = [{", ".join(f'"{u}"' for u in self.mesh_peers)}]
 sequencer = "{self.mesh_sequencer}"
+
+[observability]
+history = {str(self.obs_history).lower()}
+sample-interval = "{int(self.obs_sample_interval)}s"
+history-retention = "{int(self.obs_retention)}s"
+slo-error-rate = {self.obs_slo_error_rate}
+slo-latency-p95-ms = {self.obs_slo_latency_p95_ms}
+slo-window = "{int(self.obs_slo_window)}s"
+slo-burn-threshold = {self.obs_slo_burn_threshold}
+flightrec-max-bundles = {self.obs_flightrec_max_bundles}
 """
 
     def bind_host_port(self):

@@ -184,6 +184,11 @@ class Field:
         self.creation_id = uuid.uuid4().hex
         self.views: Dict[str, View] = {}
         self.cache_debounce = cache_debounce
+        # Durability-write coalescing for this field's fragments (set
+        # post-construction by owners of reconstructible data, e.g. the
+        # _system telemetry sampler): views created after the attribute
+        # is raised inherit it.
+        self.snapshot_debounce = 0.0
         self.on_create_shard = on_create_shard
         if row_attr_store is None:
             from .attrs import AttrStore
@@ -327,6 +332,7 @@ class Field:
                 cache_size=self.options.cache_size,
                 mutex=self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL),
                 cache_debounce=self.cache_debounce,
+                snapshot_debounce=self.snapshot_debounce,
                 on_create_shard=self.on_create_shard,
                 row_attr_store=self.row_attr_store,
                 ack=self.ack,
@@ -586,10 +592,14 @@ class Field:
             )
         )
 
-    def import_values(self, column_ids, values, clear: bool = False) -> None:
+    def import_values(
+        self, column_ids, values, clear: bool = False, fresh: bool = False
+    ) -> None:
         """Vectorized shard grouping + concurrent per-fragment applies,
         same shape as import_bulk's fast path (range check first — a
-        late ValueError must not land after part of the batch applied)."""
+        late ValueError must not land after part of the batch applied).
+        ``fresh`` is the set-only contract (Fragment.import_values):
+        the caller guarantees the columns carry no prior value."""
         g = self.bsi_group(self.name)
         if g is None:
             raise ValueError(f"field {self.name} has no int range")
@@ -609,12 +619,12 @@ class Field:
         groups = self._shard_groups(view, cols, vals)
         if len(groups) == 1:
             frag, c, v = groups[0]
-            frag.import_values(c, v, depth, clear=clear)
+            frag.import_values(c, v, depth, clear=clear, fresh=fresh)
             return
         fanout.run_fanout(
             [
                 lambda f=frag, c=c, v=v: f.import_values(
-                    c, v, depth, clear=clear
+                    c, v, depth, clear=clear, fresh=fresh
                 )
                 for frag, c, v in groups
             ]

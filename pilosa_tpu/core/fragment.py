@@ -199,6 +199,7 @@ class Fragment:
         max_op_n: int = DEFAULT_MAX_OP_N,
         mutex: bool = False,
         cache_debounce: float = 0.0,
+        snapshot_debounce: float = 0.0,
         row_attr_store=None,
         on_touch=None,
         view_gen: int = 0,
@@ -217,6 +218,15 @@ class Fragment:
         if ack not in ACK_LEVELS:
             raise ValueError(f"unknown ack level: {ack!r}")
         self.ack = ack
+        # Durability-write coalescing: with a positive debounce, the
+        # bulk-path snapshot() persists the roaring file at most once
+        # per this many seconds (pending writes flush on close).  A
+        # crash can lose up to one debounce window of bulk writes — only
+        # appropriate for reconstructible data (e.g. the _system
+        # telemetry index, whose tail is disposable by design).
+        self.snapshot_debounce = float(snapshot_debounce)
+        self._last_snapshot_ts = 0.0
+        self._snapshot_pending = False
         # This fragment's contribution to the process-wide
         # pilosa_ingest_acked_unsynced_bytes gauge.
         self._unsynced = 0
@@ -367,6 +377,18 @@ class Fragment:
         if self.path is None:
             self.op_n = 0
             return
+        if self.snapshot_debounce > 0:
+            now = time.monotonic()
+            if now - self._last_snapshot_ts < self.snapshot_debounce:
+                # Coalesce: the in-memory store is current, defer the
+                # file write until the debounce window expires (or
+                # close()).  op_n stays as-is so the op-log keeps
+                # covering single-bit writes made since the last
+                # persisted snapshot.
+                self._snapshot_pending = True
+                return
+            self._last_snapshot_ts = now
+        self._snapshot_pending = False
         data = codec.serialize(self.positions())
         tmp = self.path + ".snapshotting"
         with open(tmp, "wb") as f:
@@ -446,6 +468,11 @@ class Fragment:
         must either complete durably (it held the lock first) or RAISE —
         round 5's restart-under-write-load test caught writes that were
         acked after the op file was gone and silently lost on replay."""
+        if self._snapshot_pending and self.path is not None:
+            # A debounced bulk write is still memory-only: persist it
+            # now, while the fragment is still open (RLock re-entry).
+            self.snapshot_debounce = 0.0
+            self.snapshot()
         self._closed = True
         self.flush_cache()
         if self._op_file is not None:
@@ -1263,6 +1290,7 @@ class Fragment:
         values: Iterable[int],
         bit_depth: int,
         clear: bool = False,
+        fresh: bool = False,
     ):
         """Bulk BSI write as TWO multi-row merges: every plane's set
         positions pack into one sorted union and every plane's clear
@@ -1272,7 +1300,11 @@ class Fragment:
         end.  With ``clear`` the not-null plane is REMOVED for the given
         columns (fragment.go importSetValue :669 clear branch) — the
         value planes are still written per the given bits, matching the
-        reference exactly."""
+        reference exactly.  ``fresh``: caller GUARANTEES the columns
+        hold no prior value, so the zero-plane clear merge (a no-op on
+        untouched columns, but ~bit_depth positions of work per column)
+        is skipped — a set-only write.  Using it on a column with prior
+        bits ORs old and new planes, i.e. corrupts the value."""
         self._check_open()
         cols = np.asarray(column_ids, dtype=np.int64)
         vals = np.asarray(values, dtype=np.int64)
@@ -1284,12 +1316,21 @@ class Fragment:
         pos_u64 = in_row.astype(np.uint64)
         exp = np.uint64(ops.SHARD_WIDTH_EXP)
 
-        set_chunks, clr_chunks = [], []
-        for i in range(bit_depth):
-            bit_set = ((vals >> i) & 1).astype(bool)
-            key = np.uint64(i) << exp
-            set_chunks.append(key | pos_u64[bit_set])
-            clr_chunks.append(key | pos_u64[~bit_set])
+        # All planes at once: one (bit_depth, n) bit matrix and one
+        # packed-key matrix replace a Python loop of ~6 numpy ops per
+        # plane — at BSI depth 52 and small n (the _system sampler
+        # writes 1-2 columns per family per tick) the loop's fixed
+        # per-op overhead dominated the whole import.  Row-major
+        # boolean selection flattens plane-major with each plane's
+        # positions ascending — the same order the loop produced.
+        if bit_depth > 0:
+            planes = np.arange(bit_depth, dtype=np.uint64)
+            bitmat = ((vals[None, :] >> planes[:, None].astype(np.int64)) & 1).astype(bool)
+            packed = (planes[:, None] << exp) | pos_u64[None, :]
+            set_chunks = [packed[bitmat]]
+            clr_chunks = [] if (fresh and not clear) else [packed[~bitmat]]
+        else:
+            set_chunks, clr_chunks = [], []
         not_null = (np.uint64(bit_depth) << exp) | pos_u64
         (clr_chunks if clear else set_chunks).append(not_null)
         # Plane-major concatenation of already-sorted position runs:

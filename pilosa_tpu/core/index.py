@@ -202,9 +202,21 @@ class Index:
         return f"Index({self.name}, fields={sorted(self.fields)})"
 
 
+# The internal self-observation index (docs/observability.md): the history
+# sampler stores every registry series here as BSI fields behind YMDH
+# time-quantum views.  The leading underscore keeps it out of the user
+# namespace — user-created names must still start with a letter.
+SYSTEM_INDEX = "_system"
+
+
 def validate_name(name: str):
-    """Index/field name validation (pilosa.go name regex)."""
+    """Index/field name validation (pilosa.go name regex), extended with
+    exactly one reserved spelling: ``_system``, the internal
+    self-observation index.  Every other underscore-prefixed name stays
+    invalid so the internal namespace cannot be squatted."""
     import re
 
+    if name == SYSTEM_INDEX:
+        return
     if not re.fullmatch(r"[a-z][a-z0-9_-]{0,63}", name):
         raise ValueError(f"invalid name: {name!r}")

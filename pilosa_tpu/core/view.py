@@ -38,6 +38,7 @@ class View:
         cache_size: int = 50000,
         mutex: bool = False,
         cache_debounce: float = 0.0,
+        snapshot_debounce: float = 0.0,
         on_create_shard=None,
         row_attr_store=None,
         ack: str = fragment_mod.DEFAULT_ACK,
@@ -50,6 +51,7 @@ class View:
         self.cache_size = cache_size
         self.mutex = mutex
         self.cache_debounce = cache_debounce
+        self.snapshot_debounce = snapshot_debounce
         self.row_attr_store = row_attr_store
         # Ingest ack/durability level, threaded down to every fragment
         # ([storage] ack, docs/durability.md).
@@ -129,6 +131,7 @@ class View:
                 cache_size=self.cache_size,
                 mutex=self.mutex,
                 cache_debounce=self.cache_debounce,
+                snapshot_debounce=self.snapshot_debounce,
                 row_attr_store=self.row_attr_store,
                 on_touch=self._bump_version,
                 view_gen=self.gen,

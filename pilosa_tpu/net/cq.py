@@ -10,12 +10,19 @@ cost is O(changed bits), not O(data) — that is what makes a standing
 query affordable under streaming ingest.
 
 Delivery is long-poll (``GET /cq/{id}?since=N&wait_ms=M``), matching
-the serving tier's plain-HTTP surface: each changed result appends a
-``{"seq": n, "result": ...}`` entry to a bounded per-subscription log
-(oldest entries drop; a reader that fell behind resyncs from the
-latest entry, which always carries the FULL current result — deltas
-here are "the result changed", not a bit-level diff, so a dropped
-entry can never corrupt a reader's view).
+the serving tier's plain-HTTP surface: each changed result appends an
+entry to a bounded per-subscription log (oldest entries drop).
+
+Bitmap results ship DELTA DIFFS on the wire: when the previous and
+current results are both bitmap-shaped (``{"columns": [...]}``), the
+log entry is ``{"seq": n, "diff": [{"added": [...], "removed": [...]},
+...]}`` — one added/removed pair per result position — so a standing
+query over a big row costs O(changed ids) per delivery, not O(row).
+The FULL result is sent on the first delivery (seq 1, from create),
+whenever either side is not bitmap-shaped, and as a ``"resync": true``
+entry when a reader's ``since`` has fallen off the trimmed log (a
+missed diff cannot be reconstructed, so the poll answers with the
+current full result instead of a gapped diff stream).
 
 The write-side hook is DeltaHub.add_listener (core/delta.py): it fires
 inside the writing fragment's lock, so the callback only sets a flag —
@@ -38,7 +45,10 @@ __all__ = ["CQManager"]
 
 
 class _Sub:
-    __slots__ = ("qid", "index", "query", "seq", "last", "log")
+    __slots__ = (
+        "qid", "index", "query", "seq", "last", "last_result",
+        "last_cols", "log",
+    )
 
     def __init__(self, qid: str, index: str, query: str):
         self.qid = qid
@@ -46,7 +56,23 @@ class _Sub:
         self.query = query
         self.seq = 0
         self.last = None  # canonical JSON of the last served result
+        self.last_result = None  # full current result (resync answers)
+        self.last_cols = None  # per-result column sets when bitmap-shaped
         self.log: deque = deque(maxlen=CQManager.LOG_MAX)
+
+
+def _bitmap_cols(result):
+    """Per-result column-id sets when EVERY result is bitmap-shaped
+    (``{"columns": [ids]}``); None otherwise — counts, TopN, keyed rows
+    and mixed batches keep shipping full results."""
+    if not isinstance(result, list) or not result:
+        return None
+    out = []
+    for r in result:
+        if not isinstance(r, dict) or "columns" not in r:
+            return None
+        out.append(frozenset(r["columns"]))
+    return out
 
 
 class CQManager:
@@ -85,6 +111,8 @@ class CQManager:
             sub = _Sub("cq-%d" % next(self._ids), index, query)
             sub.seq = 1
             sub.last = canon
+            sub.last_result = result
+            sub.last_cols = _bitmap_cols(result)
             sub.log.append({"seq": 1, "result": result})
             self._subs[sub.qid] = sub
             self._ensure_running()
@@ -115,6 +143,23 @@ class CQManager:
                     raise KeyError(qid)
                 deltas = [e for e in sub.log if e["seq"] > since]
                 if deltas:
+                    if since > 0 and deltas[0]["seq"] > since + 1 and any(
+                        "result" not in e for e in deltas
+                    ):
+                        # The reader's position fell off the trimmed
+                        # log and at least one surviving entry is a
+                        # diff: a gapped diff stream would corrupt the
+                        # reader's view, so answer with the current
+                        # FULL result instead.
+                        return {
+                            "id": qid,
+                            "seq": sub.seq,
+                            "deltas": [{
+                                "seq": sub.seq,
+                                "result": sub.last_result,
+                                "resync": True,
+                            }],
+                        }
                     return {"id": qid, "seq": sub.seq, "deltas": deltas}
                 left = deadline - time.monotonic()
                 if left <= 0 or self._closed:
@@ -191,13 +236,33 @@ class CQManager:
             except Exception:  # a dropped index/field ends the stream
                 continue
             canon = _canon(result)
+            cols = _bitmap_cols(result)
             with self._cond:
                 sub = self._subs.get(qid)
                 if sub is None or sub.last == canon:
                     continue
                 sub.seq += 1
                 sub.last = canon
-                sub.log.append({"seq": sub.seq, "result": result})
+                if (
+                    cols is not None
+                    and sub.last_cols is not None
+                    and len(cols) == len(sub.last_cols)
+                ):
+                    entry = {
+                        "seq": sub.seq,
+                        "diff": [
+                            {
+                                "added": sorted(c - p),
+                                "removed": sorted(p - c),
+                            }
+                            for p, c in zip(sub.last_cols, cols)
+                        ],
+                    }
+                else:
+                    entry = {"seq": sub.seq, "result": result}
+                sub.last_result = result
+                sub.last_cols = cols
+                sub.log.append(entry)
                 self._c_deltas.inc()
                 self._cond.notify_all()
 

@@ -275,7 +275,24 @@ class FaultPlane:
         with self._lock:
             verdict = None
             for rule in self.rules:
-                if transport == "gossip" and rule.action in ("delay", "error"):
+                if transport == "serve":
+                    # INBOUND request interception (the served side of
+                    # the HTTP handler) is strictly opt-in: only rules
+                    # that name peer="serve" apply, and only the
+                    # delay/error actions make sense there — a blanket
+                    # peer="*" chaos rule must keep meaning "outbound
+                    # links", or every existing drill would take its own
+                    # control plane down.  ("serve" can never collide
+                    # with a real host:port peer.)
+                    if rule.peer != "serve" or rule.action not in (
+                        "delay", "error",
+                    ):
+                        continue
+                elif rule.peer == "serve":
+                    continue  # serve-only rules never match outbound
+                elif transport == "gossip" and rule.action in (
+                    "delay", "error",
+                ):
                     # Gossip honors drop/partition only: SWIM has no
                     # status channel, and sleeping the probe loop would
                     # fault the PROBER, not the link.

@@ -25,7 +25,7 @@ from ..executor.executor import Error as ExecError, FieldNotFoundError, IndexNot
 from ..executor.translate import TranslateError
 from ..pql import ParseError
 from ..util import plans as plans_mod
-from ..util.stats import REGISTRY
+from ..util.stats import METRIC_SERVER_ERRORS, REGISTRY
 from .admission import tenant_of
 from .wire import count_response_bytes, response_to_json
 
@@ -180,6 +180,12 @@ class Handler:
         # instance (connection gauges refreshed at scrape time).
         self.admission = None
         self.server = None
+        # 5xx accounting feeds the SLO error-rate objective: one cached
+        # handle, incremented by the handle() wrapper for every 5xx
+        # answer (dispatched, deferred, or fault-injected).
+        self._err_counter = REGISTRY.counter(METRIC_SERVER_ERRORS)
+        # Previous-scrape counter snapshot for /debug/vars "rates".
+        self._rates_prev = None
         self.routes: List[Route] = []
         r = self._route
         # Public routes (http/handler.go:237-259).
@@ -221,6 +227,8 @@ class Handler:
         r("GET", "/debug/plans", self._debug_plans)
         r("GET", "/debug/faults", self._debug_faults_get)
         r("POST", "/debug/faults", self._debug_faults_post)
+        r("GET", "/debug/history", self._debug_history)
+        r("GET", "/debug/flightrecorder", self._debug_flightrecorder)
         r("GET", "/debug/pprof", self._debug_pprof)
         r("GET", "/debug/pprof/goroutine", self._debug_pprof)
         r("GET", "/debug/pprof/profile", self._debug_pprof_profile)
@@ -270,6 +278,48 @@ class Handler:
     # -- dispatch ----------------------------------------------------------
 
     def handle(
+        self,
+        method: str,
+        path: str,
+        query: dict,
+        body: bytes,
+        headers: Optional[dict] = None,
+    ):
+        """Returns (status, content_type, payload bytes) or a
+        DeferredResponse.  Thin wrapper over _dispatch: applies the
+        fault plane's serve-side rules (peer="serve" — chaos drills
+        against this node's OWN http surface) and counts every 5xx
+        answer into pilosa_server_errors_total, the numerator of the
+        SLO error-rate objective (util/slo.py)."""
+        from .faults import PLANE
+
+        # /debug/faults stays immune so a drill can always be inspected
+        # and healed from the node it is faulting.
+        if PLANE.active and not path.startswith("/debug/faults"):
+            verdict = PLANE.intercept("serve", route=path, transport="serve")
+            if verdict is not None:  # error action; delay already slept
+                self._err_counter.inc()
+                payload = json.dumps(
+                    {"error": f"fault injected: {verdict.status}"}
+                ).encode()
+                return verdict.status, "application/json", payload
+        result = self._dispatch(method, path, query, body, headers)
+        if isinstance(result, DeferredResponse):
+            result.on_ready(
+                lambda status, ctype, payload: (
+                    self._err_counter.inc() if status >= 500 else None
+                )
+            )
+        elif (
+            isinstance(result, tuple)
+            and result
+            and isinstance(result[0], int)
+            and result[0] >= 500
+        ):
+            self._err_counter.inc()
+        return result
+
+    def _dispatch(
         self,
         method: str,
         path: str,
@@ -564,8 +614,26 @@ class Handler:
             "Content-Type", ""
         ) or proto.CONTENT_TYPE in headers.get("Accept", ""):
             return None
+        # The reactor fast path bypasses handle(), so it must run the
+        # same serve-side fault intercept and 5xx accounting — without
+        # this, an injected serve error (and the SLO watcher's
+        # error-rate objective) would only ever see worker-pool routes.
+        from .faults import PLANE
+
+        if PLANE.active and not path.startswith("/debug/faults"):
+            verdict = PLANE.intercept("serve", route=path, transport="serve")
+            if verdict is not None:
+                self._err_counter.inc()
+                payload = json.dumps(
+                    {"error": f"fault injected: {verdict.status}"}
+                ).encode()
+                return verdict.status, "application/json", payload
         req = self._query_request(m.group(1), query, body, headers)
-        return self._defer_query(req)
+        result = self._defer_query(req)
+        if isinstance(result, DeferredResponse):
+            result.on_ready(lambda status, ctype, payload: (
+                self._err_counter.inc() if status >= 500 else None))
+        return result
 
     def _post_query(self, q, b, *, index, **kw):
         req = self._query_request(index, q, b, kw.get("_headers", {}))
@@ -772,6 +840,13 @@ class Handler:
         ws = self.api.warm_status()
         if ws is not None:
             doc["warming"] = ws
+        # SLO burn reasons (util/slo.py): informational ONLY — a
+        # degraded node still answers 200 and still takes traffic
+        # (shedding is the admission controller's job); orchestrators
+        # that want to act on it read the body, not the status.
+        slo = getattr(self.api, "slo", None)
+        if slo is not None:
+            doc["degraded"] = slo.degraded
         payload = json.dumps(doc).encode()
         return (200 if ready else 503), "application/json", payload
 
@@ -936,6 +1011,61 @@ class Handler:
             )
         return PLANE.snapshot()
 
+    def _debug_history(self, q, b, **kw):
+        """GET /debug/history: read the self-hosted metrics history
+        (util/history.py — every registry series sampled into the
+        ``_system`` index).  ``?series=<family>`` is required;
+        ``since``/``until`` are epoch seconds (defaults: the last 5
+        minutes), ``step`` downsamples to a coarser grid, ``label``
+        filters to one label set.  Values are the STORED fixed-point
+        integers (divide by ``scale`` in the response for engineering
+        units) — exactly what a PQL ``Sum``/``Range`` over the
+        ``_system`` index returns for the same window."""
+        hist = getattr(self.api, "history", None)
+        if hist is None:
+            return 404, "application/json", json.dumps({
+                "error": "metrics history is not enabled "
+                         "(set [observability] history = true)"
+            }).encode()
+        series = q.get("series", [None])[0]
+        if not series:
+            raise ValueError("series parameter is required")
+
+        def _num(name):
+            raw = q.get(name, [None])[0]
+            if raw is None:
+                return None
+            try:
+                return float(raw)
+            except ValueError:
+                raise ValueError(f"{name} must be epoch seconds")
+
+        return hist.query(
+            series,
+            since=_num("since"),
+            until=_num("until"),
+            step=_num("step"),
+            label=q.get("label", [None])[0],
+        )
+
+    def _debug_flightrecorder(self, q, b, **kw):
+        """GET /debug/flightrecorder: capture a flight-recorder bundle
+        NOW — recent traces, worst plans, event-journal tail, engine /
+        residency state, hints/CQ/fault state, and the trailing window
+        of _system history.  The runbook move before restarting a sick
+        node.  ``?persist=1`` also writes it to <data-dir>/.flightrec/
+        like an SLO-triggered capture would."""
+        slo = getattr(self.api, "slo", None)
+        if slo is None:
+            return 404, "application/json", json.dumps({
+                "error": "flight recorder is not enabled "
+                         "(set [observability] history = true)"
+            }).encode()
+        bundle = slo.flight_bundle()
+        if q.get("persist", ["0"])[0] in ("1", "true"):
+            bundle["persistedTo"] = slo.persist_bundle(bundle)
+        return bundle
+
     def _debug_vars(self, q, b, **kw):
         stats = getattr(self.api.executor, "stats", None)
         out = (
@@ -1010,7 +1140,23 @@ class Handler:
         plans_mod.LEDGER.refresh_series()
         # The histogram registry's JSON view: same data /metrics serves,
         # merged here so one curl shows counters + stages + quantiles.
-        out["metrics"] = REGISTRY.snapshot()
+        snap = REGISTRY.snapshot()
+        out["metrics"] = snap
+        # Per-second counter rates since the PREVIOUS /debug/vars scrape
+        # (handler-held snapshot; the same diff_rates math the history
+        # sampler stores).  First scrape answers {} by design.
+        rates, self._rates_prev = REGISTRY.collect_rates(
+            self._rates_prev, snapshot=snap
+        )
+        out["rates"] = rates
+        # Self-hosted history + SLO state when the observability layer
+        # is wired (server.py lifecycle).
+        hist = getattr(self.api, "history", None)
+        if hist is not None:
+            out["history"] = hist.snapshot()
+        slo = getattr(self.api, "slo", None)
+        if slo is not None:
+            out["slo"] = slo.snapshot()
         return out
 
     def _debug_pprof(self, q, b, **kw):

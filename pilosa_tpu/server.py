@@ -98,6 +98,34 @@ class Server:
                 faults_mod.parse_rule(spec)
             except ValueError as e:
                 raise ValueError(f"[faults] rules: {e}") from None
+        # Observability knobs fail fast too (docs/observability.md): a
+        # zero sample interval would spin the sampler loop, and an
+        # error-rate target is a FRACTION of requests, not a percent.
+        if self.config.obs_history and float(self.config.obs_sample_interval) <= 0:
+            raise ValueError(
+                f"[observability] sample-interval = "
+                f"{self.config.obs_sample_interval!r}: expected a "
+                "positive duration"
+            )
+        if self.config.obs_history and (
+            float(self.config.obs_retention)
+            < float(self.config.obs_sample_interval)
+        ):
+            raise ValueError(
+                f"[observability] history-retention = "
+                f"{self.config.obs_retention!r}: expected >= sample-interval"
+            )
+        if not 0.0 <= float(self.config.obs_slo_error_rate) <= 1.0:
+            raise ValueError(
+                f"[observability] slo-error-rate = "
+                f"{self.config.obs_slo_error_rate!r}: expected a fraction "
+                "in [0, 1] (0 disables the objective)"
+            )
+        if float(self.config.obs_slo_burn_threshold) < 1.0:
+            raise ValueError(
+                f"[observability] slo-burn-threshold = "
+                f"{self.config.obs_slo_burn_threshold!r}: expected >= 1"
+            )
         self.data_dir = os.path.expanduser(self.config.data_dir)
         self.logger = self._make_logger()
         self.stats = self._make_stats()
@@ -278,6 +306,9 @@ class Server:
         self.logger.printf(
             "pilosa-tpu listening on %s:%d (node %s)", host, port, self.node_id
         )
+        # After serve(): process-mode sampling needs api.process_server
+        # and the handler, both wired by serve().
+        self._setup_observability()
         self._start_monitors()
         return self
 
@@ -720,6 +751,67 @@ class Server:
     @property
     def port(self) -> int:
         return self._http.server_address[1]
+
+    def _setup_observability(self):
+        """Self-hosted metrics history + SLO watcher
+        (docs/observability.md): a background tick samples every
+        registry series into the ``_system`` index (util/history.py)
+        and evaluates the configured SLO burn rates against it
+        (util/slo.py).  Off unless ``[observability] history = true`` —
+        the sampler writes through the normal import path every tick,
+        so tests and minimal deployments opt in."""
+        cfg = self.config
+        if not cfg.obs_history:
+            return
+        from .util.history import HistorySampler
+        from .util.slo import SLOWatcher
+
+        snapshot_fn = None
+        ps = self.api.process_server
+        if ps is not None:
+            # Process mode: one history for the whole NODE — sample the
+            # same aggregated exposition /metrics serves (engine process
+            # + every worker registry summed at scrape time), parsed
+            # back into snapshot shape.
+            from .util.stats import snapshot_from_exposition
+
+            handler = getattr(
+                getattr(self._http, "RequestHandlerClass", None),
+                "handler", None,
+            )
+            if handler is not None:
+                snapshot_fn = lambda: snapshot_from_exposition(  # noqa: E731
+                    ps.aggregate_metrics(handler)
+                )
+        self.api.history = HistorySampler(
+            self.api,
+            node=self.node_id,
+            interval=cfg.obs_sample_interval,
+            retention=cfg.obs_retention,
+            snapshot_fn=snapshot_fn,
+        )
+        self.api.slo = SLOWatcher(
+            self.api,
+            self.api.history,
+            node=self.node_id,
+            error_rate_target=cfg.obs_slo_error_rate,
+            latency_p95_ms_target=cfg.obs_slo_latency_p95_ms,
+            window=cfg.obs_slo_window,
+            burn_threshold=cfg.obs_slo_burn_threshold,
+            data_dir=self.data_dir,
+            max_bundles=cfg.obs_flightrec_max_bundles,
+        )
+        self.journal.append(
+            "observability.start",
+            interval=cfg.obs_sample_interval,
+            retention=cfg.obs_retention,
+            processMode=ps is not None,
+        )
+        self._spawn(self._observability_tick, cfg.obs_sample_interval)
+
+    def _observability_tick(self):
+        self.api.history.tick()
+        self.api.slo.tick()
 
     def _start_monitors(self):
         # Overlapped warm-start (docs/durability.md): re-establish HBM

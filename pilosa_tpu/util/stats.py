@@ -335,6 +335,28 @@ class MetricsRegistry:
             },
         }
 
+    def collect_rates(self, prev, now: Optional[float] = None,
+                      snapshot: Optional[dict] = None):
+        """Counter snapshot -> per-second rates since ``prev``.
+
+        ``prev`` is the opaque state returned by the previous call (or
+        ``None`` on the first call, which yields no rates — a rate needs
+        two samples).  Returns ``(rates, state)`` where ``rates`` maps
+        ``family -> {label_str: per_second}`` and ``state`` must be fed
+        back next call.  Shared by the history sampler and /debug/vars.
+        Monotonic-reset safe via :func:`diff_rates`.
+        """
+        if now is None:
+            now = time.time()
+        snap = snapshot if snapshot is not None else self.snapshot()
+        counters = snap.get("counters", {})
+        state = {"ts": now, "counters": counters}
+        if not prev or not prev.get("counters"):
+            return {}, state
+        dt = now - float(prev.get("ts", now))
+        rates = diff_rates(prev["counters"], counters, dt)
+        return rates, state
+
 
 # The process-wide metrics registry: always-on, exported at GET /metrics
 # and merged into /debug/vars.  Series names:
@@ -442,6 +464,11 @@ METRIC_INGEST_SYNC_CHUNKS = "pilosa_ingest_sync_chunks_total"
 METRIC_INGEST_SYNC_COALESCED = "pilosa_ingest_sync_coalesced_total"
 METRIC_INGEST_SYNC_DISPATCHES = "pilosa_ingest_sync_dispatches_total"
 INGEST_PATHS = ("bits", "values", "roaring")
+# The history sampler's own writes land under path="system" — NOT in the
+# headline INGEST_PATHS tuple — so --ingest-sweep numbers and the sampled
+# pilosa_ingest_* rate series can never be polluted by the sampler itself
+# (the self-observation guard, docs/observability.md).
+INGEST_PATH_SYSTEM = "system"
 
 # -- durability & serving-through-failure (docs/durability.md) --------------
 #   pilosa_ingest_acked_unsynced_bytes      gauge: op-log bytes ACKED to a
@@ -586,8 +613,33 @@ METRIC_ADMISSION_SHED = "pilosa_admission_shed_total"
 METRIC_SERVER_CONNECTIONS = "pilosa_server_connections"
 METRIC_SERVER_CONNECTIONS_TOTAL = "pilosa_server_connections_total"
 METRIC_SERVER_REQUESTS = "pilosa_server_requests_total"
+#   pilosa_server_errors_total              counter: 5xx responses served
+#                                           (includes fault-plane injected
+#                                           errors) — the numerator of the
+#                                           error-rate SLO (util/slo.py)
+METRIC_SERVER_ERRORS = "pilosa_server_errors_total"
 SHED_REASONS = ("overload", "tenant_fair", "queue_full")
 SERVER_REQUEST_PATHS = ("inline", "pool", "shed")
+
+# -- self-hosted metrics history (docs/observability.md) ---------------------
+#   pilosa_history_samples_total            series values the sampler wrote
+#                                           into the _system index
+#   pilosa_history_ticks_total              sampler passes completed
+#   pilosa_history_views_dropped_total      time-quantum views retired by
+#                                           retention
+#   pilosa_history_dropped_total{reason=}   series values NOT stored
+#                                           (stride | clamp | error)
+#   pilosa_history_tick_seconds             histogram: cost of one sampler
+#                                           pass — the measured numerator of
+#                                           bench.py --history-overhead
+#   pilosa_slo_burn_total{slo=}             SLO burn events journaled
+METRIC_HISTORY_SAMPLES = "pilosa_history_samples_total"
+METRIC_HISTORY_TICKS = "pilosa_history_ticks_total"
+METRIC_HISTORY_VIEWS_DROPPED = "pilosa_history_views_dropped_total"
+METRIC_HISTORY_DROPPED = "pilosa_history_dropped_total"
+METRIC_HISTORY_TICK_SECONDS = "pilosa_history_tick_seconds"
+METRIC_SLO_BURN = "pilosa_slo_burn_total"
+HISTORY_DROP_REASONS = ("stride", "clamp", "error")
 
 # Engine cache names labelling the hit/miss counter series (engine.py
 # resolves one handle pair per name at construction).  The memo_* names
@@ -829,6 +881,46 @@ for _p in SERVER_REQUEST_PATHS:
         help="HTTP requests by dispatch path",
         path=_p,
     )
+REGISTRY.counter(
+    METRIC_SERVER_ERRORS,
+    help="HTTP 5xx responses served (incl. fault-plane injections)",
+)
+REGISTRY.counter(
+    METRIC_INGEST_BATCHES,
+    help="Bulk-import batches accepted",
+    path=INGEST_PATH_SYSTEM,
+)
+REGISTRY.counter(
+    METRIC_INGEST_BITS,
+    help="Bits submitted to bulk imports",
+    path=INGEST_PATH_SYSTEM,
+)
+REGISTRY.histogram(
+    METRIC_INGEST_SECONDS,
+    help="Bulk-import batch apply latency (seconds)",
+    path=INGEST_PATH_SYSTEM,
+)
+REGISTRY.counter(
+    METRIC_HISTORY_SAMPLES,
+    help="Series values the history sampler stored in _system",
+)
+REGISTRY.counter(
+    METRIC_HISTORY_TICKS, help="History sampler passes completed"
+)
+REGISTRY.counter(
+    METRIC_HISTORY_VIEWS_DROPPED,
+    help="_system time-quantum views retired by retention",
+)
+for _reason in HISTORY_DROP_REASONS:
+    REGISTRY.counter(
+        METRIC_HISTORY_DROPPED,
+        help="Series values the sampler could not store",
+        reason=_reason,
+    )
+REGISTRY.histogram(
+    METRIC_HISTORY_TICK_SECONDS,
+    help="Cost of one history sampler pass (seconds)",
+)
 del _stage, _cache, _phase, _path, _reason, _p
 
 
@@ -946,6 +1038,133 @@ def merge_expositions(primary: str, others: Dict[str, str]) -> str:
         else:
             out.extend(tail_lines)
     return "\n".join(out) + "\n"
+
+
+def diff_rates(prev_counters: dict, cur_counters: dict,
+               dt: float) -> Dict[str, Dict[str, float]]:
+    """Per-second rates from two counter snapshots taken ``dt`` apart.
+
+    Both snapshots use the ``snapshot()["counters"]`` shape
+    (``family -> {label_str: cumulative}``).  Monotonic-reset safe: a
+    counter that went DOWN (process restart, registry reset) contributes
+    its current value as the delta — the post-reset accumulation is the
+    best available estimate and never goes negative.  Label churn is
+    handled conservatively: a label set absent from ``prev`` is skipped
+    (its rate appears one interval later), a label set absent from
+    ``cur`` emits nothing.
+    """
+    if dt <= 0:
+        return {}
+    out: Dict[str, Dict[str, float]] = {}
+    for family, cur in cur_counters.items():
+        prev = prev_counters.get(family)
+        if prev is None:
+            continue
+        fam_out = {}
+        for label_str, cur_v in cur.items():
+            if label_str not in prev:
+                continue
+            d = cur_v - prev[label_str]
+            if d < 0:
+                d = cur_v
+            fam_out[label_str] = d / dt
+        if fam_out:
+            out[family] = fam_out
+    return out
+
+
+def snapshot_from_exposition(text: str) -> dict:
+    """Parse a classic Prometheus exposition back into the
+    ``MetricsRegistry.snapshot()`` shape.
+
+    The process-mode history sampler runs in the device-owner process
+    but must see the WHOLE node, so it samples the merged exposition
+    from ``aggregate_metrics`` instead of the local registry.  Counters
+    and gauges map directly (via # TYPE metadata); histograms are
+    reconstructed from their cumulative ``_bucket`` lines against
+    DEFAULT_BUCKETS so p50/p95 come out of the same quantile math
+    ``Histogram.snapshot`` uses.
+    """
+    types: Dict[str, str] = {}
+    for fam, meta in _exposition_meta(text).items():
+        for line in meta:
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[fam] = parts[3]
+    counters: Dict[str, Dict[str, float]] = {}
+    gauges: Dict[str, Dict[str, float]] = {}
+    # histogram family -> label_str -> {"buckets": {le: v}, "sum": s,
+    # "count": c}
+    hraw: Dict[str, Dict[str, dict]] = {}
+
+    def split_key(key: str):
+        if "{" in key:
+            name, _, rest = key.partition("{")
+            labels = rest.rstrip("}")
+            pairs = []
+            for part in re.findall(r'([A-Za-z0-9_]+)="((?:[^"\\]|\\.)*)"',
+                                   labels):
+                k, v = part
+                v = v.replace('\\"', '"').replace("\\n", "\n")
+                v = v.replace("\\\\", "\\")
+                pairs.append((k, v))
+            return name, pairs
+        return key, []
+
+    def label_str(pairs) -> str:
+        return ",".join(f"{k}={v}" for k, v in pairs) or "_"
+
+    for key, v, _suffix in _iter_samples(text):
+        name, pairs = split_key(key)
+        base = name
+        kind = None
+        for strip in ("_bucket", "_sum", "_count"):
+            if name.endswith(strip) and types.get(name[: -len(strip)]) == \
+                    "histogram":
+                base = name[: -len(strip)]
+                kind = strip
+                break
+        if kind is not None:
+            le = None
+            core = [(k, lv) for k, lv in pairs if k != "le"]
+            for k, lv in pairs:
+                if k == "le":
+                    le = lv
+            ent = hraw.setdefault(base, {}).setdefault(
+                label_str(core), {"buckets": {}, "sum": 0.0, "count": 0.0}
+            )
+            if kind == "_bucket" and le is not None:
+                ent["buckets"][le] = v
+            elif kind == "_sum":
+                ent["sum"] = v
+            elif kind == "_count":
+                ent["count"] = v
+            continue
+        t = types.get(name)
+        if t == "counter":
+            counters.setdefault(name, {})[label_str(pairs)] = v
+        elif t == "gauge":
+            gauges.setdefault(name, {})[label_str(pairs)] = v
+
+    histograms: Dict[str, Dict[str, dict]] = {}
+    for fam, series in hraw.items():
+        out = histograms.setdefault(fam, {})
+        for ls, ent in series.items():
+            h = Histogram()
+            cumulative = [
+                ent["buckets"].get(_prom_float(b), 0.0)
+                for b in DEFAULT_BUCKETS
+            ]
+            cumulative.append(ent["buckets"].get("+Inf", ent["count"]))
+            prev = 0.0
+            for i, c in enumerate(cumulative):
+                h._counts[i] = max(0, int(round(c - prev)))
+                prev = max(prev, c)
+            h.count = int(ent["count"])
+            h.sum = float(ent["sum"])
+            out[ls] = h.snapshot()
+    return {"histograms": histograms, "counters": counters,
+            "gauges": gauges}
 
 
 class StatsClient:

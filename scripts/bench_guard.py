@@ -135,6 +135,12 @@ AUTO_REQUIRE = (
     # so the streaming-maintenance lane cannot be silently dropped.
     "result_memo_hit_rate_under_write_load",
     "dashboard_p50_under_ingest_vs_idle",
+    # Self-hosted metrics history (bench.py --history-overhead,
+    # docs/observability.md): the sampler's 1s-interval duty cycle
+    # ("pct" regresses UP; the <3% ISSUE 17 acceptance holds via
+    # ABS_CEILING) and the 1h-window /debug/history read p50.
+    "history_sampler_overhead_pct",
+    "history_query_p50_ms",
 )
 
 # Direction overrides for metrics whose UNIT would mislead: the unit
@@ -156,6 +162,10 @@ NAME_HIGHER_BETTER = {
 # run while the binding contract is the absolute <2% ceiling below.
 DEFAULT_METRIC_TOL = {
     "profile_overhead_pct": 1.0,
+    # Tick cost over a fixed interval: the numerator is a best-of-K
+    # microbench on shared vCPUs, so the ratio wobbles while the
+    # binding contract is the absolute <3% ceiling below.
+    "history_sampler_overhead_pct": 1.0,
     # A ratio of two closed-loop QPS measurements on a contended host:
     # wobbles far more than either numerator; the availability floor
     # below is the binding chaos contract.
@@ -173,6 +183,9 @@ DEFAULT_METRIC_TOL = {
 # one is a failure even when the relative delta is within tolerance.
 ABS_CEILING = {
     "profile_overhead_pct": 2.0,
+    # ISSUE 17 acceptance: the history sampler's worst-case duty cycle
+    # at the 1s smoke interval stays under 3% of one core.
+    "history_sampler_overhead_pct": 3.0,
     # ISSUE 16 acceptance: a repeated dashboard under streaming ingest
     # stays within 1.5x of its idle p50 (repair keeps serves O(changed
     # bits) instead of O(data) recomputes).

@@ -1142,4 +1142,146 @@ srv.shutdown(); srv.server_close()
 eng.close()
 EOF
 
+# Observability lane (docs/observability.md "Metrics history, SLOs &
+# flight recorder"): boot a full Server with 1s sampling and a tight
+# error-rate SLO; assert (a) the self-hosted history accumulates >=2
+# /debug/history points for the query-seconds rate, (b) a fault-plane
+# serve error rule forces an SLO breach -> slo.burn at /debug/events +
+# degraded (non-503) /readyz, and (c) a flight-recorder bundle was
+# persisted under <data-dir>/.flightrec/ carrying the breaching
+# window's history.
+env JAX_PLATFORMS=cpu PILOSA_TPU_MESH_DEVICES=1 python - <<'EOF'
+import json
+import os
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+from pilosa_tpu.config import Config
+from pilosa_tpu.server import Server
+
+tmp = tempfile.mkdtemp()
+cfg = Config()
+cfg.data_dir = os.path.join(tmp, "obs")
+cfg.bind = "localhost:0"
+cfg.obs_history = True
+cfg.obs_sample_interval = 1.0
+cfg.obs_retention = 600.0
+cfg.obs_slo_error_rate = 0.02
+cfg.obs_slo_window = 8.0
+cfg.obs_slo_burn_threshold = 1.0
+srv = Server(cfg)
+srv.open(port_override=0)
+port = srv.port
+
+
+def get(path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://localhost:{port}{path}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def post(path, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://localhost:{port}{path}", data=body, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+try:
+    post("/index/osmoke", b"{}")
+    post("/index/osmoke/field/f", b'{"options": {"type": "set"}}')
+    post(
+        "/index/osmoke/field/f/import",
+        json.dumps({"rowIDs": [1, 1, 1], "columnIDs": [0, 5, 9]}).encode(),
+    )
+
+    # (a) >=2 history points for the query rate: keep querying while the
+    # 1s sampler ticks; every point is a real sampled rate.
+    deadline = time.monotonic() + 60
+    n_points = 0
+    while n_points < 2:
+        assert time.monotonic() < deadline, (
+            f"/debug/history never reached 2 query-rate points ({n_points})"
+        )
+        for _ in range(4):
+            out = post("/index/osmoke/query", b"Count(Row(f=1))", timeout=60)
+            assert out["results"][0] == 3, out
+        doc = get("/debug/history?series=pilosa_query_seconds_rate")
+        n_points = sum(len(p) for p in doc["points"].values())
+        time.sleep(0.3)
+    assert doc.get("scale", 0) > 0, doc
+
+    # (b) force the SLO breach: every /index/* request answers 503 from
+    # the deterministic fault plane (the debug surfaces stay reachable),
+    # so the error-rate objective burns within the 8s window.
+    doc = post("/debug/faults", json.dumps({
+        "rules": [{
+            "action": "error", "peer": "serve",
+            "route": "/index/*", "status": 503,
+        }],
+    }).encode())
+    assert doc["active"], doc
+    deadline = time.monotonic() + 90
+    burned = False
+    while not burned:
+        assert time.monotonic() < deadline, "slo.burn never journaled"
+        for _ in range(4):
+            try:
+                post("/index/osmoke/query", b"Count(Row(f=1))", timeout=30)
+                raise AssertionError("serve fault rule did not fire")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503, e.code
+        ev = get("/debug/events?type=slo")
+        burned = any(e["type"] == "slo.burn" for e in ev["events"])
+        time.sleep(0.3)
+
+    # Degraded flips into the /readyz BODY, never its status code.
+    rdy = get("/readyz")
+    assert any(
+        r.startswith("slo:") for r in rdy.get("degraded", [])
+    ), rdy
+
+    # (c) the on-demand bundle answers, and the breach persisted one
+    # under <data-dir>/.flightrec/ carrying the breaching window's
+    # history (the error-rate series the watcher burned on).
+    bundle = get("/debug/flightrecorder", timeout=60)
+    assert bundle["kind"] == "flightrecorder" and bundle["history"], bundle
+    frdir = os.path.join(cfg.data_dir, ".flightrec")
+    files = sorted(
+        fn for fn in os.listdir(frdir)
+        if fn.startswith("bundle-") and fn.endswith(".json")
+    )
+    assert files, f"no persisted flight-recorder bundle in {frdir}"
+    with open(os.path.join(frdir, files[-1]), encoding="utf-8") as fh:
+        persisted = json.load(fh)
+    assert persisted["reason"] == "error_rate", persisted["reason"]
+    fams = persisted["history"]
+    assert "pilosa_server_errors_total_rate" in fams, sorted(fams)[:20]
+    assert any(e["type"] == "slo.burn" for e in persisted["events"]["events"])
+
+    # Heal; the objective clears (edge-triggered slo.clear journals).
+    post("/debug/faults", json.dumps({"rules": []}).encode())
+    deadline = time.monotonic() + 90
+    while True:
+        for _ in range(4):
+            post("/index/osmoke/query", b"Count(Row(f=1))", timeout=60)
+        ev = get("/debug/events?type=slo")
+        if any(e["type"] == "slo.clear" for e in ev["events"]):
+            break
+        assert time.monotonic() < deadline, "slo.clear never journaled"
+        time.sleep(0.3)
+    print(
+        "observability lane OK: /debug/history >=2 query-rate points -> "
+        "fault-forced burn (slo.burn journaled, /readyz degraded, "
+        "persisted .flightrec bundle with the breaching window) -> heal "
+        "-> slo.clear"
+    )
+finally:
+    srv.close()
+EOF
+
 echo "smoke OK"

@@ -1,0 +1,325 @@
+"""Self-hosted metrics history tests (docs/observability.md): the
+sampler's end-to-end self-hosting proof (PQL over ``_system`` returns
+the same values /debug/history serves), retention's bounded view drop,
+the self-observation guard, collect_rates/exposition round-trip units,
+SLO burn -> journal + flight-recorder bundle, serve-side fault
+injection, and the CQ delta-diff wire regression."""
+
+import collections
+import json
+
+import pytest
+
+from pilosa_tpu.api import API, QueryRequest
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import SYSTEM_INDEX
+from pilosa_tpu.net.faults import PLANE
+from pilosa_tpu.net.server import Handler
+from pilosa_tpu.net.wire import response_to_json
+from pilosa_tpu.util.events import EventJournal
+from pilosa_tpu.util.history import SCALE, STRIDE, HistorySampler
+from pilosa_tpu.util.slo import SLOWatcher
+from pilosa_tpu.util.stats import (
+    METRIC_SERVER_ERRORS,
+    MetricsRegistry,
+    REGISTRY,
+    diff_rates,
+    snapshot_from_exposition,
+)
+
+# 2025-08-06 10:00:00 UTC — hour-aligned so the PQL Range in the parity
+# test decomposes to exactly the hour views the sampler wrote.
+T0 = 1754474400.0
+
+
+@pytest.fixture
+def api(tmp_path):
+    h = Holder(path=str(tmp_path / "data"))
+    h.open()
+    a = API(holder=h, journal=EventJournal(node="t"))
+    yield a
+    h.close()
+
+
+# -- collect_rates / diff_rates ----------------------------------------------
+
+
+def test_collect_rates_first_call_and_rate():
+    reg = MetricsRegistry()
+    reg.counter("x_total").inc(10)
+    rates, state = reg.collect_rates(None, now=100.0)
+    assert rates == {}  # no baseline yet, by design
+    reg.counter("x_total").inc(5)
+    rates, state2 = reg.collect_rates(state, now=110.0)
+    assert rates["x_total"]["_"] == pytest.approx(0.5)
+    assert state2["ts"] == 110.0
+
+
+def test_collect_rates_counter_reset():
+    reg = MetricsRegistry()
+    prev = {"ts": 100.0, "counters": {"x_total": {"_": 10.0}}}
+    # A restarted process re-counts from zero: the diff goes negative,
+    # and the current value is the conservative rate numerator.
+    rates, _ = reg.collect_rates(
+        prev, now=110.0, snapshot={"counters": {"x_total": {"_": 3.0}}}
+    )
+    assert rates["x_total"]["_"] == pytest.approx(0.3)
+
+
+def test_collect_rates_label_churn():
+    prev = {"x_total": {"a=1": 5.0}}
+    cur = {"x_total": {"a=1": 6.0, "b=2": 4.0}, "y_total": {"_": 9.0}}
+    rates = diff_rates(prev, cur, 10.0)
+    assert rates["x_total"]["a=1"] == pytest.approx(0.1)
+    # A label set (or family) with no baseline is skipped, not guessed.
+    assert "b=2" not in rates["x_total"]
+    assert "y_total" not in rates
+
+
+def test_snapshot_from_exposition_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("rt_total", kind="a").inc(7)
+    reg.counter("rt_total", kind="b").inc(2)
+    reg.set_gauge("rt_gauge", 3.5)
+    h = reg.histogram("rt_seconds")
+    for v in (0.0003, 0.003, 0.003, 2.5):
+        h.observe(v)
+    direct = reg.snapshot()
+    parsed = snapshot_from_exposition(reg.prometheus_text())
+    assert parsed["counters"]["rt_total"] == direct["counters"]["rt_total"]
+    assert parsed["gauges"]["rt_gauge"] == direct["gauges"]["rt_gauge"]
+    dh = direct["histograms"]["rt_seconds"]["_"]
+    ph = parsed["histograms"]["rt_seconds"]["_"]
+    assert ph["count"] == dh["count"]
+    assert ph["sumSeconds"] == pytest.approx(dh["sumSeconds"])
+    assert ph["p50"] == pytest.approx(dh["p50"])
+
+
+# -- the self-hosting proof --------------------------------------------------
+
+
+def test_sampler_pql_parity_with_debug_history(api):
+    """After sampler ticks under live query load, a PQL Sum over a
+    Range of the ``_system`` index returns the SAME values the
+    /debug/history endpoint serves — the index queries its own
+    telemetry through its own engine."""
+    idx = api.holder.create_index("load")
+    idx.create_field("f")
+    hist = HistorySampler(api, interval=10.0, retention=3600.0)
+    # Register BEFORE the baseline tick: a series with no baseline is
+    # skipped rather than guessed (diff_rates contract).
+    c = REGISTRY.counter("history_parity_total")
+    hist.tick(now=T0)  # baseline (no rates yet)
+    c.inc(5)
+    # Live query load between ticks, so real engine series move too.
+    api.executor.execute("load", "Set(1, f=10) Set(2, f=10)")
+    api.executor.execute("load", "Row(f=10)")
+    hist.tick(now=T0 + 10)  # stores 5/10s -> 0.5/s -> 500 scaled
+    c.inc(3)
+    hist.tick(now=T0 + 20)  # stores 0.3/s -> 300 scaled
+
+    fam = "history_parity_total_rate"
+    sid = hist._series[fam]["_"]
+    assert sid < STRIDE
+    resp = api.query(QueryRequest(
+        SYSTEM_INDEX,
+        f"Sum(Range(samples={sid}, 2025-08-06T10:00, 2025-08-06T11:00), "
+        f"field={fam})",
+    ))
+    pql = response_to_json(resp)["results"][0]
+
+    doc = hist.query(fam, since=T0, until=T0 + 30)
+    pts = doc["points"]["_"]
+    assert [v for _, v in pts] == [500, 300]
+    assert pql["value"] == sum(v for _, v in pts)
+    assert pql["count"] == len(pts)
+    assert doc["scale"] == SCALE
+    # The real query-load series landed too.
+    q = hist.query("pilosa_query_seconds_rate", since=T0, until=T0 + 30)
+    assert any(p for p in q["points"].values())
+
+
+def test_retention_drops_expired_hour_views(api):
+    hist = HistorySampler(api, interval=10.0, retention=3600.0)
+    REGISTRY.counter("retention_probe_total").inc(1)
+    hist.tick(now=T0)
+    REGISTRY.counter("retention_probe_total").inc(1)
+    hist.tick(now=T0 + 10)
+    f = api.holder.index(SYSTEM_INDEX).field("samples")
+    assert "standard_2025080610" in f.views
+    # Two hours + retention later: hour-10's view has fully aged out.
+    hist.tick(now=T0 + 3600.0 + 7300.0)
+    names = sorted(f.views)
+    assert "standard_2025080610" not in names
+    # Bounded file count: live views cover at most retention + the
+    # current partial hour.
+    assert len(names) <= int(3600.0 / 3600.0) + 2
+    # And the dropped window is gone from the read path.
+    doc = hist.query("retention_probe_total_rate", since=T0, until=T0 + 30)
+    assert all(not p for p in doc["points"].values())
+
+
+def test_sampler_self_observation_guard(api):
+    """The sampler's own imports are rerouted to path="system" and
+    never sampled back — headline ingest series stay untouched and no
+    feedback loop forms."""
+    hist = HistorySampler(api, interval=10.0)
+
+    def series(name):
+        return dict(REGISTRY.snapshot()["counters"].get(name, {}))
+
+    bits_before = series("pilosa_ingest_bits_total")
+    hist.tick(now=T0)
+    REGISTRY.counter("guard_probe_total").inc(1)
+    hist.tick(now=T0 + 10)
+    hist.tick(now=T0 + 20)
+    bits_after = series("pilosa_ingest_bits_total")
+    # Headline paths unchanged by the sampler's own writes...
+    for path in ("path=bits", "path=values", "path=roaring"):
+        assert bits_after.get(path, 0) == bits_before.get(path, 0)
+    # ...which were all accounted under path="system".
+    assert bits_after["path=system"] > bits_before.get("path=system", 0)
+    # And the sampler never samples its own ingest series back.
+    for fam, labels in hist._series.items():
+        if fam.startswith("pilosa_ingest_"):
+            assert "path=system" not in " ".join(labels), fam
+
+
+# -- SLO burn-rate watcher + flight recorder ---------------------------------
+
+
+def test_slo_burn_journals_and_persists_bundle(api, tmp_path):
+    hist = HistorySampler(api, interval=10.0)
+    slo = SLOWatcher(
+        api, hist, error_rate_target=0.01, window=60.0,
+        burn_threshold=2.0, data_dir=str(tmp_path), max_bundles=3,
+    )
+    errs = REGISTRY.counter(METRIC_SERVER_ERRORS)
+    # The pre-registered request series carry path= labels — use one so
+    # the baseline tick already knows it.
+    reqs = REGISTRY.counter("pilosa_server_requests_total", path="inline")
+    hist.tick(now=T0)
+    errs.inc(5)
+    reqs.inc(10)
+    hist.tick(now=T0 + 10)
+    ev = slo.tick(now=T0 + 10)
+    assert ev["error_rate"]["burnRate"] > 2.0
+    assert slo.degraded == ["slo:error_rate"]
+
+    events = api.journal.to_doc(type="slo.burn")["events"]
+    assert events and events[-1]["fields"]["slo"] == "error_rate"
+    paths = slo.bundle_paths()
+    assert len(paths) == 1
+    with open(paths[0]) as fh:
+        bundle = json.load(fh)
+    assert bundle["reason"] == "error_rate"
+    # The bundle carries the breaching window's history.
+    fam = METRIC_SERVER_ERRORS + "_rate"
+    assert any(v for _, v in bundle["history"][fam]["points"]["_"])
+
+    # Edge-triggered: still burning -> no second bundle.
+    slo.tick(now=T0 + 20)
+    assert len(slo.bundle_paths()) == 1
+    # Recovery: requests keep flowing, errors stop, window rolls past.
+    reqs.inc(10)
+    hist.tick(now=T0 + 400)
+    slo.tick(now=T0 + 400)
+    assert slo.degraded == []
+    clears = api.journal.to_doc(type="slo.clear")["events"]
+    assert clears and clears[-1]["fields"]["slo"] == "error_rate"
+
+
+# -- serve-side fault injection ----------------------------------------------
+
+
+def test_serve_fault_injection_counts_errors(api):
+    handler = Handler(api)
+    base = REGISTRY.counter(METRIC_SERVER_ERRORS).get()
+    try:
+        PLANE.configure([
+            {"action": "error", "peer": "serve", "status": 503},
+        ])
+        st, _, payload = handler.handle("GET", "/schema", {}, b"")
+        assert st == 503 and b"fault injected" in payload
+        assert REGISTRY.counter(METRIC_SERVER_ERRORS).get() == base + 1
+        # The faults surface itself stays immune: a drill must remain
+        # inspectable and healable from the node it is faulting.
+        st, _, _ = handler.handle("GET", "/debug/faults", {}, b"")
+        assert st == 200
+        # A serve rule never leaks into outbound interception.
+        assert PLANE.intercept("127.0.0.1:9999", route="/schema") is None
+    finally:
+        PLANE.clear()
+    st, _, _ = handler.handle("GET", "/schema", {}, b"")
+    assert st == 200
+
+
+def test_debug_history_endpoint_disabled_and_enabled(api):
+    handler = Handler(api)
+    st, _, payload = handler.handle(
+        "GET", "/debug/history", {"series": ["x"]}, b""
+    )
+    assert st == 404 and b"not enabled" in payload
+    api.history = HistorySampler(api, interval=10.0)
+    REGISTRY.counter("endpoint_probe_total").inc(1)
+    api.history.tick(now=T0)
+    REGISTRY.counter("endpoint_probe_total").inc(1)
+    api.history.tick(now=T0 + 10)
+    st, _, payload = handler.handle(
+        "GET", "/debug/history",
+        {"series": ["endpoint_probe_total_rate"],
+         "since": [str(T0)], "until": [str(T0 + 30)]},
+        b"",
+    )
+    assert st == 200
+    doc = json.loads(payload)
+    assert doc["points"]["_"] == [[T0 + 10, 100]]
+
+
+# -- CQ delta diffs on the wire ----------------------------------------------
+
+
+def test_cq_single_bit_write_ships_single_id_diff(api):
+    """The regression the satellite pins: one Set ships a one-id diff,
+    not the whole row."""
+    idx = api.holder.create_index("cqd")
+    idx.create_field("f")
+    api.executor.execute("cqd", "Set(1, f=10) Set(2, f=10)")
+    doc = api.cq.create("cqd", "Row(f=10)")
+    qid = doc["id"]
+    assert sorted(doc["result"][0]["columns"]) == [1, 2]
+    try:
+        api.executor.execute("cqd", "Set(7, f=10)")
+        out = api.cq.poll(qid, since=1, wait_ms=5000)
+        entry = out["deltas"][-1]
+        assert "result" not in entry
+        assert entry["diff"] == [{"added": [7], "removed": []}]
+        api.executor.execute("cqd", "Clear(1, f=10)")
+        out = api.cq.poll(qid, since=out["seq"], wait_ms=5000)
+        assert out["deltas"][-1]["diff"] == [{"added": [], "removed": [1]}]
+    finally:
+        api.cq.close()
+
+
+def test_cq_trim_gap_resyncs_with_full_result(api):
+    idx = api.holder.create_index("cqr")
+    idx.create_field("f")
+    api.executor.execute("cqr", "Set(1, f=10)")
+    doc = api.cq.create("cqr", "Row(f=10)")
+    qid = doc["id"]
+    try:
+        sub = api.cq._subs[qid]
+        sub.log = collections.deque(sub.log, maxlen=2)
+        seq = 1
+        for k in (20, 21, 22, 23):
+            api.executor.execute("cqr", f"Set({k}, f=10)")
+            seq = api.cq.poll(qid, since=seq, wait_ms=5000)["seq"]
+        # since=1 fell off the trimmed log and the survivors are diffs:
+        # the poll answers with the current FULL result, marked resync.
+        out = api.cq.poll(qid, since=1, wait_ms=100)
+        assert len(out["deltas"]) == 1
+        entry = out["deltas"][0]
+        assert entry["resync"] is True
+        assert sorted(entry["result"][0]["columns"]) == [1, 20, 21, 22, 23]
+    finally:
+        api.cq.close()
