@@ -1365,7 +1365,7 @@ print(json.dumps({"n": sum(c.got for c in conns),
 # the fused win compresses to the pure bytes-saved ratio (~1.2x here).
 # The TPU round measures the full shape (docs/fusion.md).
 DASH_SHARDS = 8
-DASH_WIDGETS = (2, 4, 8)
+DASH_WIDGETS = (2, 4, 8, 10)
 DASH_REPS = 24
 
 
@@ -1392,6 +1392,13 @@ def _dash_entries(pql, n, shards):
           "src": segc()}, shards),
         ({"kind": "count",
           "call": pql.parse(f"Difference({seg}, Row(w=3))").calls[0]}, shards),
+        # PR 18 widgets: a GroupBy counted as one fused `group` edge and
+        # a second full TopN riding the shared segment mask (device trim).
+        ({"kind": "group", "fields": ["g"], "rows": [[0, 1, 2, 3]],
+          "filter": segc()}, shards),
+        ({"kind": "topnf", "field": "w", "src":
+          pql.parse(f"Intersect({seg}, Row(w=4))").calls[0], "n": 3,
+          "threshold": 1, "row_ids": None}, shards),
     ]
     return widgets[:n]
 
@@ -1399,35 +1406,50 @@ def _dash_entries(pql, n, shards):
 def _dash_oracle(eng, entries):
     """The retained sequential per-query path: one blocking dispatch +
     readback per widget — exactly what the serving tier paid pre-fusion."""
+    return _dash_oracle_x(eng, [("dash", sp, sh) for sp, sh in entries])
+
+
+def _dash_oracle_x(eng, triples):
+    """Sequential oracle over (index, spec, shards) triples — the
+    cross-index drain's per-item comparison path."""
     out = []
-    for spec, shards in entries:
+    for index, spec, shards in triples:
         k = spec["kind"]
         if k == "count":
-            out.append(eng.count("dash", spec["call"], shards))
+            out.append(eng.count(index, spec["call"], shards))
         elif k == "sum":
-            out.append(eng.sum("dash", spec["field"], spec.get("filter"), shards))
+            out.append(eng.sum(index, spec["field"], spec.get("filter"), shards))
         elif k in ("min", "max"):
-            out.append(eng.min_max("dash", spec["field"], spec.get("filter"),
+            out.append(eng.min_max(index, spec["field"], spec.get("filter"),
                                    shards, k == "min"))
         elif k == "topn":
-            out.append(eng.topn_scores("dash", spec["field"], spec["rows"],
+            out.append(eng.topn_scores(index, spec["field"], spec["rows"],
                                        spec["src"], shards))
+        elif k == "group":
+            out.append(eng.group_counts(index, spec["fields"], spec["rows"],
+                                        spec.get("filter"), shards))
         else:
-            out.append(eng.topn_full("dash", spec["field"], spec["src"],
+            out.append(eng.topn_full(index, spec["field"], spec["src"],
                                      shards, spec["n"], spec["threshold"]))
     return out
 
 
 def dashboard_sweep():
     """Whole-program fusion sweep (docs/fusion.md): dashboard-shaped
-    drains — 1 segment filter x N in {2, 4, 8} widgets of mixed
-    Count/Sum/Min/Max/TopN — timed as ONE fused device program vs the
-    unfused sequential per-query path on the same data.  Emits
+    drains — 1 segment filter x N in {2, 4, 8, 10} widgets of mixed
+    Count/Sum/Min/Max/TopN/GroupBy — timed as ONE fused device program
+    vs the unfused sequential per-query path on the same data.  Emits
     ``dashboard_fused_qps`` / ``dashboard_p50_ms`` (N=8 headlines,
     bench_guard AUTO_REQUIREd once baselined), the per-N curve, the
     measured speedup (ABS_FLOORed at 1.5x in bench_guard), and
     ``fused_masks_saved_total``; asserts — via plan records — that the
-    fused N=8 drain evaluated each shared mask exactly once."""
+    fused N=8 drain evaluated each shared mask exactly once.  PR 18
+    lanes: the TopN slab (``topn_device_p50`` / ``topn_e2e_p50`` /
+    ``topn_device_speedup``, device trim vs the in-run host rank/merge
+    oracle, ABS_FLOORed at 2x) and the cross-index drain
+    (``dashboard_crossindex_p50_ms`` /
+    ``dashboard_crossindex_fused_speedup``, one program spanning two
+    indexes)."""
     progress("importing jax (dashboard sweep)")
     import threading as _threading
 
@@ -1436,6 +1458,7 @@ def dashboard_sweep():
     from pilosa_tpu import pql
     from pilosa_tpu.core.field import FieldOptions
     from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
     from pilosa_tpu.ops import bitops
     from pilosa_tpu.parallel import MeshEngine, make_mesh
     from pilosa_tpu.parallel import fusion
@@ -1461,9 +1484,15 @@ def dashboard_sweep():
         wf = w_view.fragment_if_not_exists(s)
         for r in range(1, 5):
             wf.load_row_words(r, __rand(rng, bitops.WORDS64))
-    for frag in list(seg_view.fragments.values()) + list(
-        w_view.fragments.values()
-    ):
+    g_f = idx.create_field("g")
+    g_view = g_f.view_if_not_exists("standard")
+    for s in shards:
+        gf = g_view.fragment_if_not_exists(s)
+        for r in range(4):
+            gf.load_row_words(r, __rand(rng, bitops.WORDS64))
+    for frag in (list(seg_view.fragments.values())
+                 + list(w_view.fragments.values())
+                 + list(g_view.fragments.values())):
         frag.cache.invalidate()
     cols = rng.choice(DASH_SHARDS << 20, size=30_000, replace=False)
     v_f.import_values(
@@ -1484,6 +1513,8 @@ def dashboard_sweep():
         for k, (g, w) in enumerate(zip(got, want)):
             if isinstance(w, tuple) and len(w) == 3:
                 assert np.array_equal(g[0], w[0]), f"widget {k} diverged"
+            elif isinstance(w, np.ndarray):
+                assert np.array_equal(np.asarray(g), w), f"widget {k} diverged"
             else:
                 assert g == w, f"widget {k} diverged: {g!r} != {w!r}"
         e0, r0 = eng.fused_masks_evaluated, eng.fused_masks_referenced
@@ -1520,6 +1551,128 @@ def dashboard_sweep():
              t_seq_8 / t_fused_8)
     emit_raw("dashboard_fused_speedup", t_seq_8 / t_fused_8, "x",
              t_seq_8 / t_fused_8)
+
+    # ---- the TopN slab lane: device trim vs the host rank/merge oracle
+    # Field `t`: 128 rows of strictly graded density (cache-count order
+    # == score order, so per-shard qualifying sets stay ~n and the slab
+    # accepts instead of overflow-declining); src row dense across the
+    # shard.  The host walk (the retained oracle) re-ranks all 128
+    # candidates in python per shard; the slab merges k_out pairs.
+    topn_idx = holder.create_index("topn")
+    t_f = topn_idx.create_field("t")
+    s_f = topn_idx.create_field("srcf")
+    t_view = t_f.view_if_not_exists("standard")
+    s_view = s_f.view_if_not_exists("standard")
+    for s in shards:
+        tf = t_view.fragment_if_not_exists(s)
+        for r in range(128):
+            wr = 2048 - 15 * r
+            words = np.zeros(bitops.WORDS64, dtype=np.uint64)
+            words[:wr] = __rand(rng, wr)
+            tf.load_row_words(r, words)
+        tf.cache.invalidate()
+        sf = s_view.fragment_if_not_exists(s)
+        sf.load_row_words(0, __rand(rng, bitops.WORDS64))
+        sf.cache.invalidate()
+    ex = Executor(holder, mesh_engine=eng)
+    topn_call = pql.parse("TopN(t, Row(srcf=0), n=5)").calls[0]
+
+    class _Opt:
+        remote = False
+
+    opt = _Opt()
+    got_dev = ex._mesh_topn_shards("topn", topn_call, shards, opt)
+    eng.topn_slab_enabled = False
+    got_host = ex._mesh_topn_shards("topn", topn_call, shards, opt)
+    eng.topn_slab_enabled = True
+    assert got_dev[1] == got_host[1], "slab diverged from the host walk"
+    assert eng.topn_device_full(
+        "topn", "t", topn_call.children[0], shards, 5, 1
+    ) is not None, "slab lane declined the bench workload"
+    t_slab, _ = sync_p50(
+        lambda i: eng.topn_device_full(
+            "topn", "t", topn_call.children[0], shards, 5, 1),
+        reps=DASH_REPS)
+    t_e2e, _ = sync_p50(
+        lambda i: ex._mesh_topn_shards("topn", topn_call, shards, opt),
+        reps=DASH_REPS)
+    eng.topn_slab_enabled = False
+    t_host, _ = sync_p50(
+        lambda i: ex._mesh_topn_shards("topn", topn_call, shards, opt),
+        reps=max(6, DASH_REPS // 2))
+    eng.topn_slab_enabled = True
+    emit_raw("topn_device_p50", t_slab * 1e3, "ms", t_host / t_slab)
+    emit_raw("topn_e2e_p50", t_e2e * 1e3, "ms", t_host / t_e2e)
+    emit_raw("topn_device_speedup", t_host / t_e2e, "x", t_host / t_e2e)
+    progress(
+        f"topn slab: device {t_slab * 1e3:.2f}ms e2e {t_e2e * 1e3:.2f}ms "
+        f"vs host merge {t_host * 1e3:.2f}ms ({t_host / t_e2e:.2f}x)"
+    )
+
+    # ---- cross-index drains: one device program spans indexes --------
+    # A second dashboard index with its own segment/widget/BSI fields;
+    # the drain interleaves items from both.  Pre-PR-18 this was two
+    # programs (one per index) — the speedup is vs the sequential
+    # per-item path, same discipline as the single-index sweep.
+    idx2 = holder.create_index("dash2")
+    seg2_f = idx2.create_field("seg")
+    w2_f = idx2.create_field("w")
+    v2_f = idx2.create_field("v", FieldOptions(type="int", min=0, max=100))
+    seg2_view = seg2_f.view_if_not_exists("standard")
+    w2_view = w2_f.view_if_not_exists("standard")
+    for s in shards:
+        sf2 = seg2_view.fragment_if_not_exists(s)
+        for r in range(4):
+            sf2.load_row_words(
+                r, __rand(rng, bitops.WORDS64) | __rand(rng, bitops.WORDS64)
+            )
+        wf2 = w2_view.fragment_if_not_exists(s)
+        for r in range(1, 5):
+            wf2.load_row_words(r, __rand(rng, bitops.WORDS64))
+    for frag in (list(seg2_view.fragments.values())
+                 + list(w2_view.fragments.values())):
+        frag.cache.invalidate()
+    cols2 = rng.choice(DASH_SHARDS << 20, size=30_000, replace=False)
+    v2_f.import_values(
+        [int(c) for c in cols2], [int(c % 100) for c in range(len(cols2))]
+    )
+    seg = "Intersect(Row(seg=0), Row(seg=1), Row(seg=2), Row(seg=3))"
+    segc = lambda: pql.parse(seg).calls[0]  # noqa: E731
+    entries_x = [
+        ("dash", {"kind": "count",
+                  "call": pql.parse(f"Intersect({seg}, Row(w=1))").calls[0]},
+         shards),
+        ("dash2", {"kind": "count",
+                   "call": pql.parse(f"Intersect({seg}, Row(w=1))").calls[0]},
+         shards),
+        ("dash", {"kind": "topnf", "field": "w", "src": segc(), "n": 5,
+                  "threshold": 1, "row_ids": None}, shards),
+        ("dash2", {"kind": "sum", "field": "v", "filter": segc()}, shards),
+        ("dash", {"kind": "group", "fields": ["g"], "rows": [[0, 1, 2, 3]],
+                  "filter": segc()}, shards),
+        ("dash2", {"kind": "topnf", "field": "w", "src": segc(), "n": 5,
+                   "threshold": 1, "row_ids": None}, shards),
+    ]
+    want_x = _dash_oracle_x(eng, entries_x)
+    got_x = eng.fused_drain(entries_x)
+    for k, (g, w) in enumerate(zip(got_x, want_x)):
+        if isinstance(w, np.ndarray):
+            assert np.array_equal(np.asarray(g), w), f"x-item {k} diverged"
+        else:
+            assert g == w, f"x-item {k} diverged: {g!r} != {w!r}"
+    p0 = eng.fused_programs
+    eng.fused_drain(entries_x)
+    assert eng.fused_programs == p0 + 1, "cross-index drain split programs"
+    t_xf, _ = sync_p50(lambda i: eng.fused_drain(entries_x), reps=DASH_REPS)
+    t_xs, _ = sync_p50(lambda i: _dash_oracle_x(eng, entries_x),
+                       reps=max(6, DASH_REPS // 2))
+    emit_raw("dashboard_crossindex_p50_ms", t_xf * 1e3, "ms", t_xs / t_xf)
+    emit_raw("dashboard_crossindex_fused_speedup", t_xs / t_xf, "x",
+             t_xs / t_xf)
+    progress(
+        f"cross-index: fused {t_xf * 1e3:.2f}ms vs sequential "
+        f"{t_xs * 1e3:.2f}ms ({t_xs / t_xf:.2f}x), one program per drain"
+    )
 
     # Acceptance, via plan records: drive the N=8 drain through the
     # REAL batcher and assert the recorded plan ops show every shared
@@ -3371,12 +3524,16 @@ if __name__ == "__main__":
         "--dashboard-sweep",
         action="store_true",
         help="run the whole-program fusion sweep ONLY: dashboard-shaped "
-        "drains (1 segment filter x N in {2,4,8} widgets of mixed "
-        "Count/Sum/Min/Max/TopN) as ONE fused device program vs the "
-        "sequential per-query path, emitting dashboard_fused_qps / "
+        "drains (1 segment filter x N in {2,4,8,10} widgets of mixed "
+        "Count/Sum/Min/Max/TopN/GroupBy) as ONE fused device program vs "
+        "the sequential per-query path, emitting dashboard_fused_qps / "
         "dashboard_p50_ms / dashboard_fused_speedup / "
-        "fused_masks_saved_total and asserting via plan records that "
-        "each shared mask evaluated once (docs/fusion.md)",
+        "fused_masks_saved_total plus the PR 18 lanes — topn_device_p50 "
+        "/ topn_e2e_p50 / topn_device_speedup (device slab vs host "
+        "rank/merge) and dashboard_crossindex_p50_ms / "
+        "dashboard_crossindex_fused_speedup (one program spanning two "
+        "indexes) — and asserting via plan records that each shared "
+        "mask evaluated once (docs/fusion.md)",
     )
     ap.add_argument(
         "--conn-sweep",

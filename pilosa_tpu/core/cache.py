@@ -362,6 +362,36 @@ class RankCache:
         self._top_cache = (rank, lst)
         return lst
 
+    def rank_columns(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The standing rankings as (ids, counts) int64 columns in
+        (count desc, id desc) order — ONE atomic snapshot (self._rank is
+        swapped whole by recalculate), zero per-pair Python.  This is
+        the array-native feed for the device TopN slab's candidate
+        build: np ops consume the columns directly instead of looping
+        top()'s pair list."""
+        rk_ids, rk_cnts = self._rank
+        return rk_ids, rk_cnts
+
+    def counts_for(self, ids: np.ndarray) -> np.ndarray:
+        """Vectorized entry-store lookup: int64 counts for an id array,
+        0 for ids not in the store.  searchsorted over the id-ascending
+        entry columns plus the O(overlay) _extra pass — the bulk twin of
+        per-id dict probing for the TopN candidate matrices."""
+        ids = np.asarray(ids, dtype=np.int64)
+        eids, ecnts = self._ids, self._counts
+        out = np.zeros(ids.size, dtype=np.int64)
+        if eids.size:
+            pos = np.searchsorted(eids, ids)
+            inb = pos < eids.size
+            hit = np.zeros(ids.size, dtype=bool)
+            hit[inb] = eids[pos[inb]] == ids[inb]
+            out[hit] = ecnts[pos[hit]]
+        for k, v in self._extra.items():
+            m = ids == k
+            if m.any():
+                out[m] = v
+        return out
+
 
 class LRUCache:
     """Recency-evicting row-count cache."""

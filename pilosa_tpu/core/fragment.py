@@ -915,6 +915,19 @@ class Fragment:
     def row_count(self, row_id: int) -> int:
         return self._store.count(row_id)
 
+    def counts_for(self, row_ids) -> np.ndarray:
+        """Bulk row_count: int64 STORE counts for an id sequence (0 for
+        absent rows).  One fused pass over the store's count dict — the
+        TopN candidate-matrix build calls this once per shard instead of
+        K times (ranked-cache counts are NOT a substitute here: the
+        cache legally holds stale counts for updates below its admission
+        threshold)."""
+        get = self._store.counts.get
+        n = len(row_ids)
+        return np.fromiter(
+            (get(int(r), 0) for r in row_ids), dtype=np.int64, count=n
+        )
+
     def row_ids(self) -> List[int]:
         return self._store.row_ids()
 

@@ -1105,6 +1105,10 @@ class Executor:
 
         result = None
         me = self.cluster.node.id
+        # Encode once: the remote fan-out ships the SAME query text to
+        # every peer, and str(call) re-serializes the whole tree — O(tree)
+        # per node adds up on wide clusters.
+        call_text = str(call)
         for node_id, (node, node_shards, is_primary) in sorted(
             by_node.items()
         ):
@@ -1125,7 +1129,7 @@ class Executor:
                     "executor.RemoteQuery", node=node_id, shards=len(node_shards)
                 ):
                     doc = self.cluster.client(node).query(
-                        index, str(call), shards=node_shards, remote=True
+                        index, call_text, shards=node_shards, remote=True
                     )
                 p = plans_mod.current_plan()
                 if p is not None:
@@ -1893,7 +1897,9 @@ class Executor:
             return pairs
 
         # Phase 2: refetch exact counts for the merged candidate ids
-        # (executor.go :715-733).
+        # (executor.go :715-733).  merge_pairs already deduped the ids
+        # across shards, so this is one sorted encode — and the fan-out
+        # mapper serializes the refetch call ONCE for all peers.
         other = c.clone()
         other.args["ids"] = sorted(r for r, _ in pairs)
         trimmed = self._execute_topn_shards(index, other, shards, opt)
@@ -2042,6 +2048,43 @@ class Executor:
         if min_threshold <= 0:
             min_threshold = DEFAULT_MIN_THRESHOLD
 
+        # Device slab fast path: the per-shard candidate walk
+        # (threshold gates + top-k) runs INSIDE the sharded program and
+        # each shard returns a fixed-width slab, so the host merge is
+        # bounded by k_out * |shards| pairs instead of the full
+        # candidate union.  Declines (None) — attribute/Tanimoto
+        # filters need host metadata, ids= bypasses the cache walk,
+        # slab overflow needs the exact walk — fall through to the
+        # host-walk body below, which is retained verbatim as the
+        # differential oracle.
+        if (
+            not row_ids
+            and not attr_name
+            and not attr_values
+            and tanimoto == 0
+            and n > 0
+            and getattr(self.mesh_engine, "topn_slab_enabled", False)
+        ):
+            seq = frag_mod.WRITE_SEQ.v  # before derived state
+            try:
+                out = self._sflight.do(
+                    ("topn_slab", seq, index, str(c), tuple(sorted(shards))),
+                    lambda: self.mesh_engine.topn_device_full(
+                        index, field_name, c.children[0], shards,
+                        int(n), min_threshold,
+                    ),
+                )
+            except (ValueError, PeerlessMeshError):
+                plans_mod.take_dispatch_note()
+                out = None
+            if out is not None:
+                p = plans_mod.current_plan()
+                if p is not None:
+                    p.note_op(op="TopN", path="device_slab",
+                              topkDevice=int(n))
+                # Copy: waiters share the flight's list.
+                return set(shards), list(out)
+
         frags = {}
         cand_set = set()
         for s in shards:
@@ -2058,6 +2101,10 @@ class Executor:
         if not frags:
             return set(shards), []
         candidates = sorted(cand_set)
+        p = plans_mod.current_plan()
+        if p is not None:
+            p.note_op(op="TopN", path="host_merge",
+                      candidates=len(candidates))
         try:
             scored = self.mesh_engine.batched_topn_scores(
                 index, field_name, candidates, c.children[0], shards
@@ -2281,7 +2328,12 @@ class Executor:
                     # versioned by WRITE_SEQ, so they need not (and must
                     # not — O(total rows) hashing per query) join the key.
                     ("groupby", seq, index, str(c), tuple(sorted(shards))),
-                    lambda: self.mesh_engine.group_counts(
+                    # Through the batcher: a GroupBy arriving alongside
+                    # a dashboard drain rides the SAME fused program as
+                    # its drain-mates (a "group" edge); lone callers
+                    # take the batcher's idle direct path (solo_op →
+                    # group_counts) unchanged.
+                    lambda: self.mesh_engine.batched_group_counts(
                         index, fields, row_lists, filter_call, shards
                     ),
                 )

@@ -509,7 +509,10 @@ class CountBatcher:
         plus every Count that SHARES a Row subtree with one (the
         dashboard shape: one segment filter fanned into N widgets),
         becomes ONE fused group lowered to a single device program that
-        materializes each distinct mask once.  A fused group of one
+        materializes each distinct mask once.  Fused-eligible items
+        from DIFFERENT indexes pool into the same group — the planner
+        keys mask slots and stacks per index, so a dashboard spanning
+        indexes still compiles to ONE program.  A fused group of one
         falls back to the op's existing solo program — no 1-item fused
         executables minted."""
         groups = []
@@ -517,10 +520,12 @@ class CountBatcher:
         for it in batch:
             by_index.setdefault(it.index, []).append(it)
         eng = self.engine
+        cross_index = getattr(eng, "fused_drain_async", None) is not None
         fusion_ok = (
-            getattr(eng, "fused_many_async", None) is not None
-            and not getattr(eng, "multiproc", False)
-        )
+            cross_index
+            or getattr(eng, "fused_many_async", None) is not None
+        ) and not getattr(eng, "multiproc", False)
+        fused_all: list = []
         for index, items in by_index.items():
             aggs = [it for it in items if it.kind != "count"]
             counts = [it for it in items if it.kind == "count"]
@@ -538,7 +543,9 @@ class CountBatcher:
                     else:
                         rest.append(it)
                 counts = rest
-                if len(fused_items) == 1:
+                if cross_index:
+                    fused_all.extend(fused_items)
+                elif len(fused_items) == 1:
                     groups.append(("solo", index, fused_items))
                 else:
                     groups.append(("fused", index, fused_items))
@@ -554,6 +561,12 @@ class CountBatcher:
                 ).append(it)
             for _sig, its in by_sig.items():
                 groups.append(("count", index, its))
+        if fused_all:
+            if len(fused_all) == 1:
+                groups.append(("solo", fused_all[0].index, fused_all))
+            else:
+                # index=None: the entries carry their own index each.
+                groups.append(("fused", None, fused_all))
         return groups
 
     # -- lower+dispatch stage -----------------------------------------------
@@ -610,16 +623,24 @@ class CountBatcher:
                         [it.shards for it in items],
                     )
                 elif gkind == "fused":
-                    entries = [
-                        (
-                            it.spec
-                            if it.spec is not None
-                            else {"kind": "count", "call": it.call},
-                            it.shards,
-                        )
+                    specs = [
+                        it.spec
+                        if it.spec is not None
+                        else {"kind": "count", "call": it.call}
                         for it in items
                     ]
-                    fd = self.engine.fused_many_async(index, entries)
+                    drain = getattr(self.engine, "fused_drain_async", None)
+                    if drain is not None:
+                        fd = drain([
+                            (it.index, sp, it.shards)
+                            for it, sp in zip(items, specs)
+                        ])
+                    else:
+                        fd = self.engine.fused_many_async(
+                            index,
+                            [(sp, it.shards)
+                             for it, sp in zip(items, specs)],
+                        )
                     dev = fd.dev
                     live_items, decoders, weights = [], [], []
                     for i, it in enumerate(items):
@@ -635,7 +656,7 @@ class CountBatcher:
                 else:  # solo: one aggregate on its existing per-op program
                     it0 = items[0]
                     dev, dec = self.engine.solo_op_async(
-                        index, it0.kind, it0.spec, it0.shards
+                        it0.index, it0.kind, it0.spec, it0.shards
                     )
                     decoders = [dec]
                 t1 = time.monotonic()
@@ -789,6 +810,7 @@ class CountBatcher:
                 # op's existing lane — never mint a 1-item fused
                 # executable (_plan_drain's invariant holds on retry).
                 gkind = "count" if good[0].kind == "count" else "solo"
+                index = good[0].index  # pooled groups carry index=None
             self._dispatch_q.put((gkind, index, good, True))
         else:
             # Nothing attributable (a dispatch-level failure): fail the

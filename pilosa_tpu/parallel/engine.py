@@ -60,6 +60,7 @@ from ..util.stats import (
     METRIC_ENGINE_COMPILE_SECONDS,
     METRIC_ENGINE_EVICTED_BYTES,
     METRIC_ENGINE_EVICTIONS,
+    METRIC_ENGINE_FUSED_EDGES,
     METRIC_ENGINE_FUSED_MASKS_EVAL,
     METRIC_ENGINE_FUSED_MASKS_REF,
     METRIC_ENGINE_FUSED_PROGRAMS,
@@ -269,6 +270,14 @@ class _Lowering:
     def __init__(self, engine, canonical: List[int], slot_vector: bool = False):
         self.engine = engine
         self.canonical = canonical
+        # Cross-index drains (fusion.build): when set, a shared dict of
+        # {index: canonical shard list} consulted per stack fetch — one
+        # _Lowering then spans every index of the drain, with each
+        # operand shaped to ITS index's shard axis.  None (the default)
+        # keeps the single-index behavior: ``canonical`` applies to
+        # every index this lowering touches.
+        self.canonical_map: Optional[dict] = None
+        self.current_index: Optional[str] = None
         self.operands: list = []
         self.specs: list = []
         self._mat_ids: Dict[int, int] = {}
@@ -308,6 +317,20 @@ class _Lowering:
                 P(),
             )
 
+    def canonical_for(self, index) -> List[int]:
+        """The canonical shard list for ``index`` — per-index in
+        cross-index mode (lazily resolved into the shared map so every
+        entry of a drain sees one consistent snapshot), else the single
+        canonical this lowering was built with."""
+        if self.canonical_map is None:
+            return self.canonical
+        c = self.canonical_map.get(index)
+        if c is None:
+            c = self.canonical_map[index] = self.engine.canonical_shards(
+                index
+            )
+        return c
+
     def stack_for(self, index, field, view):
         """ONE field_stack call per (index, field, view) per query.
         A second fetch could re-run the incremental sync (a concurrent
@@ -318,7 +341,7 @@ class _Lowering:
         key = (index, field, view)
         if key not in self._stacks:
             self._stacks[key] = self.engine.field_stack(
-                index, field, view, self.canonical,
+                index, field, view, self.canonical_for(index),
                 rows_hint=self.row_hints.get(key),
             )
         return self._stacks[key]
@@ -865,6 +888,29 @@ class MeshEngine:
             REGISTRY.counter(METRIC_ENGINE_FUSED_MASKS_EVAL),
             REGISTRY.counter(METRIC_ENGINE_FUSED_MASKS_REF),
         )
+        # Per-kind fused-edge counters (lazy handle per kind seen):
+        # pilosa_engine_fused_program_edges_total{kind=...} — how much
+        # fused traffic is counts vs device-trim TopN vs GroupBy.
+        self._fused_edge_counters: Dict[str, object] = {}
+        # Device-resident TopN trim for the fused lane: topnf edges run
+        # gate + exact totals + top_k on device (kernels.fused_tree).
+        # False routes through the retained host gate+trim oracle
+        # (fusion._TopNFullDecode) — the differential tests and bench
+        # flip this to compare bit-exactly.
+        self.topn_device_trim = (
+            os.environ.get("PILOSA_TOPN_DEVICE", "1") != "0"
+        )
+        # Device TopN slab lane (executor._mesh_topn_shards): per-shard
+        # threshold-prune + top-k on device, host merges O(K·shards)
+        # pairs.  False forces the exact host walk (the oracle).
+        self.topn_slab_enabled = (
+            os.environ.get("PILOSA_TOPN_SLAB", "1") != "0"
+        )
+        # (index, field) -> (stack token, slab candidate entry): the
+        # ranked-cache-fed candidate build for the slab lane, rebuilt
+        # when the field stack's token changes (same discipline as
+        # _topn_cands).
+        self._topn_slab_cands: Dict[Tuple[str, str], tuple] = {}
         # Fused-plan cache: dashboards REPEAT, so a drain's whole plan
         # (lowering, slot graph, operands, decoders) is keyed on its
         # canonical entry keys and re-dispatched without re-planning;
@@ -2097,7 +2143,8 @@ class MeshEngine:
         return ("or",) + tuple(leaves)
 
     def _lower_zero(self, lw: _Lowering):
-        return ("zero", lw.add_matrix(self._zero_stack(lw.canonical)))
+        canon = lw.canonical_for(lw.current_index)
+        return ("zero", lw.add_matrix(self._zero_stack(canon)))
 
     def _lower_row(self, index, field, row_id, lw: _Lowering):
         # A missing FIELD is an error (the host path raises
@@ -3028,33 +3075,27 @@ class MeshEngine:
     # -- whole-program fusion (docs/fusion.md) ------------------------------
 
     def fused_many_async(self, index: str, entries):
+        """Back-compat single-index form of fused_drain_async:
+        ``entries`` is a list of (spec, shards) pairs, all of one
+        index."""
+        return self.fused_drain_async(
+            [(index, spec, shards) for spec, shards in entries]
+        )
+
+    def fused_drain_async(self, entries):
         """Plan + dispatch a heterogeneous drain — mixed Count/Sum/Min/
-        Max/TopN items that may SHARE Row subtrees — as ONE device
-        program (fusion.build / kernels.fused_tree).  ``entries`` is a
-        list of (spec, shards) where spec carries {"kind": ...} plus the
-        op's arguments; returns a fusion.FusedDispatch whose decoders
-        turn the fetched host result into each op's standard shape.
-        Single-process only: the fused program has no peer-replay
-        collective, so multi-process meshes keep the per-op paths."""
+        Max/TopN/GroupBy items that may SHARE Row subtrees and may SPAN
+        indexes — as ONE device program (fusion.build /
+        kernels.fused_tree).  ``entries`` is a list of
+        (index, spec, shards) triples where spec carries {"kind": ...}
+        plus the op's arguments; returns a fusion.FusedDispatch whose
+        decoders turn the fetched host result into each op's standard
+        shape.  Single-process only: the fused program has no
+        peer-replay collective, so multi-process meshes keep the per-op
+        paths."""
         if self.multiproc:
             raise ValueError(
                 "fused whole-program dispatch requires a single-process mesh"
-            )
-        canonical = self.canonical_shards(index)
-        if not canonical:
-            decoders = []
-            for spec, _ in entries:
-                k = spec["kind"]
-                empty = (
-                    0 if k == "count"
-                    else (0, 0) if k in ("sum", "min", "max")
-                    else None if k == "topn"
-                    else []
-                )
-                decoders.append(fusion_mod._Const(empty))
-            n = len(entries)
-            return fusion_mod.FusedDispatch(
-                ((), ()), decoders, [1.0] * n, [None] * n, [None] * n
             )
         entries = list(entries)
         # Canonical order BEFORE keying/building: concurrent arrivals of
@@ -3070,13 +3111,18 @@ class MeshEngine:
         except Exception:  # noqa: BLE001 — unkeyable spec: build as-is
             keys, order = None, list(range(n))
         sorted_entries = [entries[i] for i in order]
+        # The device-trim toggle changes the topnf edge shape, so it
+        # must re-key cached plans (tests flip it mid-session).
         cache_key = (
             None if keys is None
-            else (index, tuple(keys[i] for i in order))
+            else (
+                bool(self.topn_device_trim),
+                tuple(keys[i] for i in order),
+            )
         )
 
         def locked():
-            plan = self._fused_plan_for(index, sorted_entries, cache_key)
+            plan = self._fused_plan_for(sorted_entries, cache_key)
             fd = fusion_mod.dispatch(self, plan)
             if order == list(range(n)):
                 return fd
@@ -3097,18 +3143,18 @@ class MeshEngine:
 
     FUSED_PLAN_CACHE = 256
 
-    def _fused_plan_for(self, index: str, entries, key):
+    def _fused_plan_for(self, entries, key):
         """A validated (possibly cached) fusion.FusedPlan for this exact
         (pre-sorted) drain shape.  Runs under the dispatch lock."""
         if key is None:
-            return fusion_mod.build(self, index, entries)
+            return fusion_mod.build(self, entries)
         plan = self._fused_plans.get(key)
         if plan is not None and self._fused_plan_valid(plan):
             self._cache_hit("fused_plan")
             self._fused_plans.move_to_end(key)
             return plan
         self._cache_miss("fused_plan")
-        plan = fusion_mod.build(self, index, entries)
+        plan = fusion_mod.build(self, entries)
         # Near the residency budget, fetching a later stack can evict an
         # earlier one of THIS build — the _evict() purge runs before the
         # plan exists, so inserting it would pin evicted HBM for the
@@ -3126,27 +3172,48 @@ class MeshEngine:
         return plan
 
     def _fused_plan_valid(self, plan) -> bool:
-        """True when every reuse gate holds: same canonical shard axis,
-        every referenced stack present/absent as before with the same
-        version token.  field_stack() is consulted (not peeked) so a
-        stale stack syncs FIRST — its token then mismatches and the
-        plan rebuilds over the fresh matrices; the cached operands that
-        referenced donated buffers are discarded without being used."""
-        if self.canonical_shards(plan.index) != plan.canonical:
-            return False
+        """True when every reuse gate holds: each index's canonical
+        shard axis, every referenced stack present/absent as before
+        with the same version token.  field_stack() is consulted (not
+        peeked) so a stale stack syncs FIRST — its token then
+        mismatches and the plan rebuilds over the fresh matrices; the
+        cached operands that referenced donated buffers are discarded
+        without being used."""
+        for idx, canon in plan.canonical.items():
+            if self.canonical_shards(idx) != canon:
+                return False
         for (idx, field, view), (absent, tok) in plan.stack_tokens.items():
-            st = self.field_stack(idx, field, view, plan.canonical)
+            st = self.field_stack(
+                idx, field, view, plan.canonical.get(idx)
+            )
             if (st is None) != absent:
                 return False
             if st is not None and st.versions != tok:
                 return False
         return True
 
+    def _fused_edge_counter(self, kind: str):
+        """Lazy labeled counter handle for one fused-edge kind."""
+        c = self._fused_edge_counters.get(kind)
+        if c is None:
+            c = self._fused_edge_counters[kind] = REGISTRY.counter(
+                METRIC_ENGINE_FUSED_EDGES, kind=kind
+            )
+        return c
+
     def fused_many(self, index: str, entries):
         """Synchronous fused drain: dispatch + one readback, results in
         entry order (the differential-test / bench convenience)."""
+        return self.fused_drain(
+            [(index, spec, shards) for spec, shards in entries]
+        )
+
+    def fused_drain(self, entries):
+        """Synchronous cross-index drain over (index, spec, shards)
+        triples — the test/bench convenience twin of
+        fused_drain_async."""
         try:
-            fd = self.fused_many_async(index, entries)
+            fd = self.fused_drain_async(entries)
         finally:
             # The async form leaves the dispatch note for its driver
             # (the batcher) to claim; HERE the caller is the driver and
@@ -3212,6 +3279,14 @@ class MeshEngine:
             return out, lambda host: fusion_mod.decode_topn_full(
                 host, cands, n_out
             )
+        if kind == "group":
+            dev = self.group_counts_async(
+                index, spec["fields"], spec["rows"], spec.get("filter"),
+                shards,
+            )
+            if dev is None:
+                return None, fusion_mod._Const(fusion_mod.DECLINED)
+            return dev, lambda host: np.asarray(host)
         raise ValueError(f"unknown solo op kind: {kind!r}")
 
     def solo_op(self, index: str, kind: str, spec: dict, shards):
@@ -3235,6 +3310,12 @@ class MeshEngine:
                 spec.get("row_ids"),
             )
             return fusion_mod.DECLINED if out is None else out
+        if kind == "group":
+            out = self.group_counts(
+                index, spec["fields"], spec["rows"], spec.get("filter"),
+                shards,
+            )
+            return fusion_mod.DECLINED if out is None else out
         raise ValueError(f"unknown solo op kind: {kind!r}")
 
     def probe_fused_item(self, index: str, spec: dict, shards):
@@ -3245,7 +3326,7 @@ class MeshEngine:
         kind = spec["kind"]
         if kind == "count":
             trees = [spec["call"]]
-        elif kind in ("sum", "min", "max"):
+        elif kind in ("sum", "min", "max", "group"):
             trees = [spec["filter"]] if spec.get("filter") is not None else []
         else:
             trees = [spec["src"]]
@@ -3301,6 +3382,23 @@ class MeshEngine:
             {"kind": "topnf", "field": field, "src": src_call, "n": int(n),
              "threshold": int(min_threshold),
              "row_ids": None if not row_ids else list(row_ids)},
+            shards,
+        )
+        return None if out is fusion_mod.DECLINED else out
+
+    def batched_group_counts(self, index: str, fields, row_lists,
+                             filter_call, shards):
+        """GroupBy combo counts through the batcher; returns the counts
+        ndarray, or None when the fused path declines (combo blowup or
+        missing stack) — the caller falls back to the host path."""
+        if self.multiproc:
+            return self.group_counts(
+                index, fields, row_lists, filter_call, shards
+            )
+        out = self.batcher().submit_op(
+            index, "group",
+            {"kind": "group", "fields": list(fields),
+             "rows": [list(r) for r in row_lists], "filter": filter_call},
             shards,
         )
         return None if out is fusion_mod.DECLINED else out
@@ -3791,12 +3889,16 @@ class MeshEngine:
         K = len(cands)
         K_pad = max(8, 1 << (K - 1).bit_length()) if K else 8
         host_cnt = np.zeros((S, K_pad), dtype=np.int32)
-        for si, s in enumerate(stack.shards):
-            frag = self.holder.fragment(index, field, _STD, s)
-            if frag is None:
-                continue
-            for ki, r in enumerate(cands):
-                host_cnt[si, ki] = frag.row_count(r)
+        if K:
+            # Vectorized per-shard fill: one searchsorted sweep over the
+            # store's id-ascending columns (fragment.counts_for) instead
+            # of K dict probes per shard.
+            cand_arr = np.asarray(cands, dtype=np.int64)
+            for si, s in enumerate(stack.shards):
+                frag = self.holder.fragment(index, field, _STD, s)
+                if frag is None:
+                    continue
+                host_cnt[si, :K] = frag.counts_for(cand_arr).astype(np.int32)
         idxs = tuple(stack.row_index.get(r, 0) for r in cands) + (0,) * (
             K_pad - K
         )
@@ -3831,16 +3933,165 @@ class MeshEngine:
         cached = self._topn_cands.get(key)
         if cached is not None and cached[0] == stack.versions:
             return cached[1]
-        cand_set = set()
+        cols = []
         for s in stack.shards:
             frag = self.holder.fragment(index, field, _STD, s)
-            if frag is not None:
-                cand_set.update(r for r, _ in frag.cache.top())
-        entry = self._build_topn_candidates(
-            index, field, stack, sorted(cand_set, reverse=True)
+            if frag is None:
+                continue
+            rank_columns = getattr(frag.cache, "rank_columns", None)
+            if rank_columns is not None:
+                cols.append(rank_columns()[0])
+            elif frag.cache.top():
+                cols.append(np.asarray(
+                    [r for r, _ in frag.cache.top()], dtype=np.int64
+                ))
+        cands = (
+            [int(r) for r in np.unique(np.concatenate(cols))[::-1]]
+            if cols else []
         )
+        entry = self._build_topn_candidates(index, field, stack, cands)
         self._topn_cands[key] = (stack.versions, entry)
         return entry
+
+    def _topn_slab_candidates(self, index, field, stack):
+        """Candidate arrays for the per-shard device slab walk
+        (kernels.topn_slab_tree).  Differs from _topn_candidates in ONE
+        load-bearing way: the count matrix holds CACHE counts with
+        cache MEMBERSHIP (0 when a row is absent from that shard's
+        ranked cache) rather than store counts — the host walk it
+        replaces (fragment.top) iterates only the cached pairs, and
+        cache counts go stale below the admission threshold, so store
+        counts would change which rows the threshold gate admits."""
+        from ..core.view import VIEW_STANDARD as _STD
+
+        key = (index, field)
+        cached = self._topn_slab_cands.get(key)
+        if cached is not None and cached[0] == stack.versions:
+            return cached[1]
+        S = stack.matrix.shape[1]
+        shard_cols = [None] * S
+        for si, s in enumerate(stack.shards):
+            frag = self.holder.fragment(index, field, _STD, s)
+            if frag is None:
+                continue
+            rank_columns = getattr(frag.cache, "rank_columns", None)
+            if rank_columns is not None:
+                ids, cnts = rank_columns()
+            else:
+                pairs = frag.cache.top()
+                ids = np.asarray([r for r, _ in pairs], dtype=np.int64)
+                cnts = np.asarray([c for _, c in pairs], dtype=np.int64)
+            if ids.size:
+                shard_cols[si] = (ids, cnts)
+        cols = [ids for c in shard_cols if c is not None for ids in (c[0],)]
+        cands = (
+            [int(r) for r in np.unique(np.concatenate(cols))[::-1]]
+            if cols else []
+        )
+        K = len(cands)
+        K_pad = max(8, 1 << (K - 1).bit_length()) if K else 8
+        host_cnt = np.zeros((S, K_pad), dtype=np.int32)
+        if K:
+            cand_arr = np.asarray(cands, dtype=np.int64)
+            for si, col in enumerate(shard_cols):
+                if col is None:
+                    continue
+                ids, cnts = col
+                order = np.argsort(ids)
+                sid, scnt = ids[order], cnts[order]
+                pos = np.searchsorted(sid, cand_arr)
+                inb = pos < sid.size
+                hit = np.zeros(K, dtype=bool)
+                hit[inb] = sid[pos[inb]] == cand_arr[inb]
+                host_cnt[si, :K][hit] = scnt[pos[hit]].astype(np.int32)
+        idxs = tuple(stack.row_index.get(r, 0) for r in cands) + (0,) * (
+            K_pad - K
+        )
+        if kernels.gather_free(idxs):
+            static_idxs, dyn_idxs = idxs, None
+        else:
+            static_idxs = None
+            dyn_idxs = put_global(
+                self.mesh, np.asarray(idxs, dtype=np.int32), P()
+            )
+        entry = _TopNCandidates(
+            cands,
+            static_idxs,
+            dyn_idxs,
+            put_global(self.mesh, host_cnt.T.copy(), P(None, SHARD_AXIS)),
+            host_cnt,
+        )
+        self._topn_slab_cands[key] = (stack.versions, entry)
+        return entry
+
+    def topn_device_full(self, index, field, src_call, shards, n,
+                         min_threshold):
+        """TopN phase 1 with the per-shard candidate walk ON DEVICE
+        (kernels.topn_slab_tree): threshold-prune + per-shard top-k run
+        in the sharded program and each shard ships back a fixed-width
+        sorted (value, index) slab, so the host merge touches at most
+        k_out * |shards| pairs instead of every candidate.  Returns the
+        merged (row_id, count) pairs across the requested shards —
+        bit-exact vs the fragment.top host walk (see topn_slab_tree's
+        equivalence proof) — or None when the lane declines: multiproc
+        mesh (no peer-replay collective), n == 0 (unbounded emit),
+        oversized candidate union, or any shard whose qualifying set
+        overflowed the k_out slab (qual > k_out → the host walk is the
+        exact path).  Callers treat None as 'run the host walk'."""
+        from ..core import cache as cache_mod
+
+        if self.multiproc or not n:
+            return None
+        stack = self.field_stack(index, field, VIEW_STANDARD)
+        if stack is None:
+            return []
+        self._require_full_stack(index, field, VIEW_STANDARD, stack)
+        entry = self._topn_slab_candidates(index, field, stack)
+        if not entry.cands:
+            return []
+        if len(entry.cands) > self.MAX_TOPN_CANDIDATES:
+            return None
+        K_pad = entry.host_cnt.shape[1]
+        # Slab width: 2n rounded up to a pow2 tier (compile-key bound,
+        # headroom for cross-shard merge collapse), capped at K_pad.
+        k_out = min(K_pad, fusion_mod._pow2(max(2 * int(n), 8)))
+        mask = self._mask_words(shards, stack.shards)
+        extra_ops = () if entry.idxs is not None else (entry.dyn_idxs,)
+        extra_specs = () if entry.idxs is not None else (P(),)
+
+        def dispatch():
+            lw = _Lowering(self, stack.shards)
+            prog = self._lower(index, src_call, lw)
+            self._note_fused_dispatch()
+            return kernels.topn_slab_tree(
+                self.mesh,
+                prog,
+                extra_specs + tuple(lw.specs),
+                int(n),
+                k_out,
+                entry.idxs,
+                mask,
+                stack.matrix,
+                entry.dev_cnt,
+                self._scalar(max(int(min_threshold), 1)),
+                *extra_ops,
+                *lw.operands,
+            )
+
+        vals, idx, qual = jax.device_get(self._locked_dispatch(dispatch))
+        per_shard = []
+        for s in shards:
+            si = stack.pos.get(s)
+            if si is None:
+                continue
+            if int(qual[si]) > k_out:
+                return None  # slab overflow: host walk is the exact path
+            per_shard.append([
+                (entry.cands[int(i)], int(v))
+                for v, i in zip(vals[si], idx[si])
+                if v > 0
+            ])
+        return cache_mod.merge_pairs(per_shard)
 
     def topn_full_async(
         self,
@@ -4155,6 +4406,7 @@ class MeshEngine:
                 self._bits.clear()
                 self._canonical.clear()
                 self._topn_cands.clear()
+                self._topn_slab_cands.clear()
                 self._fused_plans.clear()
                 memo_entries = len(self.result_memo)
                 self.result_memo.clear()

@@ -57,6 +57,7 @@ OP_NAMES = {
     "max": "Max",
     "topn": "TopN",
     "topnf": "TopN",
+    "group": "GroupBy",
 }
 
 
@@ -68,6 +69,11 @@ def op_signature(kind: str, spec: dict) -> str:
         return f"{kind}|{spec['field']}|{spec.get('filter')}"
     if kind == "topn":
         return f"topn|{spec['field']}|{spec['src']}|{list(spec.get('rows') or ())}"
+    if kind == "group":
+        return (
+            f"group|{list(spec.get('fields') or ())}|"
+            f"{[list(r) for r in spec.get('rows') or ()]}|{spec.get('filter')}"
+        )
     return (
         f"topnf|{spec['field']}|{spec.get('src')}|{spec.get('n')}|"
         f"{spec.get('threshold')}|{spec.get('row_ids')}"
@@ -79,8 +85,15 @@ def op_fields(kind: str, spec: dict, collect_fields):
     field itself plus the filter/src tree's fields (walked by the
     engine's collector).  None when the tree isn't walkable — the op
     then skips the memo entirely, correctness first."""
-    fields = {spec["field"]}
-    tree = spec.get("filter") if kind in ("sum", "min", "max") else spec.get("src")
+    if kind == "group":
+        fields = set(spec.get("fields") or ())
+    else:
+        fields = {spec["field"]}
+    tree = (
+        spec.get("filter")
+        if kind in ("sum", "min", "max", "group")
+        else spec.get("src")
+    )
     if tree is not None:
         sub = collect_fields(tree)
         if sub is None:
@@ -110,7 +123,7 @@ def item_texts(spec: dict) -> set:
     kind = spec["kind"]
     if kind == "count":
         return subtree_texts(spec["call"])
-    if kind in ("sum", "min", "max"):
+    if kind in ("sum", "min", "max", "group"):
         return subtree_texts(spec.get("filter"))
     return subtree_texts(spec.get("src"))
 
@@ -118,10 +131,12 @@ def item_texts(spec: dict) -> set:
 def _entry_sort_key(entry) -> tuple:
     """Canonical build order: the planner lowers entries in THIS order
     (not arrival order), so two drains carrying the same multiset of
-    (op-kind, mask) items produce byte-identical fspecs — and reuse one
-    executable — no matter how their queries interleaved on the wire.
-    The compile-key property test pins this."""
-    spec, shards = entry
+    (index, op-kind, mask) items produce byte-identical fspecs — and
+    reuse one executable — no matter how their queries interleaved on
+    the wire.  The compile-key property test pins this.  ``entry`` is
+    an (index, spec, shards) triple (cross-index drains sort by index
+    within an op kind)."""
+    index, spec, shards = entry
     kind = spec["kind"]
     if kind == "count":
         t = str(spec["call"])
@@ -129,12 +144,17 @@ def _entry_sort_key(entry) -> tuple:
         t = f"{spec['field']}|{spec.get('filter')}"
     elif kind == "topn":
         t = f"{spec['field']}|{spec['src']}|{list(spec.get('rows') or ())}"
+    elif kind == "group":
+        t = (
+            f"{list(spec.get('fields') or ())}|"
+            f"{[list(r) for r in spec.get('rows') or ()]}|{spec.get('filter')}"
+        )
     else:
         t = (
             f"{spec['field']}|{spec['src']}|{spec.get('n')}|"
             f"{spec.get('threshold')}|{spec.get('row_ids')}"
         )
-    return (kind, t, tuple(shards))
+    return (kind, str(index), t, tuple(shards))
 
 
 # -- decode helpers (shared by fused, solo, and sync paths) ------------------
@@ -259,10 +279,11 @@ class FusedPlan:
     (``MeshEngine._fused_plan_for``)."""
 
     __slots__ = (
-        "index", "fspec", "specs", "operands", "decoders", "weights",
-        "item_notes", "errors", "sparse", "have_fused", "n_items",
-        "fused_riders", "masks_evaluated", "masks_referenced",
+        "index", "indexes", "fspec", "specs", "operands", "decoders",
+        "weights", "item_notes", "errors", "sparse", "have_fused",
+        "n_items", "fused_riders", "masks_evaluated", "masks_referenced",
         "bytes_touched", "stack_tokens", "canonical", "cacheable",
+        "edge_kinds",
     )
 
 
@@ -293,6 +314,7 @@ def dispatch(engine, plan: FusedPlan) -> FusedDispatch:
         masks_referenced=plan.masks_referenced,
         masks_tier=len(plan.fspec[0]) if plan.have_fused else 0,
         bytes_touched=plan.bytes_touched,
+        fused_indexes=len(plan.indexes),
     )
     # Counters record what actually rode a fused program: a drain whose
     # items all resolved const/peeled/errored dispatched no program and
@@ -309,6 +331,13 @@ def dispatch(engine, plan: FusedPlan) -> FusedDispatch:
             engine._fused_counters[2].inc(plan.masks_evaluated)
         if plan.masks_referenced:
             engine._fused_counters[3].inc(plan.masks_referenced)
+        # Per-kind edge counters (satellite observability: how much of
+        # the fused traffic is counts vs device-trim TopN vs GroupBy).
+        edge_counter = getattr(engine, "_fused_edge_counter", None)
+        if edge_counter is not None:
+            for ekind, n in plan.edge_kinds.items():
+                if n:
+                    edge_counter(ekind).inc(n)
     return FusedDispatch(
         (fused_out, tuple(extras)), plan.decoders, plan.weights,
         plan.item_notes, plan.errors,
@@ -345,29 +374,37 @@ def _slot_refs(prog, out: set):
     return out
 
 
-def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
+def build(engine, entries: List[tuple]) -> FusedPlan:
     """Plan one heterogeneous drain (no dispatch — ``dispatch()`` runs
     the plan, possibly many times).  ``entries`` is a list of
-    (spec, shards); must run under the engine's dispatch lock (the
-    caller is MeshEngine.fused_many_async)."""
+    (index, spec, shards) triples — a drain may SPAN indexes and still
+    compile to ONE program: mask slots are hash-consed per
+    (index, subtree text), every edge consumes operands shaped to its
+    own index's shard axis, and the kernel reduces each edge to
+    replicated outputs before stacking.  Must run under the engine's
+    dispatch lock (the caller is MeshEngine.fused_drain_async)."""
     from .engine import _Lowering
 
-    canonical = engine.canonical_shards(index)
     n_items = len(entries)
-    lw = _Lowering(engine, canonical, slot_vector=True)
+    canonicals: dict = {}
+    lw = _Lowering(engine, None, slot_vector=True)
+    lw.canonical_map = canonicals
 
     slots: list = []          # lowered progs, dependency order
-    slot_of: Dict[str, int] = {}
+    slot_of: Dict[tuple, int] = {}  # (index, subtree text) -> slot
     slot_hits: List[int] = []  # textual references per slot
     refs_total = [0]
 
-    def lower_shared(call):
-        """Hash-consing lowering: every distinct subtree text becomes
-        one mask slot; repeats resolve to ("mref", j).  Combinators
-        recurse through the cache so INNER shared subtrees (the
-        dashboard's segment filter inside N Intersects) share too."""
+    def lower_shared(index, call):
+        """Hash-consing lowering: every distinct (index, subtree text)
+        becomes one mask slot; repeats resolve to ("mref", j).
+        Combinators recurse through the cache so INNER shared subtrees
+        (the dashboard's segment filter inside N Intersects) share
+        too.  The index rides the key so a cross-index drain never
+        aliases same-text subtrees of different indexes."""
         refs_total[0] += 1
-        key = str(call)
+        lw.current_index = index
+        key = (index, str(call))
         j = slot_of.get(key)
         if j is not None:
             slot_hits[j] += 1
@@ -380,12 +417,14 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
                 "Difference": "andnot",
                 "Xor": "xor",
             }[name]
-            prog = (op,) + tuple(lower_shared(ch) for ch in call.children)
+            prog = (op,) + tuple(
+                lower_shared(index, ch) for ch in call.children
+            )
         elif name == "Not" and call.children:
             from ..core.index import EXISTENCE_FIELD_NAME
 
             exist = engine._lower_row(index, EXISTENCE_FIELD_NAME, 0, lw)
-            prog = ("andnot", exist, lower_shared(call.children[0]))
+            prog = ("andnot", exist, lower_shared(index, call.children[0]))
         else:
             prog = engine._lower(index, call, lw)
         j = len(slots)
@@ -398,8 +437,11 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
     # Count sharing nothing may take the occupancy-guided sparse path).
     # Sharing is decided from a one-pass occurrence map — a pairwise
     # set-intersection sweep is O(n^2) and this runs under the engine
-    # dispatch lock.
-    texts = [item_texts(spec) for spec, _ in entries]
+    # dispatch lock.  Texts are keyed per index: equal texts in
+    # different indexes are NOT shared masks.
+    texts = [
+        {(idx, t) for t in item_texts(spec)} for idx, spec, _ in entries
+    ]
     text_items: Dict[str, int] = {}
     for ts in texts:
         for t in ts:
@@ -423,16 +465,30 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
     reduce_rows = [0.0] * n_items
     item_notes: list = [None] * n_items
     sparse_notes: list = [None] * n_items
+    extra_notes: list = [None] * n_items  # per-item plan-note stamps
 
     from ..core.view import VIEW_STANDARD, view_bsi_name
+
+    # Empty-canonical (no shards) per-index const results — cross-index
+    # drains route these INSIDE the build so one empty index never
+    # blanks its drain-mates.
+    _EMPTY = {
+        "count": 0, "sum": (0, 0), "min": (0, 0), "max": (0, 0),
+        "topn": None, "topnf": [], "group": DECLINED,
+    }
 
     # Canonical build order (compile-key discipline): slot numbering and
     # edge order follow the sorted entries, never arrival order.
     order = sorted(range(n_items), key=lambda k: _entry_sort_key(entries[k]))
     for i in order:
-        spec, shards = entries[i]
+        index, spec, shards = entries[i]
         kind = spec["kind"]
+        lw.current_index = index
         try:
+            canonical = lw.canonical_for(index)
+            if not canonical:
+                routes[i] = ("const", _EMPTY[kind])
+                continue
             if kind == "count":
                 call = spec["call"]
                 shared = any(text_items[t] > 1 for t in texts[i])
@@ -465,7 +521,7 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
                         reduce_rows[i] = 0.25
                         continue
                     plans_mod.take_dispatch_note()  # drop the occupancy probe
-                ref = lower_shared(call)
+                ref = lower_shared(index, call)
                 j = ref[1]
                 top_slot[i] = j
                 i_mask = lw.add_mask(engine._mask_words(shards, canonical))
@@ -493,7 +549,7 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
                 if filter_call is None:
                     ms = -1
                 else:
-                    ms = lower_shared(filter_call)[1]
+                    ms = lower_shared(index, filter_call)[1]
                     top_slot[i] = ms
                 i_mask = lw.add_mask(engine._mask_words(shards, canonical))
                 i_pm = lw.add_matrix(stack.matrix)
@@ -541,6 +597,7 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
                     )
                     dedup_rows = tuple(rows)
                     n_out = thr = None
+                    device = False
                 else:
                     row_ids = spec.get("row_ids")
                     entry = engine._topn_candidates(
@@ -561,26 +618,123 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
                     n = int(spec.get("n") or 0)
                     n_out = min(n, K_pad) if n and not row_ids else None
                     thr = max(int(spec.get("threshold") or 1), 1)
-                    dec = _TopNFullDecode(
-                        entry.host_cnt, list(entry.cands), thr, n_out
+                    device = n_out is not None and bool(
+                        getattr(engine, "topn_device_trim", True)
                     )
+                    if device:
+                        # Device trim: the gate + exact psum totals +
+                        # top_k run INSIDE the fused program and the
+                        # host decodes n (id, count) pairs instead of
+                        # re-ranking K candidates per readback
+                        # (decode_topn_full_scores stays as the
+                        # differential oracle — flip
+                        # engine.topn_device_trim to compare).
+                        dec = _TopNDeviceDecode(list(entry.cands), n_out)
+                    else:
+                        dec = _TopNFullDecode(
+                            entry.host_cnt, list(entry.cands), thr, n_out
+                        )
                     dedup_rows = tuple(entry.cands)
-                ms = lower_shared(src)[1]
+                ms = lower_shared(index, src)[1]
                 top_slot[i] = ms
                 i_mask = lw.add_mask(engine._mask_words(shards, canonical))
                 i_cm = lw.add_matrix(stack.matrix)
-                ekey = (kind, ms, i_mask, i_cm, field, dedup_rows, n_out, thr)
+                ekey = (
+                    kind, ms, i_mask, i_cm, field, dedup_rows, n_out, thr,
+                    device,
+                )
                 hit = edge_of.get(ekey)
                 if hit is None:
                     i_ix = lw.add_replicated(
                         put_global(engine.mesh, idx_np, P())
                     )
-                    edge = ("topn", ms, i_mask, i_cm, i_ix)
+                    if device:
+                        edge = (
+                            "topnf", ms, i_mask, i_cm, i_ix,
+                            lw.add_matrix(entry.dev_cnt),
+                            lw.add_replicated(engine._scalar(thr)),
+                            n_out,
+                        )
+                    else:
+                        edge = ("topn", ms, i_mask, i_cm, i_ix)
                     hit = edge_of[ekey] = ("agg", len(agg_edges), dec)
                     agg_edges.append(edge)
                     agg_arity.append(2)
                 routes[i] = hit
                 reduce_rows[i] = K_pad
+                if device:
+                    extra_notes[i] = {"topkDevice": int(n_out)}
+            elif kind == "group":
+                fields = list(spec.get("fields") or ())
+                row_lists = [list(r) for r in spec.get("rows") or ()]
+                filter_call = spec.get("filter")
+                if not fields:
+                    routes[i] = ("const", DECLINED)
+                    continue
+                combos = 1
+                for rows in row_lists:
+                    combos *= max(len(rows), 1)
+                if combos > engine.MAX_GROUP_COMBOS:
+                    # Same overflow contract as group_counts_async: the
+                    # host iterator handles it (DECLINED -> None at the
+                    # batched entry point).
+                    routes[i] = ("const", DECLINED)
+                    continue
+                g_mats = []
+                g_idx = []
+                g_dims = []
+                missing = False
+                for fname, rows in zip(fields, row_lists):
+                    stack = lw.stack_for(index, fname, VIEW_STANDARD)
+                    if stack is None:
+                        missing = True
+                        break
+                    engine._require_full_stack(
+                        index, fname, VIEW_STANDARD, stack
+                    )
+                    t = tuple(stack.row_index.get(r, 0) for r in rows)
+                    # Gather-free whole-row-table lists stay static
+                    # compile keys; arbitrary subsets ride traced
+                    # operands (groupn_tree's idx_specs discipline).
+                    if kernels.gather_free(t):
+                        g_idx.append(t)
+                    else:
+                        g_idx.append(
+                            lw.add_replicated(
+                                put_global(
+                                    engine.mesh,
+                                    np.asarray(t, dtype=np.int32),
+                                    P(),
+                                )
+                            )
+                        )
+                    g_mats.append(lw.add_matrix(stack.matrix))
+                    g_dims.append(len(rows))
+                if missing:
+                    routes[i] = ("const", DECLINED)
+                    continue
+                if filter_call is None:
+                    ms = -1
+                else:
+                    ms = lower_shared(index, filter_call)[1]
+                    top_slot[i] = ms
+                i_mask = lw.add_mask(engine._mask_words(shards, canonical))
+                edge = (
+                    "group", ms, i_mask, tuple(g_mats), tuple(g_idx)
+                )
+                ekey = edge + (
+                    tuple(fields),
+                    tuple(tuple(r) for r in row_lists),
+                )
+                hit = edge_of.get(ekey)
+                if hit is None:
+                    dec = _GroupDecode(tuple(g_dims))
+                    hit = edge_of[ekey] = ("agg", len(agg_edges), dec)
+                    agg_edges.append(edge)
+                    agg_arity.append(1)
+                routes[i] = hit
+                reduce_rows[i] = float(sum(g_dims))
+                extra_notes[i] = {"fusedGroupBy": int(combos)}
             else:
                 raise ValueError(f"unknown fused item kind: {kind!r}")
         except Exception as e:  # noqa: BLE001 — one bad item must not
@@ -615,6 +769,7 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
 
     masks_evaluated = len(slots)
     masks_referenced = refs_total[0]
+    indexes = sorted({idx for idx, _, _ in entries})
     for i in range(n_items):
         if routes[i] is None or routes[i][0] == "error":
             continue
@@ -622,10 +777,14 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
             sharers.get(top_slot[i], 1) - 1 if top_slot[i] is not None else 0
         )
         note = {
-            "op": OP_NAMES[entries[i][0]["kind"]],
+            "op": OP_NAMES[entries[i][1]["kind"]],
             "path": "fused_program",
             "mask_shared_with": shared_with,
         }
+        if len(indexes) > 1:
+            note["crossIndex"] = True
+        if extra_notes[i] is not None:
+            note.update(extra_notes[i])
         if sparse_notes[i] is not None:
             note.update(sparse_notes[i])
             note["op"] = "Count"
@@ -642,7 +801,7 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
             _pow2(n_count) - n_count
         )
     padded_aggs = list(agg_edges)
-    for k in ("sum", "minmax", "topn"):
+    for k in ("sum", "minmax", "topn", "topnf", "group"):
         kind_edges = [e for e in agg_edges if e[0] == k]
         if kind_edges:
             padded_aggs.extend(
@@ -680,7 +839,8 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
             decoders[i] = _Agg(agg_pos[r[1]], agg_arity[r[1]], r[2])
 
     plan = FusedPlan()
-    plan.index = index
+    plan.index = indexes[0] if len(indexes) == 1 else None
+    plan.indexes = indexes
     plan.have_fused = bool(count_edges or agg_edges)
     plan.fspec = (tuple(slots), tuple(count_edges), tuple(padded_aggs))
     plan.specs = tuple(lw.specs)
@@ -699,11 +859,20 @@ def build(engine, index: str, entries: List[Tuple[dict, list]]) -> FusedPlan:
     plan.bytes_touched = sum(
         int(getattr(op, "nbytes", 0)) for op in lw.operands
     )
-    # Reuse gates: the canonical shard axis and every referenced
-    # stack's version token (the field-stack invalidation discipline —
-    # any write to a referenced view re-keys its stack and fails the
-    # probe, so a cached plan can never serve stale operands).
-    plan.canonical = list(canonical)
+    # Real (unpadded) per-kind edge census for the fused-program edge
+    # counters (padding is a compile-key artifact, not traffic).
+    plan.edge_kinds = {}
+    if n_count:
+        plan.edge_kinds["count"] = n_count
+    for e in agg_edges:
+        plan.edge_kinds[e[0]] = plan.edge_kinds.get(e[0], 0) + 1
+    # Reuse gates: each index's canonical shard axis and every
+    # referenced stack's version token (the field-stack invalidation
+    # discipline — any write to a referenced view re-keys its stack and
+    # fails the probe, so a cached plan can never serve stale operands).
+    plan.canonical = {
+        idx: list(lw.canonical_for(idx)) for idx in indexes
+    }
     plan.stack_tokens = {
         key: (st is None, None if st is None else st.versions)
         for key, st in {**peel_stacks, **lw._stacks}.items()
@@ -810,3 +979,35 @@ class _TopNFullDecode:
         return decode_topn_full_scores(
             parts, self.host_cnt, self.cands, self.thr, self.n_out
         )
+
+
+class _TopNDeviceDecode:
+    """Decode a device-trimmed fused TopN edge: (vals[n], ids[n]) where
+    the gate + exact totals + top_k all ran on device — the host maps
+    candidate indices back to row ids, nothing else.  Bit-exact vs
+    _TopNFullDecode (the retained host oracle) by the shared top_k
+    tie-break over id-descending candidates; pinned differentially in
+    tests/test_topn_device.py."""
+
+    __slots__ = ("cands", "n_out")
+
+    def __init__(self, cands, n_out):
+        self.cands = cands
+        self.n_out = n_out
+
+    def __call__(self, parts):
+        return decode_topn_full(parts, self.cands, self.n_out)
+
+
+class _GroupDecode:
+    """Reshape a fused GroupBy edge's flattened int32[prod(K_i)] counts
+    back to the per-field [K1, ..., Kn] tensor group_counts returns."""
+
+    __slots__ = ("dims",)
+
+    def __init__(self, dims):
+        self.dims = dims
+
+    def __call__(self, parts):
+        (flat,) = parts
+        return np.asarray(flat).reshape(self.dims)

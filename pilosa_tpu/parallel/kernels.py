@@ -378,6 +378,85 @@ def topn_full_tree(mesh, prog, specs, n_out, cand_idxs, mask, cand_mat, cnt, thr
     )(mask, cand_mat, cnt, thr, *operands)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def topn_slab_tree(
+    mesh, prog, specs, n_sel, k_out, cand_idxs, mask, cand_mat, cnt, thr,
+    *operands,
+):
+    """Per-shard threshold-prune + top-k SLAB: fragment.top's sequential
+    heap walk (fragment.go :1018-1106), vectorized per shard on device.
+
+    The walk visits the shard's ranked-cache pairs in (count desc, id
+    desc) order, pushes candidates with score >= threshold until the
+    heap holds ``n_sel``, then keeps pushing scores >= the heap min T
+    (never popping) and breaks at the first count < T.  Because score
+    <= count and the heap min never decreases once full, the emitted
+    set is EXACTLY {candidates with score >= T}, where T is the min
+    score of the first ``n_sel`` score-qualifying candidates in walk
+    order — or the raw threshold when fewer than ``n_sel`` qualify.
+    That closed form is what this kernel computes, per shard, with no
+    host loop.
+
+    ``cnt`` must be the shard's CACHE counts with cache MEMBERSHIP
+    (0 when a candidate is not in that shard's ranked cache): the walk
+    only ever visits the shard's own cached pairs.  Candidates are
+    id-DESCENDING so both the stable -cnt argsort (walk order) and
+    ``top_k``'s lowest-index tie-break reproduce the (-count, -id)
+    pair sort.
+
+    Returns (vals int32[S, k_out], idx int32[S, k_out],
+    qual int32[S]), replicated.  ``qual[s]`` counts the walk's FULL
+    output for shard s; qual > k_out marks a slab overflow — the
+    caller falls back to the exact host walk rather than truncate, so
+    the merged result is bit-exact by construction.  The compile key
+    is (prog, specs, n_sel, k_out, cand_idxs): n and the pow2 k tier
+    are static, candidate ids ride data operands."""
+
+    def body(m, cmat, cn, th, *ops):
+        if cand_idxs is None:
+            ix, *rest = ops
+            cands = gather_rows(cmat, ix)
+        else:
+            rest = ops
+            cands = gather_rows(cmat, cand_idxs)
+        src = _filter(prog, m, tuple(rest))
+        scores = score_rows(cands, jnp.broadcast_to(src, cands.shape[1:]))
+        g = jnp.where(jnp.logical_and(cn >= th, scores >= th), scores, 0)
+        # Walk order per shard: stable argsort of -cnt over the
+        # id-descending candidate axis == (count desc, id desc).
+        order = jnp.argsort(-cn, axis=0)
+        g_ord = jnp.take_along_axis(g, order, axis=0)
+        q = g_ord > 0
+        nq = jnp.sum(q, axis=0)
+        if n_sel:
+            c = jnp.cumsum(q, axis=0)
+            a = jnp.where(
+                q & (c <= n_sel), g_ord, jnp.iinfo(jnp.int32).max
+            )
+            t_phase_a = jnp.min(a, axis=0)
+            t = jnp.where(nq >= n_sel, t_phase_a, th)
+        else:
+            # n=0: no trim — the full gated set (T = threshold).
+            t = jnp.broadcast_to(th, nq.shape)
+        keep = g >= t[None, :]
+        qual = jnp.sum(keep, axis=0)
+        vals, idx = jax.lax.top_k(jnp.where(keep, g, 0).T, k_out)
+        n_dev = mesh.shape[SHARD_AXIS]
+        return (
+            replicate_shards(vals, n_dev, axis=0),
+            replicate_shards(idx, n_dev, axis=0),
+            replicate_shards(qual, n_dev, axis=0),
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS), P())
+        + specs,
+        out_specs=(P(), P(), P()),
+    )(mask, cand_mat, cnt, thr, *operands)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def sum_tree(mesh, prog, specs, pspec, mask, plane_mat, *operands):
     """BSI Sum in ONE dispatch: plane slice + filter tree + weighted
@@ -469,15 +548,27 @@ def fused_tree(mesh, fspec, specs, *operands):
       (or other slots) reference it; XLA dead-codes padded duplicates.
     * ``count_edges``: tuple of ``(slot, i_mask)`` — per-edge masked
       popcount, stacked and reduced in ONE psum (int32[n_counts]).
+      Slots may belong to DIFFERENT indexes (cross-index drains): every
+      edge reduces to replicated scalars/vectors before stacking, so
+      mixed per-index shard shapes coexist in one program.
     * ``agg_edges``: tuple of per-edge static descriptors consuming a
       slot (or the bare shard mask when slot < 0, the ("ones",) filter):
         ("sum",    slot, i_mask, i_planes, pspec)       -> counts[D], n
         ("minmax", slot, i_mask, i_planes, pspec, min)  -> hi[S], lo[S], n[S]
         ("topn",   slot, i_mask, i_cands, i_idxs)       -> scores[K,S], src[S]
+        ("topnf",  slot, i_mask, i_cands, i_idxs, i_cnt, i_thr, n_sel)
+                                                        -> vals[n], ids[n]
+        ("group",  slot, i_mask, (i_mat, ...), (idxs | i_idx, ...))
+                                                        -> counts[prod(K_i)]
       Each edge body is the corresponding single-op kernel's body
-      verbatim (sum_tree / minmax_tree / topn_tree) with the evaluated
-      slot as its filter row — bit-exactness vs the solo programs is by
-      construction, and tests/test_fusion.py pins it differentially.
+      verbatim (sum_tree / minmax_tree / topn_tree / topn_full_tree /
+      groupn_tree) with the evaluated slot as its filter row —
+      bit-exactness vs the solo programs is by construction, and
+      tests/test_fusion.py pins it differentially.  "topnf" runs full
+      TopN with the gate + exact psum totals + top-k trim ON DEVICE
+      (the dashboard lane's device trim); "group" emits the flattened
+      combination tensor (host decode reshapes), per-field row indices
+      static tuples when gather-free else traced operand refs.
 
     Outputs are a flat tuple, replicated: the count vector first (when
     any count edges exist), then each aggregate edge's components in
@@ -542,13 +633,57 @@ def fused_tree(mesh, fspec, specs, *operands):
                 counts = jnp.sum(_pc(srcb), axis=-1)
                 outs.append(replicate_shards(scores, n_dev, axis=1))
                 outs.append(replicate_shards(counts, n_dev, axis=0))
+            elif kind == "topnf":
+                # topn_full_tree's body: gate + exact psum totals +
+                # device trim.  Candidates id-descending; psum output is
+                # replicated so top_k needs no replicate_shards.
+                _, slot, i_mask, i_cm, i_ix, i_cnt, i_thr, n_sel = e
+                src = masked(slot, i_mask)
+                cands = jnp.take(ops[i_cm], ops[i_ix], axis=0)
+                scores = score_rows(
+                    cands, jnp.broadcast_to(src, cands.shape[1:])
+                )
+                gate = jnp.logical_and(
+                    ops[i_cnt] >= ops[i_thr], scores >= ops[i_thr]
+                )
+                totals = jax.lax.psum(
+                    jnp.sum(jnp.where(gate, scores, 0), axis=1), SHARD_AXIS
+                )
+                vals, top_idx = jax.lax.top_k(totals, n_sel)
+                outs.append(vals)
+                outs.append(top_idx)
+            elif kind == "group":
+                # groupn_tree's body with a flattened output (the host
+                # decoder reshapes to the per-field dims).
+                _, slot, i_mask, i_mats, gidx = e
+                f = masked(slot, i_mask)
+                grows = []
+                for i_pm, gspec in zip(i_mats, gidx):
+                    gix = gspec if isinstance(gspec, tuple) else ops[gspec]
+                    grows.append(gather_rows(ops[i_pm], gix))
+                gdims = tuple(r.shape[0] for r in grows)
+                gfb = jnp.broadcast_to(f, grows[0].shape[1:])
+                ng = len(grows)
+
+                def gbuild(i, acc, grows=grows, gdims=gdims, ng=ng):
+                    if i == ng:
+                        return [_pc(acc)]
+                    out = []
+                    for k in range(gdims[i]):
+                        out.extend(gbuild(i + 1, acc & grows[i][k]))
+                    return out
+
+                gcounts = jnp.stack(_sum_many(gbuild(0, gfb), (0, 1)))
+                outs.append(jax.lax.psum(gcounts, SHARD_AXIS))
             else:
                 raise ValueError(f"bad fused edge {kind}")
         return tuple(outs)
 
     n_out = (1 if count_edges else 0)
     for e in agg_edges:
-        n_out += {"sum": 2, "minmax": 3, "topn": 2}[e[0]]
+        n_out += {"sum": 2, "minmax": 3, "topn": 2, "topnf": 2, "group": 1}[
+            e[0]
+        ]
     return shard_map(
         body, mesh=mesh, in_specs=specs, out_specs=(P(),) * n_out
     )(*operands)

@@ -328,6 +328,26 @@ def analyze(plan: QueryPlan, slow: bool = False) -> List[str]:
                     f"fusion: {mr} mask references evaluated as {me} "
                     f"distinct masks ({mr - me} evaluation(s) saved)"
                 )
+            if op.get("crossIndex"):
+                notes.append(
+                    "cross-index drain: one fused program spans "
+                    f"{int(op.get('fused_indexes', 0) or 0) or 'multiple'} "
+                    "indexes"
+                )
+            if op.get("fusedGroupBy"):
+                notes.append(
+                    f"GroupBy fused: {int(op['fusedGroupBy'])} combo "
+                    "count(s) as one program edge"
+                )
+        if op.get("topkDevice"):
+            notes.append(
+                f"TopN trim on-device (K={int(op['topkDevice'])})"
+            )
+        elif op.get("op") == "TopN" and path == "host_merge":
+            notes.append(
+                f"TopN host merge: {int(op.get('candidates', 0) or 0)} "
+                "candidates re-ranked on host"
+            )
     # Degraded-routing annotations (docs/durability.md), aggregated to
     # ONE note each — a 100-shard query on an all-DOWN owner set stamps
     # one op per shard, and 100 identical notes would drown the plan.

@@ -394,6 +394,10 @@ METRIC_ENGINE_FUSED_MASKS_EVAL = (
 METRIC_ENGINE_FUSED_MASKS_REF = (
     "pilosa_engine_fused_program_masks_referenced_total"
 )
+#   pilosa_engine_fused_program_edges_total{kind=}  per-kind edges that rode
+#                                                fused programs (count, topn,
+#                                                topnf device trim, group, …)
+METRIC_ENGINE_FUSED_EDGES = "pilosa_engine_fused_program_edges_total"
 # -- cluster & device observability (docs/observability.md) -----------------
 #   pilosa_engine_resident_bytes            gauge: HBM held by resident stacks
 #   pilosa_engine_evicted_bytes             gauge: evicted-but-still-live

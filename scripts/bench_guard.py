@@ -135,6 +135,18 @@ AUTO_REQUIRE = (
     # so the streaming-maintenance lane cannot be silently dropped.
     "result_memo_hit_rate_under_write_load",
     "dashboard_p50_under_ingest_vs_idle",
+    # Device-resident TopN + cross-index drains (bench.py
+    # --dashboard-sweep, docs/fusion.md "TopN on device"): the slab
+    # lane's device p50 and executor e2e p50 (ms regress UP, same
+    # polarity as topn_1B_cols_p50), the device-trim-vs-host-rank/merge
+    # speedup (ABS_FLOORed at the 2x ISSUE 18 acceptance), and the
+    # cross-index drain's p50 + fused-vs-sequential speedup.  Required
+    # once baselined so the device-TopN lane cannot be silently dropped.
+    "topn_device_p50",
+    "topn_e2e_p50",
+    "topn_device_speedup",
+    "dashboard_crossindex_p50_ms",
+    "dashboard_crossindex_fused_speedup",
     # Self-hosted metrics history (bench.py --history-overhead,
     # docs/observability.md): the sampler's 1s-interval duty cycle
     # ("pct" regresses UP; the <3% ISSUE 17 acceptance holds via
@@ -152,6 +164,8 @@ NAME_HIGHER_BETTER = {
     "destructive_write_availability_pct",
     "replica_read_qps_gain",
     "dashboard_fused_speedup",
+    "topn_device_speedup",
+    "dashboard_crossindex_fused_speedup",
     "residency_hit_rate",
     "result_memo_hit_rate_under_write_load",
 }
@@ -173,6 +187,10 @@ DEFAULT_METRIC_TOL = {
     # Same shape: fused/sequential wall ratio on shared vCPUs; the 1.5x
     # ABS_FLOOR below is the binding fusion contract.
     "dashboard_fused_speedup": 0.5,
+    # Same shape again (PR 18): slab-vs-host and cross-index wall
+    # ratios; the 2x ABS_FLOOR below is the binding slab contract.
+    "topn_device_speedup": 0.5,
+    "dashboard_crossindex_fused_speedup": 0.5,
     # Two wall-p50 ratios on shared vCPUs (repair sweep): the absolute
     # floor/ceiling below carry the binding ISSUE 16 contracts.
     "result_memo_hit_rate_under_write_load": 0.5,
@@ -201,6 +219,9 @@ ABS_FLOOR = {
     "availability_under_failure_pct": 90.0,
     "destructive_write_availability_pct": 90.0,
     "dashboard_fused_speedup": 1.5,
+    # ISSUE 18 acceptance: the executor TopN e2e with device trim beats
+    # the in-run host rank/merge oracle by >=2x.
+    "topn_device_speedup": 2.0,
     # The ISSUE 15 acceptance: >0.5 of the repeated-dashboard phase
     # must serve from device residency at 4x oversubscription.
     "residency_hit_rate": 0.5,

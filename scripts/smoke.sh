@@ -577,6 +577,71 @@ assert fusion_counts.get(
     "pilosa_engine_fused_program_masks_evaluated_total", 0
 ), ("fused drain recorded no mask reuse", fusion_counts)
 
+# PR 18 smoke: a mixed TopN+GroupBy drain SPANNING indexes fuses into
+# ONE program whose plan ops record crossIndex, the on-device TopN trim
+# (topkDevice), and the fused GroupBy combo width (docs/fusion.md
+# "TopN on device" / "cross-index drains").
+idx2 = holder.create_index("smoke2")
+_h = idx2.create_field("h")
+_h.import_bulk([3, 3, 4], [0, 2, 5])
+_shards2 = sorted(idx2.available_shards())
+_memo_max = eng.result_memo.maxsize
+eng.result_memo.maxsize = 0  # every attempt must really dispatch
+_src = _pql.parse("Row(f=1)").calls[0]
+xfused = None
+for _attempt in range(8):
+    _b._last_fused = time.monotonic() + 10_000  # every submit queues
+    _plan_objs = [
+        _plans.QueryPlan("smoke", "x-topn"),
+        _plans.QueryPlan("smoke2", "x-group"),
+    ]
+    _res = {}
+
+    def _run_x_topn():
+        with _plans.attach(_plan_objs[0]):
+            _res["topn"] = eng.batched_topn_full(
+                "smoke", "f", _src, _shards, 1, 1
+            )
+
+    def _run_x_group():
+        with _plans.attach(_plan_objs[1]):
+            _res["group"] = eng.batched_group_counts(
+                "smoke2", ["h"], [[3, 4]], None, _shards2
+            )
+
+    _ts = [
+        threading.Thread(target=_run_x_topn),
+        threading.Thread(target=_run_x_group),
+    ]
+    for _t in _ts:
+        _t.start()
+    for _t in _ts:
+        _t.join(60)
+    assert _res["topn"] == [(1, 3)], _res
+    assert _res["group"] is not None and [
+        int(x) for x in _res["group"]
+    ] == [2, 1], _res
+    _xops = [
+        op
+        for p in _plan_objs
+        for op in p.ops
+        if op.get("path") == "fused_program"
+    ]
+    if any(op.get("crossIndex") for op in _xops):
+        xfused = _xops
+        break  # both submissions landed in one cross-index drain
+assert xfused is not None, (
+    "TopN+GroupBy never pooled into a cross-index drain",
+    [p.ops for p in _plan_objs],
+)
+assert any(op.get("topkDevice") for op in xfused), (
+    "cross-index drain recorded no device TopN trim", xfused
+)
+assert any(op.get("fusedGroupBy") for op in xfused), (
+    "cross-index drain recorded no fused GroupBy edge", xfused
+)
+eng.result_memo.maxsize = _memo_max
+
 srv.shutdown()
 
 # Both backends (acceptance): the threaded differential oracle serves

@@ -648,6 +648,141 @@ def test_fused_program_metric_series(holder, mesh):
     assert snap["fusedMasksReferenced"] >= snap["fusedMasksEvaluated"]
 
 
+# -- cross-index drains ------------------------------------------------------
+
+
+def _add_index_j(holder):
+    """Second index for cross-index drains: segment field g, widget
+    field u, disjoint rng stream from index i."""
+    idx = holder.create_index("j")
+    g = idx.create_field("g")
+    u = idx.create_field("u")
+    ef = idx.existence_field()
+    rng = np.random.default_rng(23)
+    rows, cols = [], []
+    for s in range(N_SHARDS):
+        base = s * SHARD_WIDTH
+        picks = rng.choice(SHARD_WIDTH, size=400, replace=False)
+        for c in picks[:300]:
+            rows.append(4)
+            cols.append(base + int(c))
+        for c in picks[150:]:
+            rows.append(5)
+            cols.append(base + int(c))
+    g.import_bulk(rows, cols)
+    ef.import_bulk([0] * len(cols), cols)
+    u.import_bulk([2] * 500, cols[:500])
+
+
+def test_cross_index_fused_drain_bit_exact(holder, mesh):
+    """A drain spanning TWO indexes — counts, a device-trim TopN, a
+    GroupBy edge, a Sum — compiles to ONE fused program (mask slots
+    keyed (index, subtree)) and every item is bit-exact vs its
+    per-index sequential oracle."""
+    _add_index_j(holder)
+    eng = MeshEngine(holder, mesh)
+    seg_i = _call(SEG)
+    seg_j = _call("Row(g=4)")
+    entries = [
+        ("i", {"kind": "count", "call": _call(f"Intersect({SEG}, Row(w=5))")},
+         SHARDS),
+        ("j", {"kind": "count", "call": _call("Intersect(Row(g=4), Row(u=2))")},
+         SHARDS),
+        ("i", {"kind": "topnf", "field": "w", "src": seg_i, "n": 3,
+               "threshold": 1, "row_ids": None}, SHARDS),
+        ("j", {"kind": "group", "fields": ["g"], "rows": [[4, 5]],
+               "filter": _call("Row(u=2)")}, SHARDS),
+        ("i", {"kind": "sum", "field": "v", "filter": seg_i}, SHARDS),
+    ]
+    want = [
+        eng.count("i", entries[0][1]["call"], SHARDS),
+        eng.count("j", entries[1][1]["call"], SHARDS),
+        eng.topn_full("i", "w", seg_i, SHARDS, 3, 1),
+        eng.group_counts("j", ["g"], [[4, 5]], _call("Row(u=2)"), SHARDS),
+        eng.sum("i", "v", seg_i, SHARDS),
+    ]
+    p0 = eng.fused_programs
+    got = eng.fused_drain(entries)
+    assert eng.fused_programs == p0 + 1  # ONE program spans both indexes
+    assert got[0] == want[0]
+    assert got[1] == want[1]
+    assert got[2] == want[2]
+    assert np.array_equal(np.asarray(got[3]), np.asarray(want[3]))
+    assert got[4] == want[4]
+    # The plan-note satellite: every item is stamped crossIndex, the
+    # TopN edge records its device trim, the GroupBy its combo width.
+    fd = eng.fused_drain_async(entries)
+    plans_mod.take_dispatch_note()
+    notes = fd.item_notes
+    assert all(n.get("crossIndex") for n in notes)
+    assert notes[2].get("topkDevice")
+    assert notes[3].get("fusedGroupBy") == 2
+    assert seg_j is not None
+    eng.close()
+
+
+def test_cross_index_fused_plan_cache_reuse(holder, mesh):
+    """The cross-index drain's plan caches and revalidates like the
+    single-index one: a second dispatch of the same drain shape reuses
+    the compiled plan; a write to EITHER index invalidates it."""
+    _add_index_j(holder)
+    eng = MeshEngine(holder, mesh)
+    entries = [
+        ("i", {"kind": "count", "call": _call(SEG)}, SHARDS),
+        ("j", {"kind": "count", "call": _call("Row(g=4)")}, SHARDS),
+    ]
+    want = eng.fused_drain(entries)
+    n0 = len(eng._fused_plans)
+    assert eng.fused_drain(entries) == want
+    assert len(eng._fused_plans) == n0  # reused, not replanned
+    holder.index("j").field("g").set_bit(4, 3 * SHARD_WIDTH + 7)
+    got = eng.fused_drain(entries)
+    assert got[0] == want[0]
+    assert got[1] == eng.count("j", _call("Row(g=4)"), SHARDS)
+    eng.close()
+
+
+def test_cross_index_batcher_pools_one_program(holder, mesh):
+    """End to end through the batcher: concurrent submissions against
+    DIFFERENT indexes land in one drain and fuse into one program."""
+    _add_index_j(holder)
+    eng = MeshEngine(holder, mesh)
+    # The oracle counts below would otherwise seed the result memo and
+    # the submissions would answer as memo-hit riders, never fusing.
+    eng.result_memo.maxsize = 0
+    eng._batcher = CountBatcher(eng)
+    b = eng.batcher()
+    ci = _call(f"Intersect({SEG}, Row(w=5))")
+    cj = _call("Intersect(Row(g=4), Row(u=2))")
+    want_i = eng.count("i", ci, SHARDS)
+    want_j = eng.count("j", cj, SHARDS)
+    want_sum = eng.sum("i", "v", _call(SEG), SHARDS)
+    _hot(b)
+    p0 = eng.fused_programs
+    results = {}
+
+    def run(name, fn):
+        results[name] = fn()
+
+    threads = [
+        threading.Thread(target=run, args=(
+            "ci", lambda: b.submit("i", ci, SHARDS))),
+        threading.Thread(target=run, args=(
+            "cj", lambda: b.submit("j", cj, SHARDS))),
+        threading.Thread(target=run, args=(
+            "sum", lambda: eng.batched_sum("i", "v", _call(SEG), SHARDS))),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert results["ci"] == want_i
+    assert results["cj"] == want_j
+    assert results["sum"] == want_sum
+    assert eng.fused_programs >= p0 + 1
+    eng.close()
+
+
 # -- the plan miner ----------------------------------------------------------
 
 

@@ -1,0 +1,265 @@
+"""Device-resident TopN (the slab lane): the per-shard candidate walk —
+threshold gates + top-k — runs inside the sharded program and each shard
+returns a fixed-width sorted slab, merged on host from k_out * |shards|
+pairs.  Everything here is differential against the retained host walk
+(fragment.top + cache.merge_pairs), which stays in the tree verbatim as
+the oracle: randomized densities, duplicate counts (the stable
+(-count, -id) tie-break), thresholds at/below/above every score, k
+larger than the candidate set, and the slab-overflow decline contract
+(qual > k_out -> None -> callers run the exact host walk)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.parallel import MeshEngine, make_mesh
+
+N_SHARDS = 8
+SHARDS = list(range(N_SHARDS))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _call(q):
+    return pql.parse(q).calls[0]
+
+
+@pytest.fixture(scope="module")
+def holder():
+    """Field ``t``: 20 rows of randomized per-shard density, plus three
+    DUPLICATE rows (30/31/32 share identical bit patterns, so every
+    per-shard cache count and every src score ties exactly — the id
+    tie-break must decide).  Field ``w``: src segments of three
+    densities (dense row 5, medium row 6, sparse row 7)."""
+    h = Holder()
+    h.open()
+    idx = h.create_index("i")
+    t = idx.create_field("t")
+    w = idx.create_field("w")
+    ef = idx.existence_field()
+    rng = np.random.default_rng(99)
+    rows, cols = [], []
+    for s in range(N_SHARDS):
+        base = s * SHARD_WIDTH
+        for r in range(20):
+            density = int(rng.integers(0, 120))
+            if density == 0:
+                continue
+            for c in rng.choice(2048, size=density, replace=False):
+                rows.append(r)
+                cols.append(base + int(c))
+        dup_cols = rng.choice(2048, size=40, replace=False)
+        for r in (30, 31, 32):  # identical counts: tie-break fodder
+            for c in dup_cols:
+                rows.append(r)
+                cols.append(base + int(c))
+    t.import_bulk(rows, cols)
+    ef.import_bulk([0] * len(cols), cols)
+    wr, wc = [], []
+    for s in range(N_SHARDS):
+        base = s * SHARD_WIDTH
+        for c in rng.choice(2048, size=1200, replace=False):
+            wr.append(5)
+            wc.append(base + int(c))
+        for c in rng.choice(2048, size=300, replace=False):
+            wr.append(6)
+            wc.append(base + int(c))
+        for c in rng.choice(2048, size=12, replace=False):
+            wr.append(7)
+            wc.append(base + int(c))
+    w.import_bulk(wr, wc)
+    return h
+
+
+def host_walk(h, eng, index, field, src_call, shards, n, thr):
+    """The retained host phase-1 verbatim (_mesh_topn_shards body):
+    per-shard fragment.top over batched device scores, merged with
+    cache.merge_pairs."""
+    thr = max(int(thr), 1)
+    frags, cand_set = {}, set()
+    for s in shards:
+        frag = h.fragment(index, field, VIEW_STANDARD, s)
+        if frag is None:
+            continue
+        frags[s] = frag
+        cand_set.update(r for r, _ in frag.cache.top())
+    if not frags:
+        return []
+    candidates = sorted(cand_set)
+    scores, src_counts, pos = eng.topn_scores(
+        index, field, candidates, src_call, shards
+    )
+    out = []
+    for s in shards:
+        frag = frags.get(s)
+        si = pos.get(s)
+        if frag is None or si is None:
+            continue
+        per = {r: int(scores[si, k]) for k, r in enumerate(candidates)}
+        out.append(
+            frag.top(
+                n=int(n),
+                min_threshold=thr,
+                src_counts=per,
+                src_count_total=int(src_counts[si]),
+            )
+        )
+    return cache_mod.merge_pairs(out)
+
+
+# -- differential fuzz -------------------------------------------------------
+
+
+@pytest.mark.parametrize("src_row", [5, 6, 7])
+def test_slab_differential_fuzz(holder, mesh, src_row):
+    """The headline differential: every (n, threshold) config over three
+    src densities — device slab vs the host walk, bit-exact whenever the
+    slab accepts.  Thresholds sweep below / at / above the score range;
+    n sweeps past the candidate-set size."""
+    eng = MeshEngine(holder, mesh)
+    src = _call(f"Row(w={src_row})")
+    ran = 0
+    for n in (1, 2, 3, 8, 64, 4096):
+        for thr in (0, 1, 3, 10, 37, 10_000_000):
+            for shards in (SHARDS, [0], [2, 5, 7]):
+                got = eng.topn_device_full("i", "t", src, shards, n, thr)
+                if got is None:
+                    continue  # overflow decline: host walk is the path
+                ran += 1
+                want = host_walk(holder, eng, "i", "t", src, shards, n, thr)
+                assert got == want, (n, thr, shards, got, want)
+    assert ran >= 60  # the lane actually exercised, not blanket-declined
+    eng.close()
+
+
+def test_slab_duplicate_counts_stable_tiebreak(holder, mesh):
+    """Rows 30/31/32 tie on every per-shard cache count AND every score:
+    the per-shard selection threshold T must resolve ties exactly like
+    the walk's (count desc, id desc) order, or the emitted set drifts."""
+    eng = MeshEngine(holder, mesh)
+    src = _call("Row(w=5)")
+    for n in (1, 2, 3, 4):
+        got = eng.topn_device_full("i", "t", src, SHARDS, n, 1)
+        want = host_walk(holder, eng, "i", "t", src, SHARDS, n, 1)
+        if got is not None:
+            assert got == want, (n, got, want)
+    eng.close()
+
+
+def test_slab_threshold_above_all_scores_empty(holder, mesh):
+    eng = MeshEngine(holder, mesh)
+    got = eng.topn_device_full(
+        "i", "t", _call("Row(w=5)"), SHARDS, 3, 10_000_000
+    )
+    assert got == []
+    eng.close()
+
+
+def test_slab_overflow_declines_to_host(holder, mesh):
+    """n=1 makes k_out=8; the dup rows + 20 dense rows qualify well past
+    8 on the dense src, so at least one shard overflows its slab and
+    the lane must return None (the exact host walk runs instead) —
+    UNLESS every shard's qualifying set fit, in which case the result
+    must equal the walk.  Either way: never a silently-truncated set."""
+    eng = MeshEngine(holder, mesh)
+    src = _call("Row(w=5)")
+    got = eng.topn_device_full("i", "t", src, SHARDS, 1, 1)
+    if got is not None:
+        assert got == host_walk(holder, eng, "i", "t", src, SHARDS, 1, 1)
+    eng.close()
+
+
+def test_slab_k_past_candidates(holder, mesh):
+    """n far beyond the candidate-set size: the slab pads, the walk
+    emits everything qualifying; both must agree exactly."""
+    eng = MeshEngine(holder, mesh)
+    src = _call("Row(w=6)")
+    got = eng.topn_device_full("i", "t", src, SHARDS, 4096, 1)
+    assert got is not None
+    assert got == host_walk(holder, eng, "i", "t", src, SHARDS, 4096, 1)
+    eng.close()
+
+
+# -- executor routing --------------------------------------------------------
+
+
+def test_executor_topn_slab_bit_exact(holder, mesh):
+    """End to end: the executor's TopN with the slab lane on vs off vs
+    the pure host-path executor — all three identical."""
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder, mesh_engine=eng)
+    plain = Executor(holder)
+    q = "TopN(t, Row(w=5), n=3)"
+    want = plain.execute("i", q).results
+    got_slab = ex.execute("i", q).results
+    assert got_slab == want
+    eng.topn_slab_enabled = False
+    got_host = ex.execute("i", q).results
+    assert got_host == want
+    eng.topn_slab_enabled = True
+    eng.close()
+
+
+def test_mesh_topn_shards_slab_vs_host(holder, mesh):
+    """The phase-1 routing itself: _mesh_topn_shards with the slab lane
+    enabled returns exactly what the host-walk body returns with it
+    disabled — including the plan-note path stamp on each side."""
+    from pilosa_tpu.util import plans as plans_mod
+
+    eng = MeshEngine(holder, mesh)
+    ex = Executor(holder, mesh_engine=eng)
+    # n=16 -> k_out=32 >= the 23-row candidate union, so no shard can
+    # overflow its slab and the device lane is guaranteed to accept.
+    c = _call("TopN(t, Row(w=6), n=16)")
+
+    class _Opt:
+        remote = False
+
+    plan = plans_mod.QueryPlan("i", str(c))
+    with plans_mod.attach(plan):
+        got = ex._mesh_topn_shards("i", c, SHARDS, _Opt())
+    eng.topn_slab_enabled = False
+    want = ex._mesh_topn_shards("i", c, SHARDS, _Opt())
+    eng.topn_slab_enabled = True
+    assert got is not None and want is not None
+    assert got[0] == want[0]
+    assert got[1] == want[1]
+    paths = {op.get("path") for op in plan.ops}
+    assert "device_slab" in paths
+    eng.close()
+
+
+# -- fused-program device trim ----------------------------------------------
+
+
+def test_fused_device_trim_vs_host_oracle(holder, mesh):
+    """The fused dashboard lane's TopN edge: device trim ON (topnf edge,
+    top_k inside the program) vs OFF (score-matrix readback +
+    decode_topn_full_scores, the differential oracle) — bit-exact, and
+    flipping the toggle may NOT reuse the other mode's cached plan."""
+    eng = MeshEngine(holder, mesh)
+    entries = [
+        ({"kind": "topnf", "field": "t", "src": _call("Row(w=5)"), "n": 3,
+          "threshold": 1, "row_ids": None}, SHARDS),
+        ({"kind": "count", "call": _call("Row(w=5)")}, SHARDS),
+    ]
+    assert eng.topn_device_trim  # default ON
+    got_dev = eng.fused_many("i", entries)
+    eng.topn_device_trim = False
+    got_host = eng.fused_many("i", entries)
+    eng.topn_device_trim = True
+    got_dev2 = eng.fused_many("i", entries)
+    want_topn = eng.topn_full("i", "t", _call("Row(w=5)"), SHARDS, 3, 1)
+    assert got_dev[0] == got_host[0] == got_dev2[0] == want_topn
+    assert got_dev[1] == got_host[1] == eng.count(
+        "i", _call("Row(w=5)"), SHARDS
+    )
+    eng.close()
