@@ -3100,6 +3100,123 @@ def profile_overhead_bench():
     holder.close()
 
 
+ADV_SHARDS = 4
+ADV_WARM_PAIRS = 12  # A,B alternations before scoring (miner + WS learn)
+ADV_SCORE_PAIRS = 64  # graded alternations (counter-delta window)
+ADV_P50_REPS = 48  # wall p50 of the real query (overhead denominator)
+ADV_REPLAY_N = 4000  # total heat-observe replays (overhead numerator)
+ADV_REPLAY_LOOPS = 8  # numerator = best (min) mean over this many loops
+
+
+def advisor_sweep():
+    """--advisor-sweep: prefetch-advisor prediction quality plus the
+    heat recorder's per-query cost (docs/observability.md "Working-set
+    heat & sequences").
+
+    Two dashboard-shaped Counts over DISJOINT row ranges alternate
+    A,B,A,B,... through the real api/engine path with the result memo
+    off — every round dispatches, so every round stamps the touches the
+    heat recorder feeds to the sequence miner and the advisor.  After a
+    learning phase, the scored phase counts advised-row hits/misses as
+    pilosa_advisor_{hits,misses}_total deltas: the advisor's advice set
+    after each A must name exactly B's rows (and vice versa), giving
+    the prefetch_advisor_hit_rate headline (bench_guard ABS_FLOOR 0.7).
+
+    heat_overhead_pct reuses the --profile-overhead replay estimator
+    (a wall A/B cannot resolve sub-ms per-query costs on this
+    container): the numerator is the best (min) tight-loop mean of
+    HEAT.observe_plan replayed on the EXACT plan a real query just
+    recorded — heat-table update, miner transition, advisor
+    grade/learn/advise, the full added path — over the real query's
+    wall p50 as denominator (target <2%; bench_guard ABS_CEILING)."""
+    progress("importing jax (advisor sweep)")
+    import jax
+
+    from pilosa_tpu.api import API, QueryRequest
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.ops import bitops
+    from pilosa_tpu.parallel import MeshEngine, make_mesh
+    from pilosa_tpu.parallel.advisor import ADVISOR
+    from pilosa_tpu.util import plan_miner, plans
+    from pilosa_tpu.util.heat import HEAT
+
+    rng = np.random.default_rng(19)
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("adv")
+    f = idx.create_field("f")
+    view = f.view_if_not_exists("standard")
+    for s in range(ADV_SHARDS):
+        frag = view.fragment_if_not_exists(s)
+        for r in (0, 1, 8, 9):
+            frag.load_row_words(r, __rand(rng, bitops.WORDS64))
+    for frag in view.fragments.values():
+        frag.cache.invalidate()
+    progress("advisor build done")
+
+    mesh = make_mesh(len(jax.devices()))
+    eng = MeshEngine(holder, mesh)
+    eng.result_memo.maxsize = 0  # every round must dispatch (touches)
+    api = API(holder=holder, mesh_engine=eng)
+    HEAT.reset()
+    plan_miner.MINER.reset()
+    ADVISOR.reset()
+
+    req_a = QueryRequest("adv", "Count(Intersect(Row(f=0), Row(f=1)))")
+    req_b = QueryRequest("adv", "Count(Intersect(Row(f=8), Row(f=9)))")
+    want_a = int(api.query(req_a).results[0])
+    want_b = int(api.query(req_b).results[0])
+
+    # Learn: the alternation teaches the miner sig(A)->sig(B)->sig(A)
+    # and the advisor both signatures' working sets.
+    for _ in range(ADV_WARM_PAIRS):
+        assert int(api.query(req_a).results[0]) == want_a
+        assert int(api.query(req_b).results[0]) == want_b
+
+    # Score: counter deltas over the graded alternations only (the
+    # learning phase's cold-start holds and half-learned sets excluded).
+    h0, m0 = ADVISOR.hits, ADVISOR.misses
+    for _ in range(ADV_SCORE_PAIRS):
+        assert int(api.query(req_a).results[0]) == want_a
+        assert int(api.query(req_b).results[0]) == want_b
+    hits = ADVISOR.hits - h0
+    misses = ADVISOR.misses - m0
+    graded = hits + misses
+    assert graded > 0, "advisor graded nothing (PILOSA_HEAT=0?)"
+    hit_rate = hits / graded
+    adv_doc = ADVISOR.to_doc()
+
+    # Heat overhead: replay estimator over the real query's wall p50.
+    p50, resp = sync_p50(lambda i: api.query(req_a), reps=ADV_P50_REPS)
+    assert int(resp.results[0]) == want_a
+    real = plans.STORE.find(resp.trace_id)
+    assert real is not None, "query plan not recorded (PILOSA_PLANS=0?)"
+    loop_n = max(1, ADV_REPLAY_N // ADV_REPLAY_LOOPS)
+    for _ in range(loop_n // 10):  # warm branches/allocator
+        HEAT.observe_plan(real)
+    best = math.inf
+    for _ in range(ADV_REPLAY_LOOPS):
+        t0 = time.perf_counter()
+        for _ in range(loop_n):
+            HEAT.observe_plan(real)
+        best = min(best, (time.perf_counter() - t0) / loop_n)
+    overhead_pct = best / p50 * 100.0
+
+    emit_raw("prefetch_advisor_hit_rate", hit_rate, "ratio", 1.0)
+    emit_raw("heat_observe_us", best * 1e6, "us", 1.0)
+    emit_raw("heat_overhead_pct", overhead_pct, "pct", 1.0)
+    progress(
+        f"advisor: {hits}/{graded} advised rows hit "
+        f"(rate {hit_rate:.3f}, target >=0.7; "
+        f"{adv_doc['adviceSets']} advice sets over "
+        f"{adv_doc['learnedSignatures']} learned signatures); "
+        f"heat observe {best * 1e6:.2f}us / query p50 "
+        f"{p50 * 1e6:.1f}us = {overhead_pct:.3f}% (target <2%)"
+    )
+    eng.close()
+    holder.close()
+
+
 HIST_P50_REPS = 48  # wall p50 of the real query (reference series)
 HIST_TICK_N = 240  # total sampler ticks timed (numerator)
 HIST_TICK_LOOPS = 8  # numerator = best (min) mean over this many loops
@@ -3593,6 +3710,18 @@ if __name__ == "__main__":
         "baselined — docs/observability.md)",
     )
     ap.add_argument(
+        "--advisor-sweep",
+        action="store_true",
+        help="run the prefetch-advisor sweep ONLY: two dashboard-shaped "
+        "Counts over disjoint row ranges alternate through the real "
+        "api/engine path (result memo off) so the heat recorder feeds "
+        "the sequence miner and the advisor; emits "
+        "prefetch_advisor_hit_rate (advised-row hits over the scored "
+        "alternations, target >=0.7) and heat_overhead_pct (replayed "
+        "HEAT.observe_plan cost over the query wall p50, target <2%%) "
+        "(docs/observability.md \"Working-set heat & sequences\")",
+    )
+    ap.add_argument(
         "--history-overhead",
         action="store_true",
         help="run the metrics-history sampler overhead micro-mode ONLY: "
@@ -3620,6 +3749,8 @@ if __name__ == "__main__":
         )
     elif args.profile_overhead:
         profile_overhead_bench()
+    elif args.advisor_sweep:
+        advisor_sweep()
     elif args.history_overhead:
         history_overhead_bench()
     elif args.repair_sweep:

@@ -48,6 +48,7 @@ from ..core.view import VIEW_STANDARD, view_bsi_name
 from ..ops import bitops
 from ..pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
 from ..util import events as events_mod
+from ..util import heat as heat_mod
 from ..util import plans as plans_mod
 from ..util.stats import (
     COMPILE_PHASES,
@@ -65,6 +66,7 @@ from ..util.stats import (
     METRIC_ENGINE_FUSED_MASKS_REF,
     METRIC_ENGINE_FUSED_PROGRAMS,
     METRIC_ENGINE_FUSED_QUERIES,
+    METRIC_ENGINE_PROMOTIONS,
     METRIC_ENGINE_REBUILDS,
     METRIC_ENGINE_RESIDENT_BLOCK_FRACTION,
     METRIC_ENGINE_RESIDENT_BYTES,
@@ -746,6 +748,16 @@ class MeshEngine:
         # into background working-set promotions + host-tier fallbacks
         # instead of blocking uploads or OOMs.
         self.residency = residency_mod.ResidencyManager(self)
+        # Working-set heat (docs/observability.md): the recorder asks
+        # this engine for the resident-vs-host split behind the
+        # /debug/heat tables and the pilosa_engine_residency_gap_bytes
+        # gauge.  Weak binding — heat must not pin a closed engine.
+        heat_mod.HEAT.bind_engine(self)
+        # Warm-start admissions count as promotions with their own
+        # cause label (the residency worker owns cause=reactive).
+        self._promotions_warm_counter = REGISTRY.counter(
+            METRIC_ENGINE_PROMOTIONS, cause="warm_start"
+        )
         # Queries answered from the host tier because their stack (or
         # the rows they touch) was not resident (bench's hit-rate
         # numerator pairs this with the stack cache-hit counter).
@@ -1161,6 +1173,69 @@ class MeshEngine:
         resident-block summaries the residency layer keeps per stack."""
         return bitops.WORDS * 4 + 16
 
+    def residency_row_split(self, key, rows):
+        """(resident_row_subset, per_row_device_bytes) for ``key`` over
+        ``rows`` — the heat recorder's resident-vs-host split and the
+        pilosa_engine_residency_gap_bytes numerator.  Read-only: a
+        quick row_index membership walk under the stacks lock, never a
+        build or sync."""
+        with self._stacks_lock:
+            st = self._stacks.get(key)
+            if st is not None:
+                resident = {r for r in rows if int(r) in st.row_index}
+                S = (
+                    int(st.matrix.shape[1])
+                    if hasattr(st.matrix, "shape")
+                    else pad_shards(len(st.shards), self.mesh)
+                )
+                return resident, S * self._row_shard_bytes()
+        # No stack at all: nothing resident; price a row off the live
+        # canonical shard axis (outside the lock — canonical_shards is
+        # its own cached walk).
+        canonical = self.canonical_shards(key[0])
+        S = pad_shards(len(canonical), self.mesh) if canonical else 0
+        return set(), S * self._row_shard_bytes()
+
+    # -- working-set touch notes (util/heat.py) -----------------------------
+
+    @staticmethod
+    def _touch_of(key, st, rows):
+        """One heat-note touch tuple for ``key``: rows (None = whole
+        stack) plus their exact occupied-block count and the OR of
+        their 64-bit occupancy masks, read from the stack's host-side
+        summary (no device traffic)."""
+        if rows is None:
+            return (key[0], key[1], key[2], None, 0, 0)
+        rows_t = tuple(sorted(int(r) for r in rows))
+        n_blocks = 0
+        mask = 0
+        if st is not None and st.occ is not None:
+            R = st.occ.shape[0]
+            for r in rows_t:
+                ridx = st.row_index.get(r)
+                if ridx is None or ridx >= R:
+                    continue
+                m = int(np.bitwise_or.reduce(st.occ[ridx]))
+                n_blocks += m.bit_count()
+                mask |= m
+        return (key[0], key[1], key[2], rows_t, n_blocks, mask)
+
+    def _note_touches(self, lw: "_Lowering"):
+        """Stamp the dispatch note with the (index, field, view, rows,
+        blocks) touches this lowering's row hints resolve to — the heat
+        recorder's input.  Early-out when plans or heat are disabled so
+        the serving path pays nothing."""
+        if not (plans_mod.ENABLED and heat_mod.HEAT.enabled):
+            return
+        if not lw.row_hints:
+            return
+        touches = [
+            self._touch_of(key, lw._stacks.get(key), rows)
+            for key, rows in lw.row_hints.items()
+        ]
+        if touches:
+            plans_mod.note_dispatch(touches=touches)
+
     def _row_universe(self, index, field, view, canonical) -> List[int]:
         """Sorted distinct row ids across the view's local fragments —
         the denominator of partial residency and the input to the
@@ -1235,11 +1310,19 @@ class MeshEngine:
         if not quiet:
             self.host_fallbacks += 1
             self.residency.note_host_fallback()
-        self.residency.request(key, rows)
+        self.residency.request(key, rows, cause="reactive")
+        # The miss IS a working-set touch: the heat recorder sees the
+        # rows this query wanted even though no device bytes moved, so
+        # the residency-gap gauge rises the moment traffic outruns
+        # promotion (not only once promotions land).
         plans_mod.note_dispatch(
             path="host_fallback",
             stack="/".join(key),
             resident_fraction=round(fraction, 4),
+            touches=[(
+                key[0], key[1], key[2],
+                None if rows is None else tuple(sorted(rows)), 0, 0,
+            )],
         )
         raise ResidencyMiss(msg, key=key, resident_fraction=fraction)
 
@@ -1513,6 +1596,20 @@ class MeshEngine:
             )
             self._stacks[key] = stack
             self._resident_bytes += stack.footprint
+            # Warm-start admissions are promotions too — same journal
+            # event and counter as the residency worker's, with their
+            # own cause so /debug/events and the {cause=} series tell
+            # boot-time warming apart from traffic-chasing promotion.
+            self._promotions_warm_counter.inc()
+            if not self._closing_down:
+                self.journal.append(
+                    "engine.promotion",
+                    index=index, field=field, view=view,
+                    cause="warm_start", partial=False,
+                    rows=len(row_index),
+                    universeRows=len(row_index),
+                    bytes=int(mat.nbytes),
+                )
             return True
 
     # Warming admits only up to this fraction of the device budget —
@@ -1568,10 +1665,12 @@ class MeshEngine:
     # one full-row scatter.
     PROMOTE_SPARSE_ROW = 0.5
 
-    def _promote(self, key, rows):
+    def _promote(self, key, rows, cause="reactive", trace_id=""):
         """Promote ``key``'s working set into device residency; runs on
         the ResidencyManager worker thread.  ``rows`` is the merged row
-        set misses requested (None = full stack required).  Returns
+        set misses requested (None = full stack required); ``cause`` and
+        ``trace_id`` carry the triggering request's origin into the
+        ``engine.promotion`` journal event.  Returns
         (outcome, device_bytes_shipped) with outcome one of
         "full" | "partial" | "declined" | "skipped".
 
@@ -1659,6 +1758,7 @@ class MeshEngine:
                     universe_rows=len(universe),
                     universe_blocks=universe_blocks,
                     shipped=int(assembled[3].nbytes),
+                    cause=cause, trace_id=trace_id,
                 )
             finally:
                 if credited:
@@ -1711,6 +1811,7 @@ class MeshEngine:
                 key, canonical, token, frag_sync, row_index, mat, occ,
                 partial=True, absent=absent, universe_rows=len(universe),
                 universe_blocks=universe_blocks, shipped=shipped,
+                cause=cause, trace_id=trace_id,
             )
         finally:
             if credited:
@@ -1764,7 +1865,8 @@ class MeshEngine:
 
     def _commit_promotion(self, key, canonical, token, frag_sync, row_index,
                           mat, occ, partial, absent, universe_rows, shipped,
-                          universe_blocks=None):
+                          universe_blocks=None, cause="reactive",
+                          trace_id=""):
         """Admit a promoted matrix under the engine locks with the
         version-token gate: stale identities abort, and a version
         advanced by a mid-promotion write reconciles IMMEDIATELY
@@ -1816,10 +1918,16 @@ class MeshEngine:
                     plans_mod.take_dispatch_note()
                     return "declined", shipped
             if not self._closing_down:
+                # Causality: the event carries WHY the stack moved and
+                # the trace id of the query that triggered it, so
+                # /debug/events?type=engine joins promotions to traffic
+                # (PR 4's eviction events already do this for the
+                # other direction).
                 self.journal.append(
-                    "engine.promote",
+                    "engine.promotion",
+                    trace_id=trace_id or None,
                     index=index, field=field, view=view,
-                    partial=bool(partial),
+                    cause=cause, partial=bool(partial),
                     rows=len(row_index), universeRows=int(universe_rows),
                     bytes=int(shipped),
                 )
@@ -2765,6 +2873,7 @@ class MeshEngine:
         mask = self._mask_words(shards, canonical)
         plan = self._sparse_plan(prog, lw, shards, canonical)
         self._note_fused_dispatch()
+        self._note_touches(lw)
         if plan is not None:
             return self._dispatch_sparse(plan, mask)
         plans_mod.note_dispatch(
@@ -3493,6 +3602,7 @@ class MeshEngine:
             mask1 = self._mask_words(u_shards[0], canonical)
             plan = self._sparse_plan(prog1, lw1, u_shards[0], canonical)
             self._note_fused_dispatch()
+            self._note_touches(lw1)
             plans_mod.note_dispatch(
                 cse_unique=1, cse_deduped=deduped, batch_size=len(calls)
             )
@@ -3533,6 +3643,7 @@ class MeshEngine:
             progs.append((prog, i_mask))
         lw.finish()
         self._note_fused_dispatch()
+        self._note_touches(lw)
         plans_mod.note_dispatch(
             op="Count", path="dense_batch", fused=True,
             cse_unique=len(u_calls), cse_deduped=deduped,
@@ -4471,6 +4582,14 @@ class MeshEngine:
             METRIC_MESH_SHARDS_PER_DEVICE,
             pad_shards(widest, self.mesh) // n_dev if widest else 0,
         )
+        # Working-set heat gauges (tracked rows + residency gap): the
+        # recorder walks its tables and asks this engine for the
+        # resident split — refreshed at scrape so /metrics and
+        # /debug/heat never disagree.
+        try:
+            heat_mod.HEAT.refresh_gauges()
+        except Exception:  # noqa: BLE001 — telemetry never fails a scrape
+            pass
 
     def _working_set_snapshot(self) -> dict:
         """Per-index resident-vs-total working-set accounting for

@@ -40,6 +40,7 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
+from ..util import heat as heat_mod
 from ..util import plans as plans_mod
 from . import kernels
 from .mesh import put_global
@@ -372,6 +373,45 @@ def _slot_refs(prog, out: set):
         if isinstance(p, tuple):
             _slot_refs(p, out)
     return out
+
+
+def _item_touches(engine, index, spec, stacks):
+    """Working-set touches of ONE fused item (util/heat.py note
+    format): every (index, field, view) stack the item reads and the
+    row ids it reads there (None = the whole stack, e.g. a BSI plane
+    walk or a TopN candidate sweep).  ``stacks`` is the drain's merged
+    (index, field, view) -> stack map so occupied-block counts come
+    from the same summaries the dispatch used."""
+    from ..core.view import VIEW_STANDARD, view_bsi_name
+
+    kind = spec["kind"]
+    hints: dict = {}
+    if kind == "count":
+        hints = engine._collect_row_hints(index, spec["call"])
+    elif kind in ("sum", "min", "max"):
+        hints[(index, spec["field"], view_bsi_name(spec["field"]))] = None
+        if spec.get("filter") is not None:
+            engine._collect_row_hints(index, spec["filter"], hints)
+    elif kind == "topn":
+        hints[(index, spec["field"], VIEW_STANDARD)] = {
+            int(r) for r in spec["rows"]
+        }
+        engine._collect_row_hints(index, spec["src"], hints)
+    elif kind == "topnf":
+        # Ranked-cache candidate sweep: the whole standard stack.
+        hints[(index, spec["field"], VIEW_STANDARD)] = None
+        engine._collect_row_hints(index, spec["src"], hints)
+    elif kind == "group":
+        for fname, rows in zip(
+            spec.get("fields") or (), spec.get("rows") or ()
+        ):
+            hints[(index, fname, VIEW_STANDARD)] = {int(r) for r in rows}
+        if spec.get("filter") is not None:
+            engine._collect_row_hints(index, spec["filter"], hints)
+    return [
+        engine._touch_of(key, stacks.get(key), rows)
+        for key, rows in hints.items()
+    ]
 
 
 def build(engine, entries: List[tuple]) -> FusedPlan:
@@ -770,6 +810,13 @@ def build(engine, entries: List[tuple]) -> FusedPlan:
     masks_evaluated = len(slots)
     masks_referenced = refs_total[0]
     indexes = sorted({idx for idx, _, _ in entries})
+    # Per-item working-set touches (util/heat.py): resolved against the
+    # drain's merged stack map so peeled and fused items alike report
+    # exact occupied blocks.  The SHARED dispatch note stays touch-free
+    # — the batcher overlays each rider's item note onto its divided
+    # copy, so every plan carries only ITS OWN touches.
+    stacks_all = {**peel_stacks, **lw._stacks}
+    note_touches = plans_mod.ENABLED and heat_mod.HEAT.enabled
     for i in range(n_items):
         if routes[i] is None or routes[i][0] == "error":
             continue
@@ -789,6 +836,15 @@ def build(engine, entries: List[tuple]) -> FusedPlan:
             note.update(sparse_notes[i])
             note["op"] = "Count"
             note["path"] = "sparse"
+        if note_touches:
+            try:
+                touches = _item_touches(
+                    engine, entries[i][0], entries[i][1], stacks_all
+                )
+                if touches:
+                    note["touches"] = touches
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         item_notes[i] = note
 
     # -- tier padding (compile-key discipline) ------------------------------

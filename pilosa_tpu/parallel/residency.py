@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Dict, Optional, Set, Tuple
 
+from ..util import tracing
 from ..util.stats import (
     METRIC_ENGINE_HOST_FALLBACKS,
     METRIC_ENGINE_PARTIAL_PROMOTIONS,
@@ -64,10 +65,14 @@ class ResidencyManager:
     def __init__(self, engine):
         self._engine = engine
         self._cv = threading.Condition()
-        # key -> requested row set, or None meaning "full stack required"
-        # (aggregate paths: BSI planes, TopN candidates).  None absorbs
-        # any row set it merges with.
-        self._pending: "Dict[Key, Optional[Set[int]]]" = {}
+        # key -> [rows, cause, trace_id]: rows is the requested row set
+        # or None meaning "full stack required" (aggregate paths: BSI
+        # planes, TopN candidates) — None absorbs any row set it merges
+        # with.  cause/trace_id record WHY the first request fired (the
+        # engine.promotion journal event + the {cause=} label on
+        # pilosa_engine_promotions_total): the first cause wins a merge
+        # and the first non-empty trace id is kept.
+        self._pending: "Dict[Key, list]" = {}
         # key -> (deadline, declined_request_was_full): a declined FULL
         # promotion must not absorb later row-hinted requests — the
         # partial working set may well fit even though the whole stack
@@ -85,7 +90,9 @@ class ResidencyManager:
         self.dropped = 0  # queue-overflow requests (host tier serves)
         self.promoted_bytes = 0
         self.promote_seconds = 0.0
-        self._c_full = REGISTRY.counter(METRIC_ENGINE_PROMOTIONS)
+        # Full-promotion counters resolve per cause at inc time (the
+        # {cause=} label on pilosa_engine_promotions_total).
+        self._c_full: Dict[str, object] = {}
         self._c_partial = REGISTRY.counter(METRIC_ENGINE_PARTIAL_PROMOTIONS)
         self._c_declined = REGISTRY.counter(METRIC_ENGINE_PROMOTIONS_DECLINED)
         self._c_bytes = REGISTRY.counter(METRIC_ENGINE_PROMOTED_BYTES)
@@ -93,12 +100,21 @@ class ResidencyManager:
 
     # -- request side (engine miss paths) -----------------------------------
 
-    def request(self, key: Key, rows: Optional[Set[int]] = None) -> bool:
+    def request(self, key: Key, rows: Optional[Set[int]] = None,
+                cause: str = "reactive",
+                trace_id: Optional[str] = None) -> bool:
         """Enqueue (or merge into) a promotion for ``key``.  ``rows`` is
         the row-id working set the triggering query touched; None means
-        the whole stack is required.  Returns False when the request was
-        absorbed by a cooldown or the queue bound (the host tier keeps
-        serving either way).  Never blocks on device work."""
+        the whole stack is required.  ``cause`` labels the promotion's
+        origin ("reactive" | "warm_start" | "advisor") and ``trace_id``
+        joins it to the triggering query's trace (defaulting to the
+        ambient span, so an engine miss inherits its query's trace
+        without plumbing).  Returns False when the request was absorbed
+        by a cooldown or the queue bound (the host tier keeps serving
+        either way).  Never blocks on device work."""
+        if trace_id is None:
+            span = tracing.current_span()
+            trace_id = span.trace_id if span is not None else ""
         with self._cv:
             if self._closed:
                 return False
@@ -112,14 +128,18 @@ class ResidencyManager:
             if key in self._pending:
                 cur = self._pending[key]
                 if rows is None:
-                    self._pending[key] = None
-                elif cur is not None:
-                    cur.update(rows)
+                    cur[0] = None
+                elif cur[0] is not None:
+                    cur[0].update(rows)
+                if not cur[2] and trace_id:
+                    cur[2] = trace_id
             else:
                 if len(self._pending) >= MAX_PENDING:
                     self.dropped += 1
                     return False
-                self._pending[key] = None if rows is None else set(rows)
+                self._pending[key] = [
+                    None if rows is None else set(rows), cause, trace_id,
+                ]
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._run, name="residency-promote", daemon=True
@@ -127,6 +147,14 @@ class ResidencyManager:
                 self._thread.start()
             self._cv.notify()
             return True
+
+    def _full_counter(self, cause: str):
+        c = self._c_full.get(cause)
+        if c is None:
+            c = self._c_full[cause] = REGISTRY.counter(
+                METRIC_ENGINE_PROMOTIONS, cause=cause
+            )
+        return c
 
     def note_host_fallback(self):
         """One query served from the host tier while its stack promotes
@@ -161,12 +189,14 @@ class ResidencyManager:
                 if self._closed:
                     return
                 key = next(iter(self._pending))
-                rows = self._pending.pop(key)
+                rows, cause, trace_id = self._pending.pop(key)
                 self._busy = True
             try:
                 t0 = time.perf_counter()
                 try:
-                    outcome, shipped = self._engine._promote(key, rows)
+                    outcome, shipped = self._engine._promote(
+                        key, rows, cause=cause, trace_id=trace_id
+                    )
                 except Exception as e:  # noqa: BLE001 — worker survives
                     self._engine._log(f"residency promote {key}: {e!r}")
                     outcome, shipped = "declined", 0
@@ -176,7 +206,7 @@ class ResidencyManager:
                     self._c_bytes.inc(shipped)
                 if outcome == "full":
                     self.promotions += 1
-                    self._c_full.inc()
+                    self._full_counter(cause).inc()
                 elif outcome == "partial":
                     self.partial_promotions += 1
                     self._c_partial.inc()

@@ -673,6 +673,28 @@ class TenantLedger:
 STORE = PlanStore()
 LEDGER = TenantLedger()
 
+# Finish-side observers: callables invoked with every recorded plan
+# AFTER the ring + ledger update.  This is the one seam the working-set
+# telemetry layer (util/heat.py: heat tables, the sequence miner, the
+# prefetch advisor) hangs off — observers see the SAME plan records the
+# ledger accounts, so derived byte tallies can never drift from the
+# pilosa_tenant_* / bytes-skipped counters.  Observers must be cheap
+# and must never raise (each call is fenced regardless).
+_OBSERVERS: List = []
+
+
+def add_observer(fn):
+    """Register a finish-side plan observer (idempotent per fn)."""
+    if fn not in _OBSERVERS:
+        _OBSERVERS.append(fn)
+
+
+def remove_observer(fn):
+    try:
+        _OBSERVERS.remove(fn)
+    except ValueError:
+        pass
+
 
 def begin(index: str, query: str, tenant: str = "default",
           profile: bool = False) -> Optional[QueryPlan]:
@@ -683,8 +705,14 @@ def begin(index: str, query: str, tenant: str = "default",
 
 
 def record(plan: Optional[QueryPlan]):
-    """Finish-side entry point: ring + analyzer + tenant ledger."""
+    """Finish-side entry point: ring + analyzer + tenant ledger +
+    telemetry observers."""
     if plan is None:
         return
     STORE.record(plan)
     LEDGER.account(plan)
+    for fn in _OBSERVERS:
+        try:
+            fn(plan)
+        except Exception:  # noqa: BLE001 — telemetry never fails a query
+            pass

@@ -153,6 +153,15 @@ AUTO_REQUIRE = (
     # ABS_CEILING) and the 1h-window /debug/history read p50.
     "history_sampler_overhead_pct",
     "history_query_p50_ms",
+    # Prefetch-advisor prediction quality + heat-recorder cost
+    # (bench.py --advisor-sweep, docs/observability.md "Working-set
+    # heat & sequences"): hit rate regresses DOWN (higher-better
+    # override + the ISSUE 19 >=0.7 floor below) and the heat
+    # recorder's per-query overhead regresses UP (<2% via ABS_CEILING,
+    # the profile_overhead_pct methodology).  Required once baselined
+    # so the telemetry-substrate lane cannot be silently dropped.
+    "prefetch_advisor_hit_rate",
+    "heat_overhead_pct",
 )
 
 # Direction overrides for metrics whose UNIT would mislead: the unit
@@ -168,6 +177,7 @@ NAME_HIGHER_BETTER = {
     "dashboard_crossindex_fused_speedup",
     "residency_hit_rate",
     "result_memo_hit_rate_under_write_load",
+    "prefetch_advisor_hit_rate",
 }
 
 # Built-in per-metric tolerance (used when no --metric-tolerance names
@@ -195,6 +205,9 @@ DEFAULT_METRIC_TOL = {
     # floor/ceiling below carry the binding ISSUE 16 contracts.
     "result_memo_hit_rate_under_write_load": 0.5,
     "dashboard_p50_under_ingest_vs_idle": 0.5,
+    # Replay-estimator-over-wall-p50 ratio (same shape as
+    # profile_overhead_pct); the absolute <2% ceiling below binds.
+    "heat_overhead_pct": 1.0,
 }
 
 # Absolute ceilings enforced regardless of the baseline value: crossing
@@ -208,6 +221,10 @@ ABS_CEILING = {
     # stays within 1.5x of its idle p50 (repair keeps serves O(changed
     # bits) instead of O(data) recomputes).
     "dashboard_p50_under_ingest_vs_idle": 1.5,
+    # ISSUE 19 acceptance: the heat recorder's per-query cost (heat
+    # tables + miner transition + advisor grade/learn/advise) stays
+    # under 2% of the query wall p50.
+    "heat_overhead_pct": 2.0,
 }
 
 # Absolute floors, the ceiling's dual: availability under failure below
@@ -228,6 +245,10 @@ ABS_FLOOR = {
     # ISSUE 16 acceptance: under write load the dashboard still answers
     # >=0.8 of its queries from the memo or an O(changed-bits) repair.
     "result_memo_hit_rate_under_write_load": 0.8,
+    # ISSUE 19 acceptance: on the alternating two-dashboard replay the
+    # advisor's advised rows hit >=0.7 of the rows the next query
+    # actually touched.
+    "prefetch_advisor_hit_rate": 0.7,
 }
 
 

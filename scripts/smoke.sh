@@ -100,6 +100,13 @@ required = [
     "pilosa_engine_promotions_declined_total",
     "pilosa_engine_host_fallbacks_total",
     "pilosa_engine_resident_block_fraction",
+    # Working-set heat + prefetch advisor (docs/observability.md
+    # "Working-set heat & sequences").
+    "pilosa_engine_heat_tracked_rows",
+    "pilosa_engine_residency_gap_bytes",
+    "pilosa_advisor_predictions_total",
+    "pilosa_advisor_hits_total",
+    "pilosa_advisor_misses_total",
 ]
 missing = [s for s in required if s not in text]
 assert not missing, f"/metrics is missing required series: {missing}"
@@ -1344,6 +1351,200 @@ try:
         "fault-forced burn (slo.burn journaled, /readyz degraded, "
         "persisted .flightrec bundle with the breaching window) -> heal "
         "-> slo.clear"
+    )
+finally:
+    srv.close()
+EOF
+
+# Working-set heat lane (docs/observability.md "Working-set heat &
+# sequences"): boot a full Server with 1s history sampling and a device
+# budget that fits only 3 of the 4 hot rows, then repeat the
+# two-dashboard pattern (A = Row(f=0)&Row(f=1), B = Row(f=8)&Row(f=9)).
+# Assert (a) /debug/heat ranks exactly the touched rows, (b)
+# /debug/sequences learned the A->B transition, (c)
+# /debug/prefetch_advice names B's rows right after A is served and the
+# advisor's self-score is high, (d) the residency gap gauge is >0 while
+# both dashboards are hot (4 hot rows, 3-row budget) with the rise
+# queryable from the _system history, and (e) the gap drains to 0 after
+# the working set shifts to A only (B's rows decay cold).
+env JAX_PLATFORMS=cpu PILOSA_TPU_MESH_DEVICES=1 python - <<'EOF'
+import json
+import os
+import tempfile
+import time
+import urllib.request
+
+from pilosa_tpu.config import Config
+from pilosa_tpu.server import Server
+
+ROW_SHARD = 32768 * 4 + 16
+tmp = tempfile.mkdtemp()
+cfg = Config()
+cfg.data_dir = os.path.join(tmp, "heat")
+cfg.bind = "localhost:0"
+cfg.obs_history = True
+cfg.obs_sample_interval = 1.0
+cfg.obs_retention = 600.0
+# 3 of the 4 hot rows fit: alternating dashboards leave a standing
+# residency gap; the A-only shift lets it drain back to 0.
+cfg.engine_device_budget_bytes = 3 * ROW_SHARD
+srv = Server(cfg)
+srv.open(port_override=0)
+port = srv.port
+# The lane exercises the residency + heat paths, not the result memo.
+srv.api.mesh_engine.result_memo.maxsize = 0
+
+
+def get(path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://localhost:{port}{path}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def post(path, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://localhost:{port}{path}", data=body, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def scrape():
+    return urllib.request.urlopen(
+        f"http://localhost:{port}/metrics", timeout=30
+    ).read().decode()
+
+
+def sample(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rpartition(" ")[2])
+    return None
+
+
+try:
+    post("/index/hsmoke", b"{}")
+    post("/index/hsmoke/field/f", b'{"options": {"type": "set"}}')
+    rows, cols = [], []
+    for r in (0, 1, 8, 9):
+        for c in range(0, 48 + 2 * r, 2):
+            rows.append(r)
+            cols.append(c)
+    post(
+        "/index/hsmoke/field/f/import",
+        json.dumps({"rowIDs": rows, "columnIDs": cols}).encode(),
+    )
+
+    A = b"Count(Intersect(Row(f=0), Row(f=1)))"
+    B = b"Count(Intersect(Row(f=8), Row(f=9)))"
+
+    def q(body):
+        return post("/index/hsmoke/query", body, timeout=60)["results"][0]
+
+    def want(r1, r2):
+        s1 = {c for r, c in zip(rows, cols) if r == r1}
+        s2 = {c for r, c in zip(rows, cols) if r == r2}
+        return len(s1 & s2)
+
+    wa, wb = want(0, 1), want(8, 9)
+
+    # Two-dashboard pattern; short sleeps let the 1s history sampler
+    # catch the standing gap while all four rows stay hot.
+    for _ in range(16):
+        assert q(A) == wa
+        assert q(B) == wb
+        time.sleep(0.2)
+
+    # (a) /debug/heat ranks the touched rows, with the residency split.
+    doc = get("/debug/heat?index=hsmoke&field=f&topk=8")
+    assert doc["tables"], doc
+    tab = doc["tables"][0]
+    top = {r["row"] for r in tab["topRows"]}
+    assert {0, 1, 8, 9} <= top, tab["topRows"]
+    assert tab["hotRows"] >= 4, tab
+    assert tab["topBlocks"], tab
+
+    # (d) standing gap: 4 hot rows, 3-row budget.  The gauge is
+    # refreshed by /debug/heat and by the sampler's pre-tick hook.
+    assert tab["gapBytes"] > 0, tab
+    text = scrape()
+    assert (sample(text, "pilosa_engine_heat_tracked_rows") or 0) >= 4, (
+        "heat tracked-rows gauge never rose")
+    assert (sample(text, "pilosa_engine_residency_gap_bytes") or 0) > 0, (
+        "standing residency gap not visible at /metrics")
+
+    # (b) the miner learned the A->B transition.
+    doc = get("/debug/sequences?top=3")
+    assert doc["observed"] >= 30 and doc["edgesObserved"] >= 20, doc
+    a_to_b = [
+        t for t in doc["transitions"]
+        if "Row(f=0)" in t["signature"]
+        and any("Row(f=8)" in n["signature"] for n in t["next"])
+    ]
+    assert a_to_b, doc["transitions"]
+    p = max(
+        n["p"] for t in a_to_b for n in t["next"]
+        if "Row(f=8)" in n["signature"]
+    )
+    assert p >= 0.4, f"A->B learned at p={p}"
+
+    # (c) right after A is served, the outstanding advice names B's
+    # rows — and the running self-score is near-perfect on this
+    # perfectly alternating traffic.
+    assert q(A) == wa
+    doc = get("/debug/prefetch_advice")
+    out = doc["outstanding"]
+    assert out is not None and "Row(f=8)" in out["predictedSignature"], doc
+    hinted = sorted(
+        r for h in out["hints"]
+        if h["index"] == "hsmoke" and h["field"] == "f"
+        for r in h["rows"]
+    )
+    assert hinted == [8, 9], out
+    assert doc["hits"] > 0 and (doc["hitRate"] or 0) >= 0.9, doc
+    hit_rate = doc["hitRate"]
+    text = scrape()
+    assert (sample(text, "pilosa_advisor_predictions_total") or 0) > 0, text
+    assert (sample(text, "pilosa_advisor_hits_total") or 0) > 0, text
+
+    # (e) working-set shift: A only.  B's rows decay below the hot
+    # threshold and the gap drains to 0 (the hot set now fits).
+    deadline = time.monotonic() + 90
+    while True:
+        for _ in range(8):
+            assert q(A) == wa
+        gap = sum(
+            t["gapBytes"]
+            for t in get("/debug/heat?index=hsmoke")["tables"]
+        )
+        if gap == 0:
+            break
+        assert time.monotonic() < deadline, (
+            f"residency gap never drained after the shift to A ({gap})")
+        time.sleep(0.2)
+
+    # The rise-then-drain is queryable from the _system history: the
+    # sampled gap series carries a >0 point from the alternation phase
+    # and a ==0 point after the drain.
+    deadline = time.monotonic() + 30
+    while True:
+        doc = get("/debug/history?series=pilosa_engine_residency_gap_bytes")
+        pts = [v for p in doc["points"].values() for _t, v in p]
+        rose = any(v > 0 for v in pts)
+        drained = bool(pts) and pts[-1] == 0
+        if rose and drained:
+            break
+        assert time.monotonic() < deadline, (
+            f"history gap series missing rise-then-drain: {pts}")
+        time.sleep(0.5)
+    print(
+        "heat lane OK: /debug/heat ranked the hot rows -> /debug/sequences "
+        f"learned A->B (p={p}) -> /debug/prefetch_advice named B's rows "
+        f"[8, 9] after A (hitRate {hit_rate}) -> "
+        "residency gap rose under the 2-dashboard working set and drained "
+        "to 0 after the shift to A, with the rise-then-drain queryable "
+        "from the _system history"
     )
 finally:
     srv.close()

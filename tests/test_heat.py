@@ -1,0 +1,400 @@
+"""Working-set heat maps, sequence mining, and the prefetch advisor
+(docs/observability.md "Working-set heat & sequences", ISSUE 19).
+
+Differential discipline: the heat recorder consumes the SAME
+per-dispatch plan notes the tenant ledger accounts, so its byte totals
+must reconcile exactly with the ledger deltas for the same traffic —
+pinned here, not approximated.  The miner is pinned to exact
+probabilities on deterministic sequences, the advisor to a perfect
+score on a learnable alternation and to silence on cold starts, and
+promotion causality to the journal/counter labels the residency worker
+emits.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API, QueryRequest
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.ops import SHARD_WIDTH
+from pilosa_tpu.ops.bitops import OCC_BLOCK_BITS
+from pilosa_tpu.parallel import MeshEngine, make_mesh
+from pilosa_tpu.parallel.advisor import PrefetchAdvisor
+from pilosa_tpu.parallel.residency import ResidencyManager
+from pilosa_tpu.util import plan_miner, plans
+from pilosa_tpu.util.heat import HEAT, HOT_HEAT
+from pilosa_tpu.util.stats import (
+    METRIC_ENGINE_PROMOTIONS,
+    REGISTRY,
+)
+
+# One (row, shard) of device words + summaries (engine._row_shard_bytes).
+ROW_SHARD = 32768 * 4 + 16
+
+INTERSECT = "Count(Intersect(Row(f=1), Row(f=2)))"
+UNION = "Count(Union(Row(f=1), Row(f=2)))"
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(1)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Each test starts from empty heat/miner/advisor singletons (they
+    are process-wide and other suites record plans too)."""
+    HEAT.reset()
+    plan_miner.MINER.reset()
+    yield
+    HEAT.reset()
+    plan_miner.MINER.reset()
+
+
+def _api(mesh, rows_blocks=None, n_shards=4):
+    holder = Holder()
+    holder.open()
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(7)
+    rows_blocks = rows_blocks or {1: (0, 1), 2: (1, 3)}
+    row_ids, cols = [], []
+    for s in range(n_shards):
+        base = s * SHARD_WIDTH
+        for r, blocks in rows_blocks.items():
+            for b in blocks:
+                for c in rng.choice(OCC_BLOCK_BITS, size=30, replace=False):
+                    row_ids.append(r)
+                    cols.append(base + b * OCC_BLOCK_BITS + int(c))
+    f.import_bulk(row_ids, cols)
+    eng = MeshEngine(holder, mesh)
+    return API(holder=holder, mesh_engine=eng), eng, f
+
+
+def _build_oversub(holder, n_rows=16):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    rows, cols = [], []
+    for r in range(n_rows):
+        for c in range(0, 400 + 10 * r, 2):
+            rows.append(r)
+            cols.append(c)
+    f.import_bulk(rows, cols)
+    return idx
+
+
+# -- heat <-> ledger differential -------------------------------------------
+
+
+def test_heat_bytes_reconcile_with_ledger(mesh):
+    """The drift-free-by-construction contract: heat byte totals equal
+    the tenant ledger's bytesTouched delta for the same queries — both
+    read the same per-dispatch notes off the same plan objects."""
+    api, eng, _ = _api(mesh)
+    led0 = plans.LEDGER.snapshot().get("default", {}).get("bytesTouched", 0)
+    for q in (INTERSECT, UNION):
+        api.query(QueryRequest("i", q))
+    t = HEAT.totals()
+    assert t["plansObserved"] == 2
+    # Internal reconciliation: every accounted byte is in exactly one
+    # bucket.
+    assert t["bytesAccounted"] == t["tableBytes"] + t["untrackedBytes"]
+    assert t["bytesAccounted"] > 0
+    # External reconciliation: identical to the ledger delta.
+    led1 = plans.LEDGER.snapshot().get("default", {}).get("bytesTouched", 0)
+    assert t["bytesAccounted"] == led1 - led0
+    eng.close()
+
+
+def test_memo_hit_replays_touches_byte_free(mesh):
+    """A memoized serve runs no dispatch, but the query still logically
+    touched its working set: rows stay warm with ZERO new bytes (the
+    ledger agrees — no bytes moved)."""
+    api, eng, _ = _api(mesh)
+    api.query(QueryRequest("i", INTERSECT))
+    t1 = HEAT.totals()
+    doc1 = HEAT.to_doc(index="i", field="f")
+    touches1 = sum(t["touches"] for t in doc1["tables"])
+    api.query(QueryRequest("i", INTERSECT))  # memo hit
+    t2 = HEAT.totals()
+    assert t2["plansObserved"] == t1["plansObserved"] + 1
+    assert t2["bytesAccounted"] == t1["bytesAccounted"]
+    doc2 = HEAT.to_doc(index="i", field="f")
+    touches2 = sum(t["touches"] for t in doc2["tables"])
+    assert touches2 > touches1, "memo hit did not replay touches"
+    eng.close()
+
+
+def test_heat_ranks_touched_rows_with_residency_split(mesh):
+    api, eng, _ = _api(mesh)
+    for _ in range(3):
+        api.query(QueryRequest("i", INTERSECT))
+    doc = HEAT.to_doc(index="i", field="f", topk=5)
+    tabs = [t for t in doc["tables"] if t["view"] == "standard"]
+    assert tabs, doc
+    tab = tabs[0]
+    top = {r["row"] for r in tab["topRows"]}
+    assert {1, 2} <= top
+    for r in tab["topRows"]:
+        assert r["heat"] >= HOT_HEAT
+        assert r["resident"] is True  # small stack: fully resident
+    assert tab["hotRows"] == tab["residentHotRows"]
+    assert tab["gapBytes"] == 0
+    assert tab["topBlocks"], "no block-granular heat recorded"
+    # The gauges agree: rows tracked, no gap on a resident stack.
+    g = HEAT.refresh_gauges()
+    assert g["trackedRows"] >= 2
+    assert g["gapBytes"] == 0
+    eng.close()
+
+
+def test_underscore_indexes_do_not_pollute_the_model(mesh):
+    p = plans.begin("_system", "Count(Row(f=1))")
+    p.note_op(op="Count", path="dense", bytes_touched=100)
+    p.finish(0.01)
+    HEAT.observe_plan(p)
+    assert HEAT.totals()["plansObserved"] == 0
+
+
+# -- residency gap: rises under shift, drains after promotion ----------------
+
+
+def test_residency_gap_rises_then_drains(mesh1):
+    """Oversubscribed engine: the cold query's host fallback IS a
+    working-set touch, so the gap gauge rises the moment traffic
+    outruns promotion — and drains to zero once the promotion worker
+    lands the rows."""
+    holder = Holder()
+    holder.open()
+    _build_oversub(holder)
+    eng = MeshEngine(holder, mesh1, max_resident_bytes=4 * ROW_SHARD + 4096)
+    eng.result_memo.maxsize = 0
+    api = API(holder=holder, mesh_engine=eng)
+    q = "Count(Intersect(Row(f=10), Row(f=11)))"
+    resp = api.query(QueryRequest("i", q))
+    assert eng.host_fallbacks >= 1
+    g = HEAT.refresh_gauges()
+    assert g["gapBytes"] > 0, "host-served hot rows did not open a gap"
+    assert eng.residency.flush(30.0)
+    g = HEAT.refresh_gauges()
+    assert g["gapBytes"] == 0, "promoted working set still shows a gap"
+    # Promotion causality rode along: the journal names the cause and
+    # the triggering query's trace.
+    evs = [e for e in eng.journal.events(type="engine.promotion")
+           if e.fields.get("index") == "i"]
+    assert evs, "no engine.promotion journal event"
+    ev = evs[-1]
+    assert ev.fields["cause"] == "reactive"
+    assert ev.trace_id == resp.trace_id
+    assert ev.fields["rows"] > 0 and ev.fields["bytes"] > 0
+    eng.close()
+
+
+def test_full_promotion_counter_labeled_by_cause():
+    """The per-cause promotions counter and cause/trace plumbing
+    through the residency queue (stub engine: no device work)."""
+    calls = []
+
+    class StubEngine:
+        def _promote(self, key, rows, cause="reactive", trace_id=""):
+            calls.append((key, rows, cause, trace_id))
+            return "full", 123
+
+        def _log(self, msg):
+            pass
+
+    c = REGISTRY.counter(METRIC_ENGINE_PROMOTIONS, cause="warm_start")
+    c0 = c.get()
+    rm = ResidencyManager(StubEngine())
+    assert rm.request(("i", "f", "standard"), None,
+                      cause="warm_start", trace_id="abc123")
+    assert rm.flush(10.0)
+    assert calls == [(("i", "f", "standard"), None, "warm_start", "abc123")]
+    assert c.get() == c0 + 1
+    assert rm.promoted_bytes == 123
+    rm.close()
+
+
+# -- sequence miner ----------------------------------------------------------
+
+
+def test_transition_model_exact_probabilities():
+    m = plan_miner.TransitionModel()
+    wall = 100.0
+    # A->B three times, A->C once: p(B|A)=0.75, p(C|A)=0.25.
+    for nxt in ("B", "B", "B", "C"):
+        m.observe("A", wall)
+        wall += 0.1
+        m.observe(nxt, wall)
+        wall += 0.1
+    preds = m.predictions("A")
+    assert [(s, p, n) for s, p, _g, n in preds] == [
+        ("B", 0.75, 3), ("C", 0.25, 1),
+    ]
+    assert preds[0][2] == pytest.approx(100.0)  # avg gap ms
+    assert m.predict_next("A") == ("B", 0.75)
+
+
+def test_transition_model_window_and_cold_start():
+    m = plan_miner.TransitionModel(window_s=5.0)
+    m.observe("A", 0.0)
+    m.observe("B", 10.0)  # gap > window: unrelated sessions
+    assert m.predictions("A") == []
+    assert m.edges_observed == 0
+    # Cold start NEVER raises — unseen signatures return empty.
+    assert m.predictions("never-seen") == []
+    assert m.predict_next("never-seen") is None
+
+
+def test_transition_model_bounds():
+    m = plan_miner.TransitionModel(max_sigs=2, max_next=2)
+    wall = 0.0
+    # Successor fan-out past max_next evicts the lowest-count edge.
+    for nxt in ("B", "B", "C", "D"):
+        m.observe("A", wall)
+        wall += 0.1
+        m.observe(nxt, wall)
+        wall += 0.1
+    succ = {s for s, _p, _g, _n in m.predictions("A", top=10)}
+    assert len(succ) == 2 and "B" in succ
+    # Distinct-signature bound holds too.
+    for sig in ("X", "Y", "Z"):
+        m.observe(sig, wall)
+        wall += 0.1
+        m.observe(sig + "'", wall)
+        wall += 0.1
+    assert m.to_doc()["signatures"] <= 2
+
+
+def test_signature_canonicalizes_and_falls_back():
+    s1 = plan_miner.signature("i", "Count(Intersect(Row(f=1), Row(f=2)))")
+    s2 = plan_miner.signature("i", "Count(Intersect(Row(f=1), Row(f=2)))")
+    assert s1 == s2 and s1.startswith("i|")
+    # Unparseable text still yields a stable key.
+    s3 = plan_miner.signature("i", "garbage(((")
+    assert s3 == "i|garbage((("
+
+
+# -- prefetch advisor --------------------------------------------------------
+
+TOUCH_A = [("i", "f", "standard", (0, 1), 2, 3)]
+TOUCH_B = [("i", "f", "standard", (8, 9), 2, 3)]
+
+
+def _drive(adv, sig, touches, wall):
+    # The heat recorder's feed order: miner transition first, then the
+    # advisor consumer.
+    plan_miner.MINER.observe(sig, wall)
+    adv.observe(None, sig, touches)
+
+
+def test_advisor_learns_alternation_perfectly():
+    adv = PrefetchAdvisor()
+    wall = 0.0
+    for _ in range(4):  # learn phase
+        _drive(adv, "A", TOUCH_A, wall)
+        wall += 0.1
+        _drive(adv, "B", TOUCH_B, wall)
+        wall += 0.1
+    h0, m0 = adv.hits, adv.misses
+    for _ in range(8):  # scored phase
+        _drive(adv, "A", TOUCH_A, wall)
+        wall += 0.1
+        _drive(adv, "B", TOUCH_B, wall)
+        wall += 0.1
+    assert adv.misses == m0, "learned alternation produced misses"
+    assert adv.hits - h0 == 32  # 16 grades x 2 advised rows
+    assert adv.hit_rate() > 0.9
+    doc = adv.to_doc()
+    assert doc["drivesPromotions"] is False  # report-only this PR
+    out = doc["outstanding"]
+    assert out is not None and out["p"] >= 0.4
+    assert out["hints"][0]["rows"] in ([0, 1], [8, 9])
+
+
+def test_advisor_cold_start_is_silent():
+    adv = PrefetchAdvisor()
+    _drive(adv, "never-seen-sig", TOUCH_A, 0.0)
+    assert adv.to_doc()["outstanding"] is None
+    assert adv.predictions == 0
+
+
+def test_advisor_full_stack_touches_advise_nothing():
+    adv = PrefetchAdvisor()
+    full = [("i", "f", "bsi", None, 0, 0)]
+    wall = 0.0
+    for _ in range(3):
+        _drive(adv, "A", full, wall)
+        wall += 0.1
+        _drive(adv, "B", full, wall)
+        wall += 0.1
+    # Row-less touches hold the outstanding advice and learn nothing.
+    assert adv.to_doc()["learnedSignatures"] == 0
+    assert adv.predictions == 0
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+def test_debug_endpoints(mesh):
+    from pilosa_tpu.net.server import Handler
+
+    api, eng, _ = _api(mesh)
+    api.query(QueryRequest("i", INTERSECT))
+    api.query(QueryRequest("i", UNION))
+    h = Handler(api)
+    heat = h._debug_heat({"index": ["i"], "topk": ["5"]}, b"")
+    assert heat["tables"] and heat["tables"][0]["index"] == "i"
+    assert heat["blockBytes"] == 2048
+    seq = h._debug_sequences({"top": ["3"]}, b"")
+    assert seq["observed"] >= 2
+    # The alternation above is one observed transition.
+    assert any(t["next"] for t in seq["transitions"])
+    adv = h._debug_prefetch_advice({}, b"")
+    assert adv["drivesPromotions"] is False
+    assert "hitRate" in adv and "outstanding" in adv
+    eng.close()
+
+
+# -- offline miner CLI -------------------------------------------------------
+
+
+def test_plan_miner_cli_sequences(tmp_path):
+    t = 1000.0
+    recent = []
+    for i in range(5):
+        recent.append({"index": "i", "query": "Count(Row(f=0))",
+                       "startTime": t, "traceID": f"a{i}"})
+        t += 0.1
+        recent.append({"index": "i", "query": "Count(Row(f=8))",
+                       "startTime": t, "traceID": f"b{i}"})
+        t += 0.1
+    dump = tmp_path / "plans.json"
+    dump.write_text(json.dumps({"recent": recent}))
+    script = Path(__file__).resolve().parent.parent / "scripts" / "plan_miner.py"
+    out = subprocess.run(
+        [sys.executable, str(script), "--file", str(dump),
+         "--sequences", "--json"],
+        capture_output=True, text=True, timeout=60, check=True,
+    )
+    doc = json.loads(out.stdout)
+    assert doc["observed"] == 10 and doc["signatures"] == 2
+    by_sig = {t["signature"]: t["next"] for t in doc["transitions"]}
+    nxt = by_sig["i|Row(f=0)"]
+    assert nxt[0]["signature"] == "i|Row(f=8)" and nxt[0]["p"] == 1.0
+    # The human rendering works over the same dump.
+    out = subprocess.run(
+        [sys.executable, str(script), "--file", str(dump), "--sequences"],
+        capture_output=True, text=True, timeout=60, check=True,
+    )
+    assert "in-window transitions" in out.stdout
