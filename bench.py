@@ -1907,13 +1907,14 @@ def repair_sweep():
     compared bit-exact against a full recompute with the repair layer
     suspended AND the memo cleared — including rounds that force a
     stale-base fallback through an un-instrumented write path
-    (clear_row publishes OPAQUE, so the repair layer must refuse and
-    recompute).  Emits the guarded headlines:
+    (load_row_words publishes OPAQUE, so the repair layer must refuse
+    and recompute; clear_row/set_row now capture deltas and repair).
+    Emits the guarded headlines:
 
       result_memo_hit_rate_under_write_load   fraction of dashboard
                                               probes answered by the
                                               memo or an O(changed-bits)
-                                              repair (acceptance >=0.8)
+                                              repair (acceptance >=0.9)
       dashboard_p50_under_ingest_vs_idle      dashboard wall p50 ratio,
                                               write rounds vs idle
                                               (acceptance <=1.5x)
@@ -1928,6 +1929,7 @@ def repair_sweep():
     from pilosa_tpu.core.field import FieldOptions
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops import bitops
     from pilosa_tpu.parallel import MeshEngine, make_mesh
 
     from pilosa_tpu.ops import SHARD_WIDTH
@@ -2030,10 +2032,14 @@ def repair_sweep():
         q(f"Set({int(rng.integers(0, RPS_SHARDS * SHARD_WIDTH))}, "
           f"v={int(rng.integers(0, 1024))})")
         if rnd % 3 == 2:
-            # Un-instrumented write: row 0's bits vanish with no delta
-            # packet — repair MUST refuse (opaque) and recompute.
+            # Un-instrumented write: load_row_words replaces row 0
+            # wholesale with no delta packet (deliberately OPAQUE, per
+            # its contract) — repair MUST refuse and recompute.
+            # clear_row no longer qualifies: it captures deltas now.
             frag = holder.fragment("rpw", "seg", "standard", 0)
-            frag.clear_row(0)
+            frag.load_row_words(
+                0, __rand(rng, bitops.WORDS64) & __rand(rng, bitops.WORDS64)
+            )
             forced_stale += 1
         # Dashboards read more often than they're written: five timed
         # serves per write burst (the first pays the repair; the later
@@ -2277,6 +2283,118 @@ def residency_sweep():
     assert hit_rate > 0.5, f"residency_hit_rate {hit_rate:.2f} <= 0.5"
     eng.close()
     eng_full.close()
+
+    # DEEP oversubscription (ISSUE 20): at 8x and 16x no meaningful row
+    # subset fits as pow2-padded partial matrices, but the packed
+    # 2KiB-block pool ships only OCCUPIED blocks — the dashboard's
+    # pooled working set stays device-resident even at 1/16th of the
+    # index, so the warm hit rate holds >0.9 with zero OOMs/declines.
+    def deep_phase(times_over):
+        engN = MeshEngine(
+            holder, mesh, max_resident_bytes=total_bytes // times_over
+        )
+        engN.result_memo.maxsize = 0
+        exN = Executor(holder, mesh_engine=engN)
+        for q, want in dashboard:  # cold: host-exact + async promotion
+            got = exN.execute("rsw", q).results[0]
+            assert got == want, (q, got, want)
+        assert engN.residency.flush(120.0), "deep promotions did not drain"
+        hits0 = engN.cache_stats["stack"][0]
+        fb0 = engN.host_fallbacks
+        times = []
+        for _ in range(RSW_WARM_REPS):
+            t0 = time.perf_counter()
+            for q, want in dashboard:
+                assert exN.execute("rsw", q).results[0] == want
+            times.append((time.perf_counter() - t0) / len(dashboard))
+        hits = engN.cache_stats["stack"][0] - hits0
+        fallbacks = engN.host_fallbacks - fb0
+        rate = hits / max(1, hits + fallbacks)
+        snapN = engN.residency.snapshot()
+        assert snapN["declined"] == 0, snapN  # no OOMs, no refusals
+        engN.close()
+        return statistics.median(times), rate
+
+    t_warm8, rate8 = deep_phase(8)
+    t_warm16, rate16 = deep_phase(16)
+    emit_raw("residency_hit_rate_8x", rate8, "ratio", rate8)
+    emit_raw(
+        "oversubscribed_8x_warm_vs_resident",
+        t_warm8 / max(t_full, 1e-9), "x", t_full / max(t_warm8, 1e-9),
+    )
+    emit_raw("residency_hit_rate_16x", rate16, "ratio", rate16)
+    progress(
+        f"8x: warm p50 {t_warm8 * 1e3:.2f} ms "
+        f"({t_warm8 / max(t_full, 1e-9):.2f}x resident), hit rate "
+        f"{rate8:.2f}; 16x: {t_warm16 * 1e3:.2f} ms, hit rate {rate16:.2f}"
+    )
+    assert rate8 > 0.9, f"residency_hit_rate_8x {rate8:.2f} <= 0.9"
+
+    # In-run A/B at EQUAL budget: does promote-ahead actually buy warm
+    # latency?  Two single-query dashboards over disjoint stacks
+    # alternate with a drain gap between them, under a budget that fits
+    # ONE pooled working set but not both — so each arrival needs its
+    # stack promoted.  Advisor-off pays a host fallback + demand
+    # promotion every swing; advisor-on has the next stack promoted
+    # during the gap (next-touch eviction protects it from the pricer),
+    # so warm arrivals dispatch on device.  Learning prefix excluded.
+    from pilosa_tpu.api import API, QueryRequest
+    from pilosa_tpu.parallel.advisor import ADVISOR
+    from pilosa_tpu.util import plan_miner
+    from pilosa_tpu.util.heat import HEAT
+
+    pool64_bytes = 64 * S * bitops.OCC_BLOCK_WORDS * 4  # one 64-slot pool
+    ab_budget = (3 * pool64_bytes) // 2  # fits one pooled set, not two
+    ab_reqs = []
+    for fi in (0, 2):  # disjoint stacks: wf0 vs wf2
+        ra, rb = 2 * fi, 2 * fi + 1
+        q = f"Count(Intersect(Row(wf{fi}={ra}), Row(wf{fi}={rb})))"
+        want = sum(pc(host[(fi, ra)][s] & host[(fi, rb)][s]) for s in shards)
+        ab_reqs.append((QueryRequest("rsw", q), want))
+
+    AB_CYCLES, AB_LEARN = 12, 2
+
+    def ab_arm(drive):
+        HEAT.reset()
+        plan_miner.MINER.reset()
+        ADVISOR.reset()
+        ADVISOR.drive_promotions = drive
+        engA = MeshEngine(holder, mesh, max_resident_bytes=ab_budget)
+        engA.result_memo.maxsize = 0
+        api = API(holder=holder, mesh_engine=engA)
+        times = []
+        try:
+            for cyc in range(AB_CYCLES):
+                for req, want in ab_reqs:
+                    t0 = time.perf_counter()
+                    got = int(api.query(req).results[0])
+                    dt = time.perf_counter() - t0
+                    assert got == want, (req.query, got, want)
+                    # The gap: real dashboards have think-time between
+                    # swings; promote-ahead (or the demand promotion the
+                    # miss just queued) lands inside it.
+                    assert engA.residency.flush(60.0)
+                    if cyc >= AB_LEARN:
+                        times.append(dt)
+            fallbacks = engA.host_fallbacks
+        finally:
+            ADVISOR.drive_promotions = True
+            engA.close()
+        return statistics.median(times), fallbacks
+
+    t_off, fb_off = ab_arm(False)
+    t_on, fb_on = ab_arm(True)
+    ab_speedup = t_off / max(t_on, 1e-9)
+    emit_raw("residency_advisor_ab_speedup", ab_speedup, "x", ab_speedup)
+    progress(
+        f"advisor A/B at equal budget: off p50 {t_off * 1e3:.2f} ms "
+        f"({fb_off} host fallbacks) vs on p50 {t_on * 1e3:.2f} ms "
+        f"({fb_on}) = {ab_speedup:.1f}x"
+    )
+    assert ab_speedup > 1.0, (
+        f"advisor-on ({t_on * 1e3:.2f} ms) did not beat advisor-off "
+        f"({t_off * 1e3:.2f} ms) at equal budget"
+    )
     holder.close()
 
 
@@ -3581,9 +3699,13 @@ if __name__ == "__main__":
         "configured device budget (no single stack fits), measuring the "
         "cold host-fallback p50, the warm partially-resident dashboard "
         "p50 (guarded oversubscribed_4x_count_p50_ms), residency_hit_rate, "
-        "and promotion_overlap_mbits_s, with bit-exact differential "
-        "asserts across host / partial / fully-resident paths and zero "
-        "OOMs by construction (docs/residency.md)",
+        "and promotion_overlap_mbits_s; then deep 8x/16x phases on the "
+        "packed 2KiB-block pool (residency_hit_rate_8x > 0.9, "
+        "oversubscribed_8x_warm_vs_resident <= ~1.2x) and an equal-budget "
+        "advisor on/off A/B (residency_advisor_ab_speedup > 1) — all with "
+        "bit-exact differential asserts across host / partial / "
+        "fully-resident paths and zero OOMs by construction "
+        "(docs/residency.md)",
     )
     ap.add_argument(
         "--repair-sweep",

@@ -1371,7 +1371,11 @@ class Fragment:
     def load_row_words(self, row_id: int, words_u64: np.ndarray):
         """Install a dense row wholesale — the zero-copy load path for
         benchmarks/restore (no op-log, no snapshot; caller invalidates the
-        rank cache once after the batch)."""
+        rank cache once after the batch).  Deliberately publishes OPAQUE
+        (no delta capture): a load is not a serving write, and the
+        repair layer MUST fall back to recompute over it — bench's
+        --repair-sweep uses exactly this hole as its forced-stale
+        probe."""
         self._check_open()
         n = self._store.set_dense(
             row_id, np.ascontiguousarray(words_u64, dtype=np.uint64)
@@ -1457,6 +1461,20 @@ class Fragment:
         """Remove every bit in a row, snapshot (fragment.go clearRow :551,
         unprotectedClearRow)."""
         self._check_open()
+        if self._delta_wanted():
+            # Dense delta: every nonzero word of the row, before-value =
+            # the word itself (after = 0).  Empty when the row was
+            # already empty — an exact no-op packet, never OPAQUE
+            # (ISSUE 20 satellite: serving-path row rewrites repair).
+            old = (
+                self._store.words_u64(row_id)
+                if row_id in self._store
+                else np.zeros(WORDS64, dtype=np.uint64)
+            )
+            w = np.flatnonzero(old).astype(np.int64)
+            self._delta_pending = (
+                np.full(w.size, row_id, dtype=np.int64), w, old[w]
+            )
         if self._mutex_owners is not None:
             self._mutex_owners[
                 self._store.positions(row_id).astype(np.int64)
@@ -1480,6 +1498,15 @@ class Fragment:
         )
         old = self._store.words_u64(row_id) if row_id in self._store else None
         changed = old is None or not np.array_equal(old, new)
+        if self._delta_wanted():
+            # Dense delta of the overwrite: exactly the words that
+            # differ, with their pre-write values (ISSUE 20 satellite —
+            # the last serving-path OPAQUE besides load_row_words).
+            base = old if old is not None else np.zeros(WORDS64, dtype=np.uint64)
+            w = np.flatnonzero(base != new).astype(np.int64)
+            self._delta_pending = (
+                np.full(w.size, row_id, dtype=np.int64), w, base[w]
+            )
         n = self._store.set_dense(row_id, new)
         self._mutex_owners = None
         self.cache.bulk_add(row_id, n)

@@ -1,13 +1,15 @@
 """Prefetch advisor: predicted-next working sets, scored continuously.
 
-REPORT-ONLY in this PR (ISSUE 19): the advisor converts the sequence
-miner's predicted-next plan signatures (``util/plan_miner.MINER``) into
-concrete (index, field, view, rows) promotion hints and *grades its own
-predictions against replayed traffic* — it deliberately does NOT drive
-promotions yet.  The perf follow-on that wires hints into
-``ResidencyManager.request(cause="advisor")`` inherits a prediction
-quality that is already observable and bench-guarded
-(``prefetch_advisor_hit_rate``), not a hope.
+Landed report-only in ISSUE 19; since ISSUE 20 the advisor DRIVES
+promote-ahead: every advice set it issues is also pushed into
+``ResidencyManager.request(cause="advisor")`` (minus the rows already
+resident), behind the exact admission scoring, decline cooldowns, and
+version-token commit gate demand promotions use — so speculative
+promotions compete with demand traffic but can never corrupt it, and
+they inherit a prediction quality that was already observable and
+bench-guarded (``prefetch_advisor_hit_rate``) before the first byte
+moved.  The residency worker additionally serves demand (non-advisor)
+requests first, so promote-ahead never starves a miss.
 
 Protocol (docs/observability.md "advisor scoring"): after each query
 the advisor (1) grades the advice set issued after the PREVIOUS query
@@ -28,6 +30,7 @@ touches the heat tables and the tenant ledger account.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -67,6 +70,22 @@ class PrefetchAdvisor:
         self._c_pred = REGISTRY.counter(METRIC_ADVISOR_PREDICTIONS)
         self._c_hits = REGISTRY.counter(METRIC_ADVISOR_HITS)
         self._c_miss = REGISTRY.counter(METRIC_ADVISOR_MISSES)
+        # -- promote-ahead (ISSUE 20) ------------------------------------
+        # Weak engine binding (MeshEngine.__init__ calls bind_engine):
+        # advice must not pin a closed engine alive.
+        self._engine_ref = None
+        # Kill switch: False returns the advisor to ISSUE 19's
+        # report-only behavior (the bench A/B arm flips this).
+        self.drive_promotions = True
+        self.driven_rows = 0
+        self.driven_requests = 0
+
+    def bind_engine(self, engine):
+        self._engine_ref = weakref.ref(engine)
+
+    def _engine(self):
+        ref = self._engine_ref
+        return ref() if ref is not None else None
 
     # -- feed (heat-recorder consumer) ---------------------------------------
 
@@ -91,6 +110,44 @@ class PrefetchAdvisor:
             self._grade_locked(touched)
             self._learn_locked(sig, ws)
             self._advise_locked(sig)
+            out = self._outstanding
+        # Drive promote-ahead OUTSIDE the advisor lock: the residency
+        # split takes engine locks and the eviction pricer reads this
+        # advisor's predictions UNDER those locks (predicted_keys), so
+        # holding both here would invert the lock order.
+        if out is not None:
+            self._drive(out[2])
+
+    def _drive(self, hints: dict):
+        """Push an advice set into residency as ``cause="advisor"``
+        promote-ahead requests, minus the rows already resident.  Best
+        effort on the query path: any failure is swallowed — advice
+        must never fail the query it rode in on."""
+        if not self.drive_promotions:
+            return
+        engine = self._engine()
+        if engine is None:
+            return
+        try:
+            for key, rows in hints.items():
+                resident, _ = engine.residency_row_split(key, rows)
+                want = set(rows) - resident
+                if not want:
+                    continue
+                engine.residency.request(key, want, cause="advisor")
+                self.driven_requests += 1
+                self.driven_rows += len(want)
+        except Exception:  # noqa: BLE001 — advice is strictly best-effort
+            pass
+
+    def predicted_keys(self) -> frozenset:
+        """Keys named by the outstanding advice set — the eviction
+        pricer's predicted-next-touch signal (engine._evict_for).
+        Cold start (no outstanding advice) is the empty set, which
+        reduces eviction ordering to the legacy cost/LRU blend."""
+        with self._lock:
+            out = self._outstanding
+            return frozenset(out[2]) if out is not None else frozenset()
 
     def _grade_locked(self, touched: set):
         out = self._outstanding
@@ -178,7 +235,11 @@ class PrefetchAdvisor:
                 } if self.last_grade is not None else None,
                 "learnedSignatures": len(self._working_sets),
                 "minP": MIN_P,
-                "drivesPromotions": False,  # report-only this PR
+                "drivesPromotions": bool(
+                    self.drive_promotions and self._engine() is not None
+                ),
+                "drivenRequests": self.driven_requests,
+                "drivenRows": self.driven_rows,
             }
             if out is None:
                 doc["outstanding"] = None
@@ -204,6 +265,8 @@ class PrefetchAdvisor:
             self.misses = 0
             self.advice_sets = 0
             self.last_grade = None
+            self.driven_rows = 0
+            self.driven_requests = 0
 
 
 ADVISOR = PrefetchAdvisor()

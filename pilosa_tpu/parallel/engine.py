@@ -162,12 +162,14 @@ class _FieldStack:
     __slots__ = (
         "matrix", "row_index", "versions", "shards", "pos", "frag_sync",
         "occ", "partial", "absent_rows", "block_mask", "universe_rows",
-        "universe_blocks", "footprint",
+        "universe_blocks", "footprint", "pool", "slot_of", "pool_next",
+        "free_dirty", "slot_dev",
     )
 
     def __init__(self, matrix, row_index: Dict[int, int], versions, shards,
                  frag_sync=None, occ=None, partial=False, absent_rows=None,
-                 block_mask=None, universe_rows=None, universe_blocks=None):
+                 block_mask=None, universe_rows=None, universe_blocks=None,
+                 slot_of=None, pool_next=0):
         self.matrix = matrix
         self.row_index = row_index
         self.versions = versions
@@ -223,6 +225,36 @@ class _FieldStack:
         for summary in (self.occ, self.block_mask):
             if summary is not None:
                 self.footprint += int(summary.nbytes)
+        # -- packed 2 KiB-block device pool (partial stacks only) ----------
+        # When ``slot_of`` is set, ``matrix`` is a block POOL
+        # uint32[Pcap, S, OCC_BLOCK_WORDS]: each promoted row maps to an
+        # int32[OCC_BLOCKS] slot vector (slot 0 = the reserved all-zero
+        # block), so partial HBM is charged per occupied 2 KiB block,
+        # not per pow2-padded 128 KiB row — and the compile key depends
+        # only on the pool-capacity tier, ending the per-working-set
+        # tier-boundary recompiles (docs/residency.md, docs/fusion.md).
+        # row_index still names each row's position in the occ /
+        # block_mask summaries; only matrix addressing goes via slots.
+        self.pool = slot_of is not None
+        self.slot_of = slot_of  # row -> np.int32[OCC_BLOCKS]
+        self.pool_next = pool_next  # first virgin (never-written) slot
+        self.free_dirty = []  # recycled slots: must be zero-filled on reuse
+        self.slot_dev = {}  # row -> replicated device slot vector (lazy)
+
+    def slot_vec(self, row_id, mesh):
+        """Replicated device slot vector for ``row_id`` (row_id=None =
+        the shared all-zero vector for absent rows in batched mode),
+        cached per stack and invalidated whenever the sync path
+        reassigns the row's slots."""
+        vec = self.slot_dev.get(row_id)
+        if vec is None:
+            host = (
+                np.zeros(bitops.OCC_BLOCKS, dtype=np.int32)
+                if row_id is None or self.slot_of.get(row_id) is None
+                else self.slot_of[row_id]
+            )
+            vec = self.slot_dev[row_id] = put_global(mesh, host, P())
+        return vec
 
     def resident_fraction(self) -> float:
         """Resident rows / row universe (1.0 for full stacks)."""
@@ -562,24 +594,25 @@ def _scatter_words_donated(mesh, *args):
 
 
 @functools.lru_cache(maxsize=64)
-def _zeros_exec(mesh, R, S):
-    """Per-(mesh, R, S) zero-stack allocator jitted with the pinned
+def _zeros_exec(mesh, R, S, W):
+    """Per-(mesh, R, S, W) zero-stack allocator jitted with the pinned
     row-major layout: a partial promotion's backing matrix is born ON
     device (no host->device transfer of zeros) and the scatter chain
     then ships only the promoted rows' occupied blocks.  R arrives
     power-of-two tiered (engine._promote), so the executable cache
-    stays bounded."""
+    stays bounded.  W is the word width: bitops.WORDS for row-granular
+    stacks, bitops.OCC_BLOCK_WORDS for the packed block pool."""
     from .mesh import _row_major_format
 
     fmt = _row_major_format(NamedSharding(mesh, P(None, SHARD_AXIS)), 3)
     return jax.jit(
-        lambda: jnp.zeros((R, S, bitops.WORDS), jnp.uint32),
+        lambda: jnp.zeros((R, S, W), jnp.uint32),
         out_shardings=fmt,
     )
 
 
-def _device_zeros(mesh, R, S):
-    return _zeros_exec(mesh, R, S)()
+def _device_zeros(mesh, R, S, W=None):
+    return _zeros_exec(mesh, R, S, bitops.WORDS if W is None else W)()
 
 
 class IngestSyncer:
@@ -753,6 +786,13 @@ class MeshEngine:
         # /debug/heat tables and the pilosa_engine_residency_gap_bytes
         # gauge.  Weak binding — heat must not pin a closed engine.
         heat_mod.HEAT.bind_engine(self)
+        # Promote-ahead (docs/residency.md "Predictive promotion &
+        # block pool"): the prefetch advisor drives its hints into
+        # residency.request(cause="advisor") through this binding.
+        # Weak, like HEAT — advice must not pin a closed engine.
+        from . import advisor as advisor_mod
+
+        advisor_mod.ADVISOR.bind_engine(self)
         # Warm-start admissions count as promotions with their own
         # cause label (the residency worker owns cause=reactive).
         self._promotions_warm_counter = REGISTRY.counter(
@@ -1262,9 +1302,15 @@ class MeshEngine:
         ``need_bytes`` more fits under ``max_resident_bytes`` (a SOFT
         working-set target — when nothing more is evictable the caller
         still admits, trusting the next pressure cycle to converge).
-        Victims are ordered by the per-tenant device-cost EWMA of their
-        index (cold tenants lose their stacks first — PR 9's measured
-        signal), LRU within equal cost.  Runs under the engine locks."""
+        Victims are priced by predicted-NEXT-touch blended with the
+        backward device-cost EWMA (lexicographic: a stack the prefetch
+        advisor's outstanding advice names is predicted to serve the
+        next query and survives any non-predicted stack — even a
+        hot-now one that won't recur; within each class the per-tenant
+        EWMA of the index orders victims, cold tenants first — PR 9's
+        measured signal — with LRU breaking ties).  Cold start (no
+        outstanding advice) reduces exactly to the backward ordering.
+        Runs under the engine locks."""
 
         def fits():
             return (
@@ -1275,10 +1321,20 @@ class MeshEngine:
 
         if fits():
             return True
+        try:
+            from . import advisor as advisor_mod
+
+            predicted = advisor_mod.ADVISOR.predicted_keys()
+        except Exception:  # noqa: BLE001 — pricing must never fail
+            predicted = frozenset()
         lru_pos = {k: i for i, k in enumerate(self._stacks)}
         order = sorted(
             (k for k in self._stacks if k not in protect),
-            key=lambda k: (self._index_cost(k[0]), lru_pos[k]),
+            key=lambda k: (
+                1 if k in predicted else 0,
+                self._index_cost(k[0]),
+                lru_pos[k],
+            ),
         )
         for k in order:
             if fits():
@@ -1659,11 +1715,6 @@ class MeshEngine:
     # overlaps the (asynchronously dispatched) device scatter of chunk
     # N — the IngestSyncer overlap pattern applied to cache fill.
     PROMOTE_CHUNK_ROWS = 64
-    # Occupied-block fraction at or under which a promoted row ships as
-    # word-level scatters of its occupied 2 KiB blocks only (the
-    # "promote blocks, not stacks" transfer path); denser rows ship as
-    # one full-row scatter.
-    PROMOTE_SPARSE_ROW = 0.5
 
     def _promote(self, key, rows, cause="reactive", trace_id=""):
         """Promote ``key``'s working set into device residency; runs on
@@ -1763,44 +1814,71 @@ class MeshEngine:
             finally:
                 if credited:
                     self.residency.sub_inflight(full_foot)
-        # Partial promotion: only the touched rows, pow2 row capacity so
-        # compiled programs tier.
+        # Partial promotion: a packed 2 KiB-block device POOL holding
+        # only the promoted rows' OCCUPIED blocks — partial HBM is
+        # charged per block, and the compile key depends only on the
+        # pool-capacity tier (docs/residency.md "Predictive promotion &
+        # block pool").
         uni = set(universe)
         target = sorted(r for r in want if r in uni)
         absent = {r for r in want if r not in uni}
         if not target and not absent:
             return "skipped", 0
-        # Power-of-two row capacity so partial-stack programs tier
-        # (compile key = matrix shape); min 1 — a one-row working set
-        # must fit a one-row budget.
-        R_cap = 1 << (max(1, len(target)) - 1).bit_length()
-        part_foot = R_cap * S * self._row_shard_bytes()
+        BW = bitops.OCC_BLOCK_WORDS
+        # Slot assignment: one pool slot per (row, occupancy block),
+        # union over shards — the gather index must be uniform across
+        # the shard axis, and shard positions whose block is empty read
+        # the slot's zeros.  Slot 0 is reserved all-zero.
+        slot_of: Dict[int, np.ndarray] = {}
+        next_slot = 1
+        for r in target:
+            u = 0
+            for f in frags:
+                if f is not None:
+                    u |= int(f.row_occupancy(r))
+            vec = np.zeros(bitops.OCC_BLOCKS, dtype=np.int32)
+            b = u
+            while b:
+                blk = (b & -b).bit_length() - 1
+                vec[blk] = next_slot
+                next_slot += 1
+                b &= b - 1
+            slot_of[r] = vec
+        # Pow2 pool capacity with 2x headroom so repeat promotions over
+        # a growing working set land in the SAME tier (no recompile),
+        # sticky at or above the previous pool's capacity for this key.
+        P_cap = 1 << max(3, (2 * next_slot - 1).bit_length())
+        with self._stacks_lock:
+            prev = self._stacks.get(key)
+            if prev is not None and prev.pool:
+                P_cap = max(P_cap, int(prev.matrix.shape[0]))
+        part_foot = P_cap * S * BW * 4 + len(target) * S * 16
         if not self._admissible(part_foot):
             return "declined", 0
         self.residency.add_inflight(part_foot)
         credited = True
         try:
             with self._dispatch_lock, self._stacks_lock:
-                # Make room up front (cost-priced); the in-flight bytes
-                # are already counted so concurrent admissions can't
-                # stack on top of this upload.
+                # Make room up front (next-touch priced); the in-flight
+                # bytes are already counted so concurrent admissions
+                # can't stack on top of this upload.
                 self._evict_for(0, protect=frozenset((key,)))
-            mat = _device_zeros(self.mesh, R_cap, S)
+            mat = _device_zeros(self.mesh, P_cap, S, BW)
             row_index = {r: i for i, r in enumerate(target)}
-            occ = np.zeros((R_cap, S), dtype=np.uint64)
+            occ = np.zeros((len(target), S), dtype=np.uint64)
             shipped = 0
             for ci in range(0, len(target), self.PROMOTE_CHUNK_ROWS):
                 chunk = target[ci : ci + self.PROMOTE_CHUNK_ROWS]
-                updates, word_updates, n_words, sb = (
-                    self._assemble_promotion_chunk(chunk, row_index, frags, occ)
+                updates, sb = self._assemble_pool_chunk(
+                    chunk, row_index, slot_of, frags, occ
                 )
                 shipped += sb
-                if updates or word_updates:
+                if updates:
                     # Async dispatch: returns as soon as the scatter is
                     # enqueued — the next chunk's host assembly overlaps
                     # this chunk's device transfer.  The matrix is
                     # private until commit, so donation needs no lock.
-                    mat = self._scatter_chain(mat, updates, word_updates, n_words)
+                    mat = self._scatter_chain(mat, updates, [], 0, width=BW)
             # Release the in-flight credit BEFORE commit: the committed
             # footprint replaces it, and carrying both through the
             # commit's eviction pass would double-charge the budget and
@@ -1811,27 +1889,31 @@ class MeshEngine:
                 key, canonical, token, frag_sync, row_index, mat, occ,
                 partial=True, absent=absent, universe_rows=len(universe),
                 universe_blocks=universe_blocks, shipped=shipped,
-                cause=cause, trace_id=trace_id,
+                cause=cause, trace_id=trace_id, slot_of=slot_of,
+                pool_next=next_slot,
             )
         finally:
             if credited:
                 self.residency.sub_inflight(part_foot)
 
-    def _assemble_promotion_chunk(self, chunk_rows, row_index, frags, occ):
-        """Host half of one promotion chunk: read each (row, shard)'s
-        words, compute occupancy FROM those words (never a second
-        fragment read — the same false-negative rule as
-        _assemble_host), and emit scatter operands.  Rows at or under
-        PROMOTE_SPARSE_ROW occupied-block fraction ship word-level
-        (only their occupied 2 KiB blocks cross PCIe); denser rows ship
-        whole.  Returns (updates, word_updates, n_words, bytes)."""
+    def _assemble_pool_chunk(self, chunk_rows, row_index, slot_of, frags, occ):
+        """Host half of one pool-promotion chunk: read each
+        (row, shard)'s words, compute occupancy FROM those words (never
+        a second fragment read — the same false-negative rule as
+        _assemble_host), and emit one full-2 KiB-block scatter entry
+        (slot, shard_pos, words[OCC_BLOCK_WORDS]) per occupied block —
+        only occupied blocks ever cross PCIe.  A block occupied by a
+        write that RACED the slot-assignment walk has no slot yet; its
+        words are masked out of both the upload and the recorded
+        occupancy (device content and summary stay consistent), and the
+        racing write's version bump replays it through the incremental
+        sync after commit.  Returns (updates, bytes)."""
+        BW = bitops.OCC_BLOCK_WORDS
         updates: list = []
-        word_updates: list = []
-        n_words = 0
         shipped = 0
-        sparse_cap = int(bitops.OCC_BLOCKS * self.PROMOTE_SPARSE_ROW)
         for r in chunk_rows:
             ri = row_index[r]
+            slots = slot_of[r]
             for si, f in enumerate(frags):
                 if f is None or not f.row_occupancy(r):
                     # A write racing this check bumps the fragment
@@ -1840,33 +1922,24 @@ class MeshEngine:
                     continue
                 words = np.asarray(f.row_words(r), dtype=np.uint32)
                 o64 = int(bitops.occupancy64(words))
-                if not o64:
-                    continue
-                occ[ri, si] = np.uint64(o64)
-                blocks = np.nonzero(
-                    np.unpackbits(
-                        np.uint64(o64).reshape(1).view(np.uint8),
-                        bitorder="little",
-                    )
-                )[0]
-                if len(blocks) <= sparse_cap:
-                    widxs = (
-                        blocks[:, None].astype(np.int64)
-                        * bitops.OCC_BLOCK_WORDS
-                        + np.arange(bitops.OCC_BLOCK_WORDS)[None, :]
-                    ).ravel().astype(np.int32)
-                    word_updates.append((ri, si, widxs, words[widxs]))
-                    n_words += len(widxs)
-                    shipped += len(widxs) * 4
-                else:
-                    updates.append((ri, si, words))
-                    shipped += words.nbytes
-        return updates, word_updates, n_words, shipped
+                kept = 0
+                b = o64
+                while b:
+                    blk = (b & -b).bit_length() - 1
+                    b &= b - 1
+                    slot = int(slots[blk])
+                    if slot == 0:
+                        continue  # raced-in block: sync replays it
+                    kept |= 1 << blk
+                    updates.append((slot, si, words[blk * BW : (blk + 1) * BW]))
+                    shipped += BW * 4
+                occ[ri, si] = np.uint64(kept)
+        return updates, shipped
 
     def _commit_promotion(self, key, canonical, token, frag_sync, row_index,
                           mat, occ, partial, absent, universe_rows, shipped,
                           universe_blocks=None, cause="reactive",
-                          trace_id=""):
+                          trace_id="", slot_of=None, pool_next=0):
         """Admit a promoted matrix under the engine locks with the
         version-token gate: stale identities abort, and a version
         advanced by a mid-promotion write reconciles IMMEDIATELY
@@ -1890,6 +1963,7 @@ class MeshEngine:
                 absent_rows=set(absent), block_mask=block_mask,
                 universe_rows=universe_rows,
                 universe_blocks=universe_blocks,
+                slot_of=slot_of, pool_next=pool_next,
             )
             self._evict_for(stack.footprint)
             self._stacks[key] = stack
@@ -2007,6 +2081,18 @@ class MeshEngine:
                         cached.absent_rows.discard(r)
                         continue
                     return None  # brand-new row: shape change
+                if cached.pool:
+                    # Block-pool stacks translate row/word deltas into
+                    # per-slot block writes; a write needing more
+                    # blocks than the pool has left forces a rebuild.
+                    occ64 = self._pool_sync_row(
+                        cached, r, row_idx, si, upd, updates, word_updates
+                    )
+                    if occ64 is None:
+                        return None  # pool exhausted: rebuild at a new tier
+                    n_words = sum(len(w[2]) for w in word_updates)
+                    occ_updates.append((row_idx, si, occ64))
+                    continue
                 if upd[0] == "words":
                     _, widxs, vals, occ64 = upd
                     word_updates.append((row_idx, si, widxs, vals))
@@ -2048,11 +2134,102 @@ class MeshEngine:
 
     def _scatter_sync_chain(self, cached, updates, word_updates, n_words):
         cached.matrix = self._scatter_chain(
-            cached.matrix, updates, word_updates, n_words
+            cached.matrix, updates, word_updates, n_words,
+            width=bitops.OCC_BLOCK_WORDS if cached.pool else None,
         )
         self.stack_updates += 1
 
-    def _scatter_chain(self, mat, updates, word_updates, n_words):
+    def _pool_sync_row(self, cached, r, row_idx, si, upd, updates, word_updates):
+        """Translate one dirty row's delta into block-pool writes.
+
+        The pool matrix is slot-major ([P_cap, S, OCC_BLOCK_WORDS]); the
+        occupancy summaries stay row-major, so the caller applies the
+        returned occ64 at (row_idx, si) unchanged.  Newly occupied
+        blocks allocate a slot: virgin slots (never written, still the
+        zeros the pool was created with) take word scatters directly;
+        recycled slots are zero-filled across every shard position first
+        (full-block zero entries land in the row-update pass, word
+        deltas overlay afterwards — `_scatter_chain` runs row updates
+        before word updates, so the order is deterministic).  Slots are
+        never freed here — a block that empties keeps its slot (reads
+        gather zeros, which is exact) until the next full rebuild
+        repacks the pool.  Returns the shard's refreshed occupancy, or
+        None when the pool is out of slots (caller rebuilds at the next
+        pow2 pool tier)."""
+        BW = bitops.OCC_BLOCK_WORDS
+        slots = cached.slot_of.get(r)
+        if slots is None:
+            return None  # no slot map for a resident row: stale layout
+        S = cached.matrix.shape[1]
+        P_cap = cached.matrix.shape[0]
+
+        def alloc(cover_si):
+            # cover_si: the caller is about to append a full-block data
+            # entry for (slot, si) in `updates`, so a recycled slot must
+            # NOT also get a zero entry there (duplicate (row, pos)
+            # indices in one scatter are nondeterministic).
+            if cached.pool_next < P_cap:
+                s = cached.pool_next
+                cached.pool_next += 1
+                return s  # virgin: device content is already zeros
+            if cached.free_dirty:
+                s = cached.free_dirty.pop()
+                zero = np.zeros(BW, dtype=np.uint32)
+                for sp in range(S):
+                    if cover_si and sp == si:
+                        continue
+                    updates.append((s, sp, zero))
+                return s
+            return None
+
+        if upd[0] == "words":
+            _, widxs, vals, occ64 = upd
+            by_block: Dict[int, Tuple[list, list]] = {}
+            for w, v in zip(widxs, vals):
+                wi, vl = by_block.setdefault(int(w) // BW, ([], []))
+                wi.append(int(w) % BW)
+                vl.append(v)
+            for blk, (wis, vls) in by_block.items():
+                slot = int(slots[blk])
+                if slot == 0:
+                    # slot 0 == never allocated == the block was
+                    # all-zero at the last sync point for EVERY shard,
+                    # so the changed words over zeros are the complete
+                    # block content.
+                    slot = alloc(cover_si=False)
+                    if slot is None:
+                        return None
+                    slots[blk] = slot
+                    cached.slot_dev.pop(r, None)
+                word_updates.append((
+                    slot, si,
+                    np.asarray(wis, dtype=np.int32),
+                    np.asarray(vls, dtype=np.uint32),
+                ))
+            return int(occ64)
+        # "row": full row content replaces every resident block and
+        # allocates slots for newly occupied ones.
+        words = np.asarray(upd[1], dtype=np.uint32)
+        occ64 = int(upd[2])
+        prev = int(cached.block_mask[row_idx, si])
+        for blk in range(bitops.OCC_BLOCKS):
+            has = (occ64 >> blk) & 1
+            slot = int(slots[blk])
+            if slot == 0:
+                if not has:
+                    continue
+                slot = alloc(cover_si=True)
+                if slot is None:
+                    return None
+                slots[blk] = slot
+                cached.slot_dev.pop(r, None)
+                updates.append((slot, si, words[blk * BW : (blk + 1) * BW]))
+            elif has or (prev >> blk) & 1:
+                # Occupied now, or stale device content to zero out.
+                updates.append((slot, si, words[blk * BW : (blk + 1) * BW]))
+        return occ64
+
+    def _scatter_chain(self, mat, updates, word_updates, n_words, width=None):
         # EVERY chunk donates — the update runs in place instead of
         # opening with a full-stack device copy (~9 ms on a 3 GB
         # stack, formerly the dominant cost of every write+query
@@ -2067,13 +2244,15 @@ class MeshEngine:
         # CONTRACT for any new caller: never hold a stack.matrix
         # handle across a field_stack call — re-read it from the
         # stack object.
+        if width is None:
+            width = bitops.WORDS
         for ci in range(0, len(updates), self.SCATTER_CHUNK_ROWS):
             chunk = updates[ci : ci + self.SCATTER_CHUNK_ROWS]
             D = len(chunk)
             D_pad = max(8, 1 << (D - 1).bit_length())
             rows = np.empty(D_pad, dtype=np.int32)
             poss = np.empty(D_pad, dtype=np.int32)
-            vals = np.empty((D_pad, bitops.WORDS), dtype=np.uint32)
+            vals = np.empty((D_pad, width), dtype=np.uint32)
             for i in range(D_pad):
                 r, p, w = chunk[min(i, D - 1)]  # pad repeats the last
                 rows[i], poss[i] = r, p
@@ -2242,6 +2421,12 @@ class MeshEngine:
                     )
                 continue
             i_mat = lw.add_matrix(stack.matrix)
+            if stack.pool:
+                leaves.append((
+                    "rowb", i_mat,
+                    lw.add_replicated(stack.slot_vec(row_id, self.mesh)),
+                ))
+                continue
             i_idx = lw.scalar_ref(ridx)
             leaves.append(("row", i_mat, i_idx))
         if not leaves:
@@ -2277,6 +2462,17 @@ class MeshEngine:
             # of the query's working set and serve from the host path
             # (raises ResidencyMiss).
             self._partial_miss(index, field, VIEW_STANDARD, row_id, lw, stack)
+        if stack.pool:
+            # Block-pool stack: row presence AND layout are data — the
+            # replicated slot vector names the row's block slots, and a
+            # KNOWN-EMPTY row rides the all-zero vector (every gather
+            # hits reserved slot 0, which is kept all-zero).  The
+            # compile key depends only on the pool's pow2 capacity, so
+            # promote/evict cycles stop recompiling (docs/fusion.md).
+            i_mat = lw.add_matrix(stack.matrix)
+            return ("rowb", i_mat, lw.add_replicated(
+                stack.slot_vec(row_id if ridx is not None else None, self.mesh)
+            ))
         if lw.scalar_values is not None:
             # Slot-vector (batched) mode: row PRESENCE must be data, not
             # program structure — a ("zero",) leaf for a missing row id
@@ -2660,8 +2856,8 @@ class MeshEngine:
     def memo_probe_op(self, index: str, kind: str, spec: dict, shards):
         """(key, value-or-None) for submit_op: a hit answers the op
         with zero device dispatch, tagged per op kind in /debug/vars.
-        A miss probes the repair layer (Sum only registers; Min/Max
-        are memo-only — their extrema aren't delta-maintainable)."""
+        A miss probes the repair layer (Sum via plane-popcount deltas;
+        Min/Max via the per-field extremum table, docs/incremental.md)."""
         tag = self._OP_CACHE_TAG.get(kind)
         if tag is None or self.multiproc:
             return None, None
@@ -2677,12 +2873,16 @@ class MeshEngine:
             repaired = self.repairs.probe("sum", key)
             if repaired is not None:
                 return key, repaired
+        elif kind in ("min", "max"):
+            repaired = self.repairs.probe("minmax", key)
+            if repaired is not None:
+                return key, repaired
         return key, None
 
     def memo_store_op(self, key, kind: str, spec: dict, value):
-        """Store a fresh op result under its submit-time key; Sum also
-        registers its plane footprint for repair.  DECLINED sentinels
-        (fused TopN fallback) are never memoized."""
+        """Store a fresh op result under its submit-time key; Sum and
+        Min/Max also register their plane footprints for repair.
+        DECLINED sentinels (fused TopN fallback) are never memoized."""
         if key is None or value is None or value is fusion_mod.DECLINED:
             return
         if kind == "topnf":
@@ -2692,6 +2892,10 @@ class MeshEngine:
         if kind == "sum":
             self.repairs.register_sum(
                 key, spec["field"], spec.get("filter"), value
+            )
+        elif kind in ("min", "max"):
+            self.repairs.register_minmax(
+                key, spec["field"], spec.get("filter"), kind == "min", value
             )
 
     # -- executor-lane memo (cache-only TopN / fused GroupBy results live

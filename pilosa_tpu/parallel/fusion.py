@@ -349,7 +349,7 @@ def _slot_rows(prog) -> int:
     """Shard rows a slot's OWN prog sweeps (mrefs cost nothing here —
     their slots carry their own cost)."""
     kind = prog[0]
-    if kind in ("row", "rowm"):
+    if kind in ("row", "rowm", "rowb"):
         return 1
     if kind == "range":
         pspec = prog[3]
@@ -375,13 +375,13 @@ def _slot_refs(prog, out: set):
     return out
 
 
-def _item_touches(engine, index, spec, stacks):
-    """Working-set touches of ONE fused item (util/heat.py note
-    format): every (index, field, view) stack the item reads and the
-    row ids it reads there (None = the whole stack, e.g. a BSI plane
-    walk or a TopN candidate sweep).  ``stacks`` is the drain's merged
-    (index, field, view) -> stack map so occupied-block counts come
-    from the same summaries the dispatch used."""
+def _item_hints(engine, index, spec) -> dict:
+    """Row-hint map of ONE fused item: every (index, field, view) stack
+    the item reads -> the row ids it reads there (None = the whole
+    stack, e.g. a BSI plane walk or a TopN candidate sweep).  Feeds
+    both the heat touches (_item_touches) and the drain lowering's
+    ``row_hints`` — so a fused item missing a partial stack requests
+    promotion of exactly its rows, not the full stack."""
     from ..core.view import VIEW_STANDARD, view_bsi_name
 
     kind = spec["kind"]
@@ -408,9 +408,29 @@ def _item_touches(engine, index, spec, stacks):
             hints[(index, fname, VIEW_STANDARD)] = {int(r) for r in rows}
         if spec.get("filter") is not None:
             engine._collect_row_hints(index, spec["filter"], hints)
+    return hints
+
+
+def merge_hints(into: dict, hints: dict) -> dict:
+    """Merge one item's hint map into a drain-wide map: None (whole
+    stack) dominates, row sets union."""
+    for key, rows in hints.items():
+        if rows is None or into.get(key, ()) is None:
+            into[key] = None
+        else:
+            into.setdefault(key, set()).update(rows)
+    return into
+
+
+def _item_touches(engine, index, spec, stacks):
+    """Working-set touches of ONE fused item (util/heat.py note
+    format), derived from the same hint map the lowering used.
+    ``stacks`` is the drain's merged (index, field, view) -> stack map
+    so occupied-block counts come from the same summaries the dispatch
+    used."""
     return [
         engine._touch_of(key, stacks.get(key), rows)
-        for key, rows in hints.items()
+        for key, rows in _item_hints(engine, index, spec).items()
     ]
 
 
@@ -517,6 +537,19 @@ def build(engine, entries: List[tuple]) -> FusedPlan:
         "topn": None, "topnf": [], "group": DECLINED,
     }
 
+    # Row hints for the WHOLE drain, merged across items before any
+    # stack fetch: a fused item missing a partial (pool) stack then
+    # requests promotion of exactly the drain's touched rows instead of
+    # the full stack — previously fused drains promoted full stacks
+    # only (None hint), defeating block-granular residency for
+    # dashboard traffic.  Best effort: a malformed item raises again in
+    # its own lowering below and routes to ("error", ...).
+    for idx_h, spec_h, _ in entries:
+        try:
+            merge_hints(lw.row_hints, _item_hints(engine, idx_h, spec_h))
+        except Exception:  # noqa: BLE001
+            pass
+
     # Canonical build order (compile-key discipline): slot numbering and
     # edge order follow the sorted entries, never arrival order.
     order = sorted(range(n_items), key=lambda k: _entry_sort_key(entries[k]))
@@ -538,6 +571,7 @@ def build(engine, entries: List[tuple]) -> FusedPlan:
                     # block-gather kernels instead of paying the fused
                     # program's dense sweep.
                     lw1 = _Lowering(engine, canonical)
+                    lw1.row_hints = lw.row_hints
                     prog1 = engine._lower(index, call, lw1)
                     mask1 = engine._mask_words(shards, canonical)
                     plan = engine._sparse_plan(prog1, lw1, shards, canonical)

@@ -81,6 +81,20 @@ def apply_prog(prog, operands, slots=None):
         # replicated scalar operand index.
         idx = operands[0][ref[1]] if isinstance(ref, tuple) else operands[ref]
         return jax.lax.dynamic_index_in_dim(mat, idx, axis=0, keepdims=False)
+    if kind == "rowb":
+        # Block-pool row gather (tiered residency, docs/residency.md
+        # "Predictive promotion & block pool"): the matrix is a packed
+        # 2 KiB-block pool uint32[Pcap, S_local, OCC_BLOCK_WORDS] and
+        # prog[2] names a replicated int32[OCC_BLOCKS] slot vector
+        # mapping each of the row's occupancy blocks to its pool slot.
+        # Slot 0 is the reserved all-zero block, so absent blocks (and
+        # whole absent rows, via an all-zero vector) read as zeros —
+        # presence is DATA, and the compile key depends only on the
+        # pool's capacity tier, never the row set.
+        mat = operands[prog[1]]
+        srow = operands[prog[2]]
+        blocks = jnp.take(mat, srow, axis=0)  # [OCC_BLOCKS, S_local, BW]
+        return jnp.transpose(blocks, (1, 0, 2)).reshape(mat.shape[1], -1)
     if kind == "rowm":
         # Maskable row gather (batched mode): slot index -1 means the
         # row id doesn't exist — gather row 0 and zero the result, so

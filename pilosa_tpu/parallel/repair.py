@@ -4,8 +4,8 @@ The versioned result memo (_ResultMemo) makes a repeat query against
 unchanged data free — but ONE write bumps a version token and the next
 dashboard drain recomputes from the full index, even though the write
 changed a handful of words.  This layer keeps a second, footprint-aware
-registry of materialized results (Count, BSI Sum, cache-only TopN,
-GroupBy tables) and advances them to the current version tokens in
+registry of materialized results (Count, BSI Sum, BSI Min/Max, cache-only
+TopN, GroupBy tables) and advances them to the current version tokens in
 O(changed bits): the write path stages its touched (row, word) keys and
 before-words on the delta bus (core/delta.py), and a memo miss whose
 entry can account for EVERY version bump since its base re-reads just
@@ -54,6 +54,7 @@ import numpy as np
 
 from ..core.delta import HUB
 from ..core.view import VIEW_STANDARD, view_bsi_name
+from ..ops import bitops
 from ..util.stats import (
     METRIC_RESULT_REPAIRS,
     METRIC_RESULT_REPAIR_FALLBACKS,
@@ -187,6 +188,10 @@ class RepairLayer:
     MAX_ATTEMPTS = 3
     # Candidate-universe cap for TopN repair tables ([S, K] int64).
     MAX_TOPN_TABLE = 2048
+    # Distinct raw values a Min/Max extremum table tracks per shard
+    # descent: writes that stay inside this band repair in O(touched);
+    # one that drains the band falls back to the recompute oracle.
+    MINMAX_TABLE_K = 8
 
     def __init__(self, engine):
         self.engine = engine
@@ -324,6 +329,121 @@ class RepairLayer:
              "min": bsig.min, "filter": filt},
             fields, fviews,
         ))
+
+    def register_minmax(self, key, field_name, filter_call, is_min, value):
+        """A fresh BSI Min/Max (value, count).  The repair state is a
+        small per-field extremum table — the most extreme distinct raw
+        values under the consideration set (not-null & filter) with
+        EXACT global counts, plus the coverage bound the table is exact
+        down to.  Writes whose columns stay inside the covered band
+        repair by moving counts between table entries; a write that
+        drains the band (every covered value deleted) falls back to the
+        recompute oracle, because the new extremum may live below the
+        bound where counts were never tracked."""
+        if key is None or not isinstance(value, tuple):
+            return
+        if self._suspended or getattr(self.engine, "multiproc", False):
+            return  # skip the table-build walk, not just _admit
+        index, qstr, shards, tokens = key
+        idx = self.engine.holder.index(index)
+        f = idx.field(field_name) if idx is not None else None
+        bsig = f.bsi_group(field_name) if f is not None else None
+        if bsig is None:
+            return
+        filt = None
+        if filter_call is not None:
+            filt = compile_tree(filter_call)
+            if filt is None:
+                return
+        fields = {field_name}
+        fviews = {(field_name, view_bsi_name(field_name))}
+        if filter_call is not None:
+            ffields = self.engine._collect_fields(filter_call)
+            if ffields is None:
+                return
+            fields |= ffields
+            fviews |= {(lf, lv) for lf, lv, _r in filt[0]}
+        depth = bsig.bit_depth()
+        tables, bounds = self._build_extremum_tables(
+            index, field_name, depth, filt, shards, is_min
+        )
+        self._admit(_Entry(
+            "minmax", ("minmax", index, qstr, shards), tokens,
+            (int(value[0]), int(value[1])),
+            {"field": field_name, "depth": depth, "min": bsig.min,
+             "filter": filt, "is_min": bool(is_min), "tables": tables,
+             "bounds": bounds},
+            fields, fviews,
+        ))
+
+    def _build_extremum_tables(self, index, field_name, depth, filt,
+                               shards, is_min):
+        """Per-shard {raw value -> exact count} of the K most extreme
+        distinct raw values, via BSI radix descents restricted to the
+        consideration set's nonzero words.  The tables stay PER SHARD
+        because the serve reduce is per shard too (decode_min_max keeps
+        the first best shard's count; cross-shard ties don't sum).
+        Returns (tables, bounds), both keyed by shard: with ``score`` =
+        the raw value oriented so bigger is more extreme (negated for
+        Min), every consideration column of shard s with score >=
+        bounds[s] is counted exactly in tables[s]; bounds[s] is None
+        when the descent exhausted the shard (EVERY column counted)."""
+        bv = view_bsi_name(field_name)
+        holder = self.engine.holder
+        all_w = np.arange(bitops.WORDS64, dtype=np.int64)
+        tables: Dict[int, Dict[int, int]] = {}
+        bounds: Dict[int, Optional[int]] = {}
+        for s in shards:
+            table: Dict[int, int] = {}
+            tables[s], bounds[s] = table, None
+            frag = holder.fragment(index, field_name, bv, s)
+            if frag is None:
+                continue  # empty shard: exhausted by definition
+            cons = frag.words64_at(depth, all_w)  # the not-null row
+            if filt is not None:
+                fl, fe = filt
+                lw = {}
+                for i, (lf, lv, r) in enumerate(fl):
+                    lfr = holder.fragment(index, lf, lv, s)
+                    lw[i] = (
+                        np.zeros(all_w.size, dtype=np.uint64)
+                        if lfr is None else lfr.words64_at(r, all_w)
+                    )
+                cons = cons & fe(lw, all_w.size)
+            W0 = np.flatnonzero(cons)
+            if W0.size == 0:
+                continue
+            planes = [frag.words64_at(i, W0) for i in range(depth)]
+            cand0 = cons[W0]
+            last = 0
+            for _ in range(self.MINMAX_TABLE_K):
+                if not cand0.any():
+                    break
+                # One descent: narrow the candidate set to the columns
+                # holding the most extreme remaining value (fragment.go
+                # minUnsigned/maxUnsigned, vectorized over words).
+                cand = cand0
+                val = 0
+                for i in range(depth - 1, -1, -1):
+                    if is_min:
+                        off = cand & ~planes[i]
+                        if off.any():
+                            cand = off
+                        else:
+                            val |= 1 << i
+                    else:
+                        on = cand & planes[i]
+                        if on.any():
+                            cand = on
+                            val |= 1 << i
+                table[val] = table.get(val, 0) + _pc(cand)
+                cand0 = cand0 & ~cand
+                last = val
+            if cand0.any():
+                # Budget hit with columns left: exact only down to the
+                # least extreme value the descent reached.
+                bounds[s] = -last if is_min else last
+        return tables, bounds
 
     def register_topn(self, key, field_name, n, threshold, row_ids):
         """A cache-only TopN (no src bitmap): the repair state is the
@@ -475,7 +595,9 @@ class RepairLayer:
             if value is None:
                 return None
             entry.tokens = target
-            entry.value = value if entry.kind in ("count", "sum") else None
+            entry.value = (
+                value if entry.kind in ("count", "sum", "minmax") else None
+            )
             self._account(words)
             return self._serve(entry)
         return None
@@ -483,7 +605,7 @@ class RepairLayer:
     def _serve(self, entry: _Entry):
         if entry.kind == "count":
             return int(entry.value)
-        if entry.kind == "sum":
+        if entry.kind in ("sum", "minmax"):
             return entry.value
         if entry.kind == "topn":
             return serve_topn(entry.aux)
@@ -555,7 +677,7 @@ class RepairLayer:
             rows = {r for lf, lv, r in entry.aux["leaves"]
                     if (lf, lv) == (fname, vname)}
             return np.asarray(sorted(rows), dtype=np.int64)
-        if entry.kind == "sum":
+        if entry.kind in ("sum", "minmax"):
             aux = entry.aux
             if (fname, vname) == (aux["field"], view_bsi_name(aux["field"])):
                 return np.arange(aux["depth"] + 1, dtype=np.int64)
@@ -580,9 +702,9 @@ class RepairLayer:
         """A packet row outside the entry's row universe means the
         materialized SHAPE changed (a new TopN candidate, a new group
         row), not just the counts — fall back.  Scalar kinds (count,
-        sum) and explicit-ids TopN are row-closed: writes to other rows
-        can't change the value, so they're simply dropped."""
-        if entry.kind in ("count", "sum"):
+        sum, min/max) and explicit-ids TopN are row-closed: writes to
+        other rows can't change the value, so they're simply dropped."""
+        if entry.kind in ("count", "sum", "minmax"):
             return False
         if entry.kind == "topn" and entry.aux["explicit"]:
             return False
@@ -619,7 +741,7 @@ class RepairLayer:
         actually touched; the other kinds read their fixed leaf set."""
         if entry.kind == "count":
             return list(entry.aux["leaves"])
-        if entry.kind == "sum":
+        if entry.kind in ("sum", "minmax"):
             aux = entry.aux
             bv = view_bsi_name(aux["field"])
             out = [(aux["field"], bv, i) for i in range(aux["depth"] + 1)]
@@ -676,6 +798,8 @@ class RepairLayer:
             return self._apply_count(entry, words, reads, before)
         if entry.kind == "sum":
             return self._apply_sum(entry, words, reads, before)
+        if entry.kind == "minmax":
+            return self._apply_minmax(entry, words, reads, before)
         if entry.kind == "topn":
             return self._apply_topn(entry, words, reads, before)
         return self._apply_groupby(entry, words, reads, before)
@@ -719,6 +843,74 @@ class RepairLayer:
                 ) << i
         total, n = entry.value
         return (total + d_total + bmin * d_n, n + d_n)
+
+    def _apply_minmax(self, entry, words, reads, before):
+        """Extremum-table maintenance: per touched word, zip the plane
+        bits back into per-column raw values before and after, then move
+        the covered counts (a write is a decrement at its old value and
+        an increment at its new one; values below a shard's coverage
+        bound are untracked and simply ignored).  Falls back (None) when
+        a covered decrement has no table entry — impossible unless the
+        band itself is stale — or when a non-exhausted shard's band
+        drains: that shard's extremum may now live below its bound,
+        where counts were never kept.  The final reduce replays
+        decode_min_max exactly (first best shard's count wins; ties
+        across shards don't sum), so a repaired serve is bit-identical
+        to a recompute at the same tokens."""
+        aux = entry.aux
+        field, depth, bmin = aux["field"], aux["depth"], aux["min"]
+        filt, is_min = aux["filter"], aux["is_min"]
+        tables, bounds = aux["tables"], aux["bounds"]
+        bv = view_bsi_name(field)
+
+        def bits(w):
+            return np.unpackbits(w.view(np.uint8), bitorder="little")
+
+        def columns(src, s, W):
+            # Consideration mask + raw value per column of the touched
+            # words (64 columns per uint64 word, little-endian bits).
+            nn = bits(src[(field, bv, depth, s)]).astype(bool)
+            if filt is not None:
+                fl, fe = filt
+                fw = fe({i: src[(lf, lv, r, s)]
+                         for i, (lf, lv, r) in enumerate(fl)}, W.size)
+                nn &= bits(fw).astype(bool)
+            vals = np.zeros(W.size * 64, dtype=np.int64)
+            for i in range(depth):
+                vals += bits(src[(field, bv, i, s)]).astype(np.int64) << i
+            return nn, vals
+
+        for s, W in words.items():
+            table, bound = tables.get(s), bounds.get(s)
+            if table is None:
+                return None  # packet for a shard outside the universe
+            nn_a, va = columns(reads, s, W)
+            nn_b, vb = columns(before, s, W)
+            for c in np.flatnonzero((nn_a != nn_b) | (nn_a & (va != vb))):
+                if nn_b[c]:
+                    v = int(vb[c])
+                    if bound is None or (-v if is_min else v) >= bound:
+                        n = table.get(v, 0) - 1
+                        if n < 0:
+                            return None
+                        table[v] = n
+                if nn_a[c]:
+                    v = int(va[c])
+                    if bound is None or (-v if is_min else v) >= bound:
+                        table[v] = table.get(v, 0) + 1
+        best_val, best_n = 0, 0
+        for s in entry.sig[3]:  # ascending = decode's canonical scan
+            live = [v for v, c in tables[s].items() if c > 0]
+            if not live:
+                if bounds[s] is None:
+                    continue  # shard provably empty under the filter
+                return None  # band drained: shard extremum unknowable
+            v = min(live) if is_min else max(live)
+            if best_n == 0 or (v < best_val if is_min else v > best_val):
+                best_val, best_n = v, int(tables[s][v])
+        if best_n == 0:
+            return (0, 0)  # every shard provably empty — recompute's (0, 0)
+        return (best_val + bmin, best_n)
 
     def _apply_topn(self, entry, words, reads, before):
         """Count-table maintenance: per touched (shard, candidate) the

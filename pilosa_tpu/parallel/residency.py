@@ -88,6 +88,7 @@ class ResidencyManager:
         self.partial_promotions = 0
         self.declined = 0
         self.dropped = 0  # queue-overflow requests (host tier serves)
+        self.advisor_deferred = 0  # speculative requests refused under backlog
         self.promoted_bytes = 0
         self.promote_seconds = 0.0
         # Full-promotion counters resolve per cause at inc time (the
@@ -133,7 +134,18 @@ class ResidencyManager:
                     cur[0].update(rows)
                 if not cur[2] and trace_id:
                     cur[2] = trace_id
+                if cause != "advisor" and cur[1] == "advisor":
+                    # A demand miss caught up with speculation: the
+                    # merged promotion is demand now (worker ordering +
+                    # the journal's cause both follow).
+                    cur[1] = cause
             else:
+                if cause == "advisor" and len(self._pending) >= MAX_PENDING // 2:
+                    # Speculative requests only get the queue's front
+                    # half: under backlog, promote-ahead yields before
+                    # it can crowd out a single demand promotion.
+                    self.advisor_deferred += 1
+                    return False
                 if len(self._pending) >= MAX_PENDING:
                     self.dropped += 1
                     return False
@@ -188,7 +200,16 @@ class ResidencyManager:
                     self._cv.wait()
                 if self._closed:
                     return
-                key = next(iter(self._pending))
+                # Demand first: speculative (advisor) promotions only
+                # run when no reactive/warm-start request is waiting —
+                # promote-ahead competes for budget, never for the
+                # worker's next slot.
+                key = next(
+                    (k for k, v in self._pending.items() if v[1] != "advisor"),
+                    None,
+                )
+                if key is None:
+                    key = next(iter(self._pending))
                 rows, cause, trace_id = self._pending.pop(key)
                 self._busy = True
             try:
@@ -248,6 +269,7 @@ class ResidencyManager:
                 "partialPromotions": self.partial_promotions,
                 "declined": self.declined,
                 "dropped": self.dropped,
+                "advisorDeferred": self.advisor_deferred,
                 "promotedBytes": self.promoted_bytes,
                 "promoteSeconds": round(self.promote_seconds, 6),
                 "cooldowns": len(self._cooldown),

@@ -701,7 +701,7 @@ METRIC_RESULT_REPAIR_SECONDS = "pilosa_result_repair_seconds"
 METRIC_RESULT_REPAIR_TOUCHED_WORDS = "pilosa_result_repair_touched_words_total"
 METRIC_CQ_ACTIVE = "pilosa_cq_active"
 METRIC_CQ_DELTAS = "pilosa_cq_deltas_total"
-REPAIR_KINDS = ("count", "sum", "topn", "groupby")
+REPAIR_KINDS = ("count", "sum", "topn", "groupby", "minmax")
 
 # Pre-register the always-on surface so /metrics exposes every required
 # series (with zero counts) from process start — scrape checks must not

@@ -1358,8 +1358,9 @@ EOF
 
 # Working-set heat lane (docs/observability.md "Working-set heat &
 # sequences"): boot a full Server with 1s history sampling and a device
-# budget that fits only 3 of the 4 hot rows, then repeat the
-# two-dashboard pattern (A = Row(f=0)&Row(f=1), B = Row(f=8)&Row(f=9)).
+# budget that fits ONE dashboard's packed block pool but not both, then
+# repeat the two-dashboard pattern (A = Row(f=0)&Row(f=1),
+# B = Row(f=8)&Row(f=9)).
 # Assert (a) /debug/heat ranks exactly the touched rows, (b)
 # /debug/sequences learned the A->B transition, (c)
 # /debug/prefetch_advice names B's rows right after A is served and the
@@ -1377,7 +1378,6 @@ import urllib.request
 from pilosa_tpu.config import Config
 from pilosa_tpu.server import Server
 
-ROW_SHARD = 32768 * 4 + 16
 tmp = tempfile.mkdtemp()
 cfg = Config()
 cfg.data_dir = os.path.join(tmp, "heat")
@@ -1385,9 +1385,13 @@ cfg.bind = "localhost:0"
 cfg.obs_history = True
 cfg.obs_sample_interval = 1.0
 cfg.obs_retention = 600.0
-# 3 of the 4 hot rows fit: alternating dashboards leave a standing
-# residency gap; the A-only shift lets it drain back to 0.
-cfg.engine_device_budget_bytes = 3 * ROW_SHARD
+# Each row below occupies 8 of the 64 occupancy blocks, so one
+# dashboard's 2-row packed pool lands in the 64-slot capacity tier
+# (128KiB) and the merged 4-row working set in the 128-slot tier
+# (256KiB): at 160KiB, one dashboard fits but the hot set doesn't —
+# alternating dashboards leave a standing residency gap; the A-only
+# shift (B's rows decay cold) lets it drain back to 0.
+cfg.engine_device_budget_bytes = 160 * 1024
 srv = Server(cfg)
 srv.open(port_override=0)
 port = srv.port
@@ -1427,10 +1431,12 @@ try:
     post("/index/hsmoke", b"{}")
     post("/index/hsmoke/field/f", b'{"options": {"type": "set"}}')
     rows, cols = [], []
+    BLOCK_COLS = 16384  # one 2KiB occupancy block = 512 u32 words
     for r in (0, 1, 8, 9):
-        for c in range(0, 48 + 2 * r, 2):
-            rows.append(r)
-            cols.append(c)
+        for b in range(8):
+            for c in range(0, 6 + 2 * r, 2):
+                rows.append(r)
+                cols.append(b * BLOCK_COLS + c)
     post(
         "/index/hsmoke/field/f/import",
         json.dumps({"rowIDs": rows, "columnIDs": cols}).encode(),
@@ -1545,6 +1551,169 @@ try:
         "residency gap rose under the 2-dashboard working set and drained "
         "to 0 after the shift to A, with the rise-then-drain queryable "
         "from the _system history"
+    )
+finally:
+    srv.close()
+EOF
+
+# Promote-ahead lane (docs/residency.md "Predictive promotion & block
+# pool"): boot a Server with a device budget that fits ONE dashboard's
+# packed block pool but not two, then alternate two single-query
+# dashboards over DISJOINT fields (A = fa rows 0&1, B = fb rows 8&9).
+# Once the miner has learned the alternation, assert the full causal
+# chain per cycle: serving A makes a cause="advisor" engine.promotion
+# for fb land in the journal BEFORE dashboard B's first query is even
+# issued, and B's warm queries then add ZERO host fallbacks (served
+# from the speculatively promoted pool, bit-exact).
+env JAX_PLATFORMS=cpu PILOSA_TPU_MESH_DEVICES=1 python - <<'EOF'
+import json
+import os
+import tempfile
+import time
+import urllib.request
+
+from pilosa_tpu.config import Config
+from pilosa_tpu.server import Server
+
+tmp = tempfile.mkdtemp()
+cfg = Config()
+cfg.data_dir = os.path.join(tmp, "promote")
+cfg.bind = "localhost:0"
+# Each dashboard's working set packs into one 8-slot pool of 2KiB
+# occupancy blocks (~16KiB + row index): 24KiB fits one pooled
+# dashboard but NOT both, so every swing needs a promotion — demand
+# (advisor off / unlearned) or promote-ahead (learned).
+cfg.engine_device_budget_bytes = 24 * 1024
+srv = Server(cfg)
+srv.open(port_override=0)
+port = srv.port
+# The lane exercises the promote-ahead path, not the result memo.
+srv.api.mesh_engine.result_memo.maxsize = 0
+
+
+def get(path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://localhost:{port}{path}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def post(path, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://localhost:{port}{path}", data=body, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def sample(name):
+    text = urllib.request.urlopen(
+        f"http://localhost:{port}/metrics", timeout=30
+    ).read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rpartition(" ")[2])
+    return 0.0
+
+
+try:
+    post("/index/psmoke", b"{}")
+    data = {}  # field -> (rows, cols)
+    for fname, base in (("fa", 0), ("fb", 8)):
+        post(
+            f"/index/psmoke/field/{fname}",
+            b'{"options": {"type": "set"}}',
+        )
+        rows, cols = [], []
+        # Queried rows base/base+1 plus two cold rows so the queried
+        # working set is a strict subset of the stack (partial pool).
+        for r in (base, base + 1, base + 2, base + 3):
+            for c in range(0, 40 + 2 * r, 2):
+                rows.append(r)
+                cols.append(c)
+        post(
+            f"/index/psmoke/field/{fname}/import",
+            json.dumps({"rowIDs": rows, "columnIDs": cols}).encode(),
+        )
+        data[fname] = (rows, cols)
+
+    def want(fname, r1, r2):
+        rows, cols = data[fname]
+        s1 = {c for r, c in zip(rows, cols) if r == r1}
+        s2 = {c for r, c in zip(rows, cols) if r == r2}
+        return len(s1 & s2)
+
+    A = b"Count(Intersect(Row(fa=0), Row(fa=1)))"
+    B = b"Count(Intersect(Row(fb=8), Row(fb=9)))"
+    wa, wb = want("fa", 0, 1), want("fb", 8, 9)
+
+    def q(body):
+        return post("/index/psmoke/query", body, timeout=60)["results"][0]
+
+    # Learn: the alternation teaches the miner sig(A)->sig(B)->sig(A);
+    # the sleeps are the dashboards' think-time — promotions (demand or
+    # speculative) land inside them.
+    for _ in range(12):
+        assert q(A) == wa
+        time.sleep(0.25)
+        assert q(B) == wb
+        time.sleep(0.25)
+
+    def advisor_fb_promotions(since_seq):
+        evs = get("/debug/events?type=engine")["events"]
+        return [
+            e for e in evs
+            if e["type"] == "engine.promotion" and e["seq"] > since_seq
+            and e["fields"].get("cause") == "advisor"
+            and e["fields"].get("field") == "fb"
+        ]
+
+    # Scored swings: the advisor-caused fb promotion must be IN THE
+    # JOURNAL before B's first scored query is issued, and that B serve
+    # must then not add a single host fallback.  Not every swing can
+    # score under the deliberately tiny one-pool budget: a promotion
+    # racing a just-evicted pool whose device buffer hasn't been freed
+    # yet is declined and cools the stack down for a few seconds, in
+    # which state fb simply stays resident and no fresh journal event
+    # fires.  Such swings keep the alternation flowing (self-healing
+    # once the cooldown expires) and retry; the contract is that the
+    # full causal chain is observed on >=2 swings.
+    passed = 0
+    for attempt in range(20):
+        evs = get("/debug/events?type=engine")["events"]
+        mark = max((e["seq"] for e in evs), default=0)
+        assert q(A) == wa
+        deadline = time.monotonic() + 3
+        promos = advisor_fb_promotions(mark)
+        while not promos and time.monotonic() < deadline:
+            time.sleep(0.05)
+            promos = advisor_fb_promotions(mark)
+        if not promos:
+            assert q(B) == wb  # heal: keep the A->B pattern alive
+            time.sleep(1.0)  # decline cooldown + buffer GC headroom
+            continue
+        assert promos[0]["fields"].get("partial") is True, promos[0]
+        fb0 = sample("pilosa_engine_host_fallbacks_total")
+        assert q(B) == wb
+        assert sample("pilosa_engine_host_fallbacks_total") == fb0, (
+            f"swing {attempt}: B's warm query paid a host fallback "
+            "despite the promote-ahead")
+        passed += 1
+        if passed >= 2:
+            break
+        time.sleep(0.25)  # think-time: let fa promote ahead for A
+    assert passed >= 2, (
+        f"only {passed}/2 swings showed the promote-ahead causal chain")
+
+    snap = srv.api.mesh_engine.residency.snapshot()
+    adv = get("/debug/prefetch_advice")
+    print(
+        "promote-ahead lane OK: learned A->B alternation -> "
+        "cause=advisor partial (block-pool) promotions for fb landed "
+        f"before B's first query in {passed} scored swings, B's warm "
+        "serves added zero host fallbacks "
+        f"(advisor hitRate {adv.get('hitRate')}, "
+        f"advisorDeferred {snap['advisorDeferred']})"
     )
 finally:
     srv.close()

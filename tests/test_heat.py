@@ -180,11 +180,25 @@ def test_residency_gap_rises_then_drains(mesh1):
     eng = MeshEngine(holder, mesh1, max_resident_bytes=4 * ROW_SHARD + 4096)
     eng.result_memo.maxsize = 0
     api = API(holder=holder, mesh_engine=eng)
+    # Gate the promotion worker: block-pool promotions ship so few
+    # bytes that an ungated worker often lands before the first gauge
+    # read, racing the "gap rises" half of the assertion.
+    import threading
+
+    gate = threading.Event()
+    orig_chunk = eng._assemble_pool_chunk
+
+    def gated(*a):
+        gate.wait(30.0)
+        return orig_chunk(*a)
+
+    eng._assemble_pool_chunk = gated
     q = "Count(Intersect(Row(f=10), Row(f=11)))"
     resp = api.query(QueryRequest("i", q))
     assert eng.host_fallbacks >= 1
     g = HEAT.refresh_gauges()
     assert g["gapBytes"] > 0, "host-served hot rows did not open a gap"
+    gate.set()
     assert eng.residency.flush(30.0)
     g = HEAT.refresh_gauges()
     assert g["gapBytes"] == 0, "promoted working set still shows a gap"
@@ -316,7 +330,8 @@ def test_advisor_learns_alternation_perfectly():
     assert adv.hits - h0 == 32  # 16 grades x 2 advised rows
     assert adv.hit_rate() > 0.9
     doc = adv.to_doc()
-    assert doc["drivesPromotions"] is False  # report-only this PR
+    # A standalone advisor (no engine bound) stays report-only.
+    assert doc["drivesPromotions"] is False
     out = doc["outstanding"]
     assert out is not None and out["p"] >= 0.4
     assert out["hints"][0]["rows"] in ([0, 1], [8, 9])
@@ -361,7 +376,8 @@ def test_debug_endpoints(mesh):
     # The alternation above is one observed transition.
     assert any(t["next"] for t in seq["transitions"])
     adv = h._debug_prefetch_advice({}, b"")
-    assert adv["drivesPromotions"] is False
+    # Bound to a live engine: the advisor drives promote-ahead now.
+    assert adv["drivesPromotions"] is True
     assert "hitRate" in adv and "outstanding" in adv
     eng.close()
 

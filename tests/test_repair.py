@@ -296,20 +296,65 @@ def test_sum_repair_matches_recompute(holder, mesh):
     assert q(SF) == _oracle(eng, ex, SF)
 
 
-def test_min_max_memo_hits_not_repaired(holder, mesh):
-    """Min/Max ride the memo (hits while idle) but are NOT registered
-    for repair — an extremum isn't delta-maintainable.  After a write
-    they recompute and stay correct."""
+def test_min_max_repair_matches_recompute(holder, mesh):
+    """Min/Max repair through the per-field extremum table: writes that
+    stay inside the covered band repair in O(touched words), and every
+    repaired serve equals a full recompute at the same tokens —
+    including the cross-shard tie semantics of decode_min_max (the
+    first best shard's count wins, ties don't sum)."""
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type="int", min=0, max=1000))
+    eng, ex = _mesh_executor(holder, mesh)
+    q = lambda s: ex.execute("i", s).results[0]
+    q("Set(0, v=5) Set(1, v=9) Set(2, v=100)"
+      f" Set({SHARD_WIDTH + 1}, v=200) Set({SHARD_WIDTH + 2}, v=100)")
+    assert q("Min(field=v)") == q("Min(field=v)")  # memo hit
+    assert q("Max(field=v)") == q("Max(field=v)")
+    # Overwrite the max away: decrement at 200, increment at 7.
+    q(f"Set({SHARD_WIDTH + 1}, v=7)")
+    got = q("Max(field=v)")
+    assert eng.repairs.repaired["minmax"] >= 1
+    assert got == _oracle(eng, ex, "Max(field=v)")
+    assert q("Min(field=v)") == _oracle(eng, ex, "Min(field=v)")
+    # A new extremum appears (covered increment)...
+    q("Set(5, v=999)")
+    assert q("Max(field=v)") == _oracle(eng, ex, "Max(field=v)")
+    # ...then ties across shards: the count must follow the recompute's
+    # first-best-shard reduce exactly.
+    q(f"Set({SHARD_WIDTH + 3}, v=999)")
+    assert q("Max(field=v)") == _oracle(eng, ex, "Max(field=v)")
+    # Filtered Min: the filter leaf joins the footprint, and a write
+    # flipping filter membership moves the extremum.
+    q("Set(1, f=10) Set(2, f=10)")
+    MF = "Min(Row(f=10), field=v)"
+    base = q(MF)
+    assert q(MF) == base
+    rep = eng.repairs.repaired["minmax"]
+    q("Set(0, f=10)")  # column 0 (v=5) enters the filter: new min
+    assert q(MF) == _oracle(eng, ex, MF)
+    assert eng.repairs.repaired["minmax"] > rep
+
+
+def test_min_max_band_drain_falls_back(holder, mesh):
+    """Writes that delete EVERY tracked extreme value drain the covered
+    band: the true extremum now lives below the coverage bound where
+    counts were never kept, so the probe must fall back to recompute —
+    never serve from a drained table."""
     idx = holder.create_index("i")
     idx.create_field("v", FieldOptions(type="int", min=0, max=1000))
     eng, ex = _mesh_executor(holder, mesh)
     q = lambda s: ex.execute("i", s).results[0]
-    q("Set(0, v=5) Set(1, v=9)")
-    assert q("Min(field=v)") == q("Min(field=v)")
-    assert q("Max(field=v)") == q("Max(field=v)")
-    q("Set(2, v=3)")
-    assert q("Min(field=v)") == _oracle(eng, ex, "Min(field=v)")
-    assert q("Max(field=v)") == _oracle(eng, ex, "Max(field=v)")
+    n_vals = eng.repairs.MINMAX_TABLE_K + 4
+    q(" ".join(f"Set({c}, v={100 + c})" for c in range(n_vals)))
+    base = q("Max(field=v)")
+    assert (base.val, base.count) == (100 + n_vals - 1, 1)
+    # Crush every covered extreme below the band in one round.
+    q(" ".join(f"Set({c}, v=1)" for c in range(n_vals)))
+    fb = eng.repairs.fallbacks["minmax"]
+    got = q("Max(field=v)")
+    assert eng.repairs.fallbacks["minmax"] == fb + 1
+    assert got == _oracle(eng, ex, "Max(field=v)")
 
 
 # -- delta hub bounds --------------------------------------------------------

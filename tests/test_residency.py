@@ -201,11 +201,11 @@ def test_write_during_promotion_token_recheck(holder, mesh1):
     eng = _fresh_engine(holder, mesh1, 4 * ROW_SHARD + 4096)
     ex = Executor(holder, mesh_engine=eng)
     ex_host = Executor(holder)
-    orig = eng._assemble_promotion_chunk
+    orig = eng._assemble_pool_chunk
     wrote = threading.Event()
 
-    def racing(chunk_rows, row_index, frags, occ):
-        out = orig(chunk_rows, row_index, frags, occ)
+    def racing(chunk_rows, row_index, slot_of, frags, occ):
+        out = orig(chunk_rows, row_index, slot_of, frags, occ)
         if not wrote.is_set():
             wrote.set()
             # Lands AFTER the chunk was read, BEFORE commit: the
@@ -213,7 +213,7 @@ def test_write_during_promotion_token_recheck(holder, mesh1):
             idx.field("f").import_bulk([10, 11], [100001, 100001])
         return out
 
-    eng._assemble_promotion_chunk = racing
+    eng._assemble_pool_chunk = racing
     q = QUERIES[0]
     ex.execute("i", q)  # cold -> host + enqueue
     assert eng.residency.flush(30.0)
@@ -373,12 +373,146 @@ def test_host_fallback_plan_annotation(holder, mesh1):
     eng.close()
 
 
+def test_block_pool_fuzz_promote_evict_sync(holder, mesh1):
+    """ISSUE 20 satellite: randomized differential fuzz of the packed
+    block pool — rotating row pairs churn promote/evict cycles under a
+    4-row budget while random writes land across the full occupancy
+    range (virgin blocks, recycled slots, zero-covered tails), and
+    every query must stay bit-exact vs the host path."""
+    rng = np.random.default_rng(7)
+    idx = build_oversub(holder)
+    eng = _fresh_engine(holder, mesh1, 4 * ROW_SHARD + 4096)
+    ex = Executor(holder, mesh_engine=eng)
+    ex_host = Executor(holder)
+    ops = ("Intersect", "Union", "Difference", "Xor")
+    for it in range(30):
+        r1, r2 = (int(r) for r in rng.choice(N_ROWS, 2, replace=False))
+        q = f"Count({ops[it % 4]}(Row(f={r1}), Row(f={r2})))"
+        assert (
+            ex.execute("i", q).results[0]
+            == ex_host.execute("i", q).results[0]
+        ), (it, q)
+        if it % 3 == 0:
+            # Random writes, spanning the whole shard so new occupancy
+            # blocks appear on already-promoted rows (pool slot alloc +
+            # zero-fill cover paths in the incremental sync).
+            n = int(rng.integers(1, 6))
+            idx.field("f").import_bulk(
+                [int(r) for r in rng.integers(0, N_ROWS, n)],
+                [int(c) for c in rng.integers(0, 1_000_000, n)],
+            )
+        if it % 7 == 0:
+            assert eng.residency.flush(30.0)
+    assert eng.residency.flush(30.0)
+    snap = eng.residency.snapshot()
+    assert snap["partialPromotions"] >= 1
+    assert eng.cache_snapshot()["evictions"] >= 1  # the churn was real
+    for q in QUERIES:
+        assert (
+            ex.execute("i", q).results[0]
+            == ex_host.execute("i", q).results[0]
+        ), q
+    eng.close()
+
+
+def test_write_during_promote_ahead_race(holder, mesh1):
+    """ISSUE 20 satellite: a write landing during an ADVISOR-driven
+    speculative promotion reconciles exactly like a demand one (token
+    re-check + incremental sync), and the journal records the
+    promotion with cause="advisor"."""
+    from pilosa_tpu.util.events import EventJournal
+
+    idx = build_oversub(holder)
+    journal = EventJournal()
+    eng = MeshEngine(
+        holder, mesh1, max_resident_bytes=4 * ROW_SHARD + 4096,
+        journal=journal,
+    )
+    eng.result_memo.maxsize = 0
+    ex = Executor(holder, mesh_engine=eng)
+    ex_host = Executor(holder)
+    orig = eng._assemble_pool_chunk
+    wrote = threading.Event()
+
+    def racing(chunk_rows, row_index, slot_of, frags, occ):
+        out = orig(chunk_rows, row_index, slot_of, frags, occ)
+        if not wrote.is_set():
+            wrote.set()
+            idx.field("f").import_bulk([10, 11], [100001, 100001])
+        return out
+
+    eng._assemble_pool_chunk = racing
+    # The promote-ahead path: a speculative request, no query driving it.
+    assert eng.residency.request(
+        ("i", "f", "standard"), {10, 11}, cause="advisor"
+    )
+    assert eng.residency.flush(30.0)
+    assert wrote.is_set()
+    promos = journal.events(type="engine.promotion")
+    assert any(e.fields.get("cause") == "advisor" for e in promos), promos
+    # The first query reconciles through the token gate and serves the
+    # post-write truth, never the pre-write snapshot the upload read.
+    want = ex_host.execute("i", QUERIES[0]).results[0]
+    assert ex.execute("i", QUERIES[0]).results[0] == want
+    eng.close()
+
+
+def test_next_touch_eviction_prefers_predicted_stack(mesh1):
+    """ISSUE 20 satellite, the eviction-order differential: with no
+    outstanding advice the pricer reduces to the legacy cost/LRU blend
+    (cheapest tenant evicted first); with advice naming a stack, that
+    stack survives even though legacy pricing would evict it first,
+    and the non-predicted one goes instead."""
+    from pilosa_tpu.parallel.advisor import ADVISOR
+
+    h = Holder()
+    h.open()
+    for name in ("p1", "p2"):
+        h.create_index(name).create_field("f").import_bulk([1], [0])
+    h.index("p1").create_field("g").import_bulk([1], [0])
+    costs = {"p1": 0.0, "p2": 5.0}  # legacy order evicts p1 first
+
+    def admit_third(budget):
+        eng = MeshEngine(h, mesh1, max_resident_bytes=budget)
+        eng.cost_of_index = lambda index: costs.get(index, 0.0)
+        eng.field_stack("p1", "f", "standard")
+        eng.field_stack("p2", "f", "standard")
+        assert len(eng._stacks) == 2
+        eng.field_stack("p1", "g", "standard")
+        return eng
+
+    budget = 2 * ROW_SHARD + 4096
+    ADVISOR.reset()
+    eng = admit_third(budget)  # cold start: legacy blend
+    assert ("p1", "f", "standard") not in eng._stacks
+    assert ("p2", "f", "standard") in eng._stacks
+    eng.close()
+
+    ADVISOR.reset()
+    with ADVISOR._lock:
+        # Outstanding advice predicts p1/f serves the next query.
+        ADVISOR._outstanding = (
+            "sig", 1.0, {("p1", "f", "standard"): frozenset({1})}
+        )
+    try:
+        eng = admit_third(budget)
+        assert ("p1", "f", "standard") in eng._stacks  # predicted survives
+        assert ("p2", "f", "standard") not in eng._stacks
+        eng.close()
+    finally:
+        ADVISOR.reset()
+
+
 def test_promotion_declined_cooldown(holder, mesh1):
     """A stack that cannot fit even partially declines (counted) and
     cools down instead of spinning the worker; the host tier keeps
     serving bit-exact."""
     build_oversub(holder)
-    eng = _fresh_engine(holder, mesh1, ROW_SHARD // 2)  # < one row-shard
+    # Below even the MINIMUM block-pool tier (8 slots x 2 KiB): the
+    # pow2-row era used ROW_SHARD // 2 here, but a pool serves a
+    # 2-row working set in ~16 KiB, so "cannot fit even partially"
+    # now means a budget under that floor.
+    eng = _fresh_engine(holder, mesh1, 4096)
     ex = Executor(holder, mesh_engine=eng)
     ex_host = Executor(holder)
     q = QUERIES[0]
